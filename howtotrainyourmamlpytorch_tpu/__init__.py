@@ -1,0 +1,25 @@
+"""TPU-native MAML / MAML++ few-shot meta-learning framework.
+
+A ground-up JAX/XLA/pjit redesign of the capabilities of
+``abhishekpandey07/HowToTrainYourMAMLPytorch`` (MAML++, Antoniou et al. 2019):
+pure-functional networks over parameter pytrees, inner-loop adaptation as
+``lax.scan`` with second-order ``jax.grad`` and rematerialization, tasks
+vmapped and sharded across a device mesh with a single meta-gradient ``psum``
+per outer step.
+
+Layer map (ours → reference):
+  config.py            → utils/parser_utils.py + experiment_config/*.json
+  models/              → meta_neural_network_architectures.py
+  meta/                → few_shot_learning_system.py + inner_loop_optimizers.py
+  parallel/            → nn.DataParallel / NCCL (upgraded to mesh + psum)
+  data/                → data.py
+  utils/               → utils/storage.py
+  experiment.py        → experiment_builder.py
+  train_maml_system.py → train_maml_system.py
+"""
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+__version__ = "0.1.0"
+
+__all__ = ["MAMLConfig", "__version__"]

@@ -1,0 +1,230 @@
+"""Configuration system for the TPU-native MAML++ framework.
+
+Mirrors the reference's flag surface (reference: ``utils/parser_utils.py §
+get_args`` — argparse defaults overridden by an ``experiment_config/*.json``
+file passed via ``--name_of_args_json_file``). We keep drop-in compatibility
+with the reference's JSON schema: every key the reference configs use is
+accepted verbatim by :func:`MAMLConfig.from_dict`; GPU-specific keys are
+accepted and ignored (recorded in ``ignored_keys``) since device selection is
+handled by JAX/XLA.
+
+The config is a frozen dataclass so it can be closed over by jitted functions
+safely (all jit-static decisions — inner-step counts, MAML++ feature toggles,
+backbone shape — are plain Python values here, never traced).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import warnings
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+# Reference keys that configure CUDA/worker plumbing with no TPU equivalent.
+# Accepted (so reference JSON loads unmodified) but ignored.
+_IGNORED_REFERENCE_KEYS = {
+    "gpu_to_use",
+    "num_of_gpus",
+    "num_dataset_workers",
+    "use_gpu",
+    "gpu_id",
+    "dataset_workers",
+    "reset_stored_filepaths",
+    "name_of_args_json_file",
+    "samples_per_iter",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MAMLConfig:
+    """Full experiment configuration.
+
+    Field names follow the reference flag names (``utils/parser_utils.py``)
+    so reference JSON configs load without a translation table. TPU-specific
+    additions are grouped at the bottom and all have safe defaults.
+    """
+
+    # ---- experiment identity / schedule -------------------------------
+    experiment_name: str = "maml_experiment"
+    seed: int = 104
+    train_seed: int = 0
+    val_seed: int = 0
+    total_epochs: int = 100
+    total_iter_per_epoch: int = 500
+    total_epochs_before_pause: int = 100
+    continue_from_epoch: Union[str, int] = "from_scratch"  # 'latest' | int
+    evaluate_on_test_set_only: bool = False
+    max_models_to_save: int = 5
+    num_evaluation_tasks: int = 600
+
+    # ---- dataset -------------------------------------------------------
+    dataset_name: str = "omniglot_dataset"
+    dataset_path: str = "datasets"
+    image_height: int = 28
+    image_width: int = 28
+    image_channels: int = 1
+    reverse_channels: bool = False
+    augment_images: bool = False  # Omniglot rotation-classes (x4)
+    num_classes_per_set: int = 5      # N-way
+    num_samples_per_class: int = 1    # K-shot (support)
+    num_target_samples: int = 1       # target (query) samples per class
+    batch_size: int = 16              # meta-batch: tasks per outer step
+    sets_are_pre_split: bool = True
+    load_into_memory: bool = False
+    labels_as_int: bool = False
+    indexes_of_folders_indicating_class: Tuple[int, ...] = (-3, -2)
+
+    # ---- backbone ------------------------------------------------------
+    num_stages: int = 4
+    cnn_num_filters: int = 64
+    conv_padding: bool = True
+    max_pooling: bool = True
+    per_step_bn_statistics: bool = True          # BNRS
+    learnable_bn_gamma: bool = True              # BNWB (gamma)
+    learnable_bn_beta: bool = True               # BNWB (beta)
+    enable_inner_loop_optimizable_bn_params: bool = False
+    norm_layer: str = "batch_norm"               # 'batch_norm' | 'layer_norm'
+    batch_norm_momentum: float = 0.1
+    batch_norm_eps: float = 1e-5
+    backbone: str = "vgg"                        # 'vgg' | 'resnet12'
+
+    # ---- meta-learning (MAML / MAML++) ---------------------------------
+    number_of_training_steps_per_iter: int = 5   # K (inner steps, train)
+    number_of_evaluation_steps_per_iter: int = 5 # K (inner steps, eval)
+    task_learning_rate: float = 0.1              # inner-loop LR init
+    learnable_per_layer_per_step_inner_loop_learning_rate: bool = True  # LSLR
+    second_order: bool = True
+    first_order_to_second_order_epoch: int = -1  # DA: 2nd order iff epoch > this
+    use_multi_step_loss_optimization: bool = True  # MSL
+    multi_step_loss_num_epochs: int = 15
+    meta_learning_rate: float = 0.001
+    min_learning_rate: float = 0.00001           # cosine eta_min
+    meta_adam_beta1: float = 0.9
+    meta_adam_beta2: float = 0.999
+    meta_adam_eps: float = 1e-8
+    clamp_meta_grad_value: Optional[float] = None  # ±value per-param clamp
+
+    # ---- TPU-native additions ------------------------------------------
+    mesh_shape: Tuple[int, ...] = (1, 1)   # (dcn, tasks); product must divide
+    mesh_axis_names: Tuple[str, ...] = ("dcn", "tasks")
+    compute_dtype: str = "bfloat16"        # matmul/conv compute dtype
+    param_dtype: str = "float32"
+    remat_inner_steps: bool = True         # jax.checkpoint per inner step
+    prefetch_batches: int = 2              # host->device prefetch depth
+    experiment_root: str = "experiments"
+
+    # Keys found in a loaded JSON that we accepted-and-ignored (for logging).
+    ignored_keys: Tuple[str, ...] = ()
+
+    # ------------------------------------------------------------------
+    def __post_init__(self) -> None:
+        if self.norm_layer not in ("batch_norm", "layer_norm"):
+            raise ValueError(f"unknown norm_layer {self.norm_layer!r}")
+        if self.backbone not in ("vgg", "resnet12"):
+            raise ValueError(f"unknown backbone {self.backbone!r}")
+        if self.num_classes_per_set < 2:
+            raise ValueError("num_classes_per_set must be >= 2")
+        if self.number_of_training_steps_per_iter < 1:
+            raise ValueError("need at least one inner step")
+
+    # ---- derived values -------------------------------------------------
+    @property
+    def num_support_per_task(self) -> int:
+        return self.num_classes_per_set * self.num_samples_per_class
+
+    @property
+    def num_target_per_task(self) -> int:
+        return self.num_classes_per_set * self.num_target_samples
+
+    @property
+    def image_shape(self) -> Tuple[int, int, int]:
+        """(H, W, C) — NHWC, the TPU-native layout."""
+        return (self.image_height, self.image_width, self.image_channels)
+
+    @property
+    def bn_num_steps(self) -> int:
+        """Leading dim of per-step BN state/γ/β.
+
+        Reference shapes its per-step buffers ``(num_steps, F)`` indexed by
+        ``num_step`` (``meta_neural_network_architectures.py §
+        MetaBatchNormLayer``). Every forward uses step indices in
+        ``[0, num_steps)`` (the MSL target forward reuses the current step's
+        index; the final-only forward uses the last step's), so we allocate
+        exactly ``max(train, eval)`` rows — eval step counts beyond the
+        training count get their own BN rows — and clip the index
+        defensively in the layer.
+        """
+        if not self.per_step_bn_statistics:
+            return 1
+        return max(self.number_of_training_steps_per_iter,
+                   self.number_of_evaluation_steps_per_iter)
+
+    @property
+    def lslr_num_steps(self) -> int:
+        """Rows per LSLR learning-rate vector: one per possible inner step,
+        covering eval step counts that exceed the training count (those
+        extra rows simply keep their ``task_learning_rate`` init since no
+        gradient ever reaches them)."""
+        return max(self.number_of_training_steps_per_iter,
+                   self.number_of_evaluation_steps_per_iter)
+
+    def use_second_order(self, epoch: int) -> bool:
+        """Derivative-order annealing (reference:
+        ``few_shot_learning_system.py § forward`` — second order iff the
+        flag is set and ``epoch > first_order_to_second_order_epoch``)."""
+        return bool(self.second_order
+                    and epoch > self.first_order_to_second_order_epoch)
+
+    def use_msl(self, epoch: int) -> bool:
+        """Multi-step loss active this epoch (training only)."""
+        return bool(self.use_multi_step_loss_optimization
+                    and epoch < self.multi_step_loss_num_epochs)
+
+    # ---- construction ---------------------------------------------------
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "MAMLConfig":
+        """Build a config from a dict using the reference JSON schema.
+
+        Unknown keys are collected into ``ignored_keys`` rather than raising,
+        so reference configs (and future reference versions) load cleanly.
+        """
+        field_names = {f.name for f in dataclasses.fields(cls)}
+        kwargs: Dict[str, Any] = {}
+        ignored: List[str] = []
+        for key, value in d.items():
+            if key in field_names and key != "ignored_keys":
+                kwargs[key] = value
+            else:
+                ignored.append(key)
+                if key not in _IGNORED_REFERENCE_KEYS:
+                    # Likely a typo or an unknown reference key — loud but
+                    # non-fatal so newer reference configs still load.
+                    warnings.warn(f"MAMLConfig: unrecognized config key "
+                                  f"{key!r} ignored", stacklevel=2)
+        # Reference behavior: Mini/Tiered-ImageNet runs clamp per-parameter
+        # meta-gradients to ±10 (``few_shot_learning_system.py §
+        # meta_update``). Reproduce when the JSON doesn't say otherwise.
+        ds = str(kwargs.get("dataset_name", cls.dataset_name))
+        if "imagenet" in ds.lower() and "clamp_meta_grad_value" not in kwargs:
+            kwargs["clamp_meta_grad_value"] = 10.0
+        # JSON has no tuples; normalize list-valued fields.
+        for tup_field in ("mesh_shape", "mesh_axis_names",
+                          "indexes_of_folders_indicating_class"):
+            if tup_field in kwargs and isinstance(kwargs[tup_field], list):
+                kwargs[tup_field] = tuple(kwargs[tup_field])
+        kwargs["ignored_keys"] = tuple(sorted(ignored))
+        return cls(**kwargs)
+
+    @classmethod
+    def from_json_file(cls, path: Union[str, os.PathLike]) -> "MAMLConfig":
+        with open(path, "r") as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.pop("ignored_keys", None)
+        return d
+
+    def replace(self, **kwargs: Any) -> "MAMLConfig":
+        return dataclasses.replace(self, **kwargs)

@@ -1,0 +1,24 @@
+from howtotrainyourmamlpytorch_tpu.meta.inner import (
+    Episode,
+    TaskResult,
+    lslr_init,
+    merge_fast_slow,
+    per_step_loss_importance,
+    split_fast_slow,
+    task_forward,
+)
+from howtotrainyourmamlpytorch_tpu.meta.outer import (
+    MetaTrainState,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+    meta_lr_schedule,
+    init_train_state,
+)
+
+__all__ = [
+    "Episode", "TaskResult", "lslr_init", "merge_fast_slow",
+    "per_step_loss_importance", "split_fast_slow", "task_forward",
+    "MetaTrainState", "make_eval_step", "make_optimizer", "make_train_step",
+    "meta_lr_schedule", "init_train_state",
+]

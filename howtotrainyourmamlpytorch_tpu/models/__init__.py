@@ -1,0 +1,3 @@
+from howtotrainyourmamlpytorch_tpu.models.vgg import make_model, make_vgg
+
+__all__ = ["make_model", "make_vgg"]

@@ -1,0 +1,205 @@
+"""Pure-functional layers with per-step norm state (BNRS / BNWB).
+
+Reference: ``meta_neural_network_architectures.py`` — MetaConv2dLayer,
+MetaLinearLayer, MetaBatchNormLayer, MetaLayerNormLayer. The reference's core
+contortion — every ``forward`` accepting an *external* weight dict so the
+inner loop can run task-adapted "fast weights" while autograd stays connected
+to the slow weights — is JAX's native shape: every function here is
+``apply(params, state, x, step) -> (y, state)`` over plain pytrees. There is
+no module state anywhere; ``extract_top_level_dict`` has no equivalent
+because nested dicts are the parameter format.
+
+TPU notes:
+  * NHWC layout + HWIO kernels (XLA:TPU's preferred conv layout).
+  * Convs/matmuls run in a configurable compute dtype (bfloat16 by default)
+    with float32 params and float32 normalization statistics — the MXU path.
+  * The per-step index may be a traced int (the ``lax.scan`` counter);
+    per-step γ/β/stat rows are selected with dynamic indexing, which XLA
+    lowers to a gather — no recompilation per step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# initializers (PyTorch-matching so reference hyperparameters transfer;
+# reference init: xavier-uniform weights, zero biases, BN γ=1 β=0)
+# ---------------------------------------------------------------------------
+
+def _xavier_uniform(key: jax.Array, shape: Tuple[int, ...],
+                    fan_in: int, fan_out: int,
+                    dtype: jnp.dtype = jnp.float32) -> jax.Array:
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, minval=-limit, maxval=limit)
+
+
+# ---------------------------------------------------------------------------
+# conv / linear
+# ---------------------------------------------------------------------------
+
+def conv2d_init(key: jax.Array, in_channels: int, out_channels: int,
+                kernel_size: int = 3,
+                dtype: jnp.dtype = jnp.float32) -> Params:
+    """HWIO kernel + bias. Reference: MetaConv2dLayer (xavier-uniform w,
+    zero b)."""
+    shape = (kernel_size, kernel_size, in_channels, out_channels)
+    receptive = kernel_size * kernel_size
+    w = _xavier_uniform(key, shape, in_channels * receptive,
+                        out_channels * receptive, dtype)
+    return {"w": w, "b": jnp.zeros((out_channels,), dtype)}
+
+
+def conv2d_apply(params: Params, x: jax.Array, *, stride: int = 1,
+                 padding: str = "SAME",
+                 compute_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+    """3x3 conv, NHWC, computed entirely in ``compute_dtype``.
+
+    bf16×bf16 accumulates in f32 on the MXU natively; keeping the *output*
+    dtype equal to the input dtype (rather than forcing f32 via
+    ``preferred_element_type``) keeps the conv VJP dtype-consistent under
+    the nested jax.grad of the meta-objective. The following norm layer
+    re-centers in f32.
+    """
+    w = params["w"].astype(compute_dtype)
+    y = jax.lax.conv_general_dilated(
+        x.astype(compute_dtype), w,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + params["b"].astype(compute_dtype)
+
+
+def linear_init(key: jax.Array, in_features: int, out_features: int,
+                dtype: jnp.dtype = jnp.float32) -> Params:
+    """Reference: MetaLinearLayer (xavier-uniform w, zero b)."""
+    w = _xavier_uniform(key, (in_features, out_features),
+                        in_features, out_features, dtype)
+    return {"w": w, "b": jnp.zeros((out_features,), dtype)}
+
+
+def linear_apply(params: Params, x: jax.Array, *,
+                 compute_dtype: jnp.dtype = jnp.bfloat16) -> jax.Array:
+    y = jnp.dot(x.astype(compute_dtype), params["w"].astype(compute_dtype))
+    return y + params["b"].astype(compute_dtype)
+
+
+# ---------------------------------------------------------------------------
+# per-step batch norm (BNRS + BNWB)
+# ---------------------------------------------------------------------------
+
+def batch_norm_init(num_features: int, num_steps: int,
+                    dtype: jnp.dtype = jnp.float32) -> Tuple[Params, State]:
+    """Per-step BN parameters and running-stat state.
+
+    Reference: MetaBatchNormLayer — running mean/var buffers shaped
+    ``(num_steps, F)`` indexed by the inner-step number (BNRS), learnable
+    per-step γ/β (BNWB). ``num_steps == 1`` recovers ordinary shared BN
+    (per_step_bn_statistics=False).
+    """
+    params = {
+        "gamma": jnp.ones((num_steps, num_features), dtype),
+        "beta": jnp.zeros((num_steps, num_features), dtype),
+    }
+    state = {
+        "mean": jnp.zeros((num_steps, num_features), dtype),
+        "var": jnp.ones((num_steps, num_features), dtype),
+    }
+    return params, state
+
+
+def batch_norm_apply(params: Params, state: State, x: jax.Array,
+                     step: jax.Array, *, training: bool,
+                     momentum: float = 0.1,
+                     eps: float = 1e-5) -> Tuple[jax.Array, State]:
+    """Normalize with *batch* statistics and update the step's running stats.
+
+    Matches the reference's semantics exactly: ``F.batch_norm(...,
+    training=True)`` is used in **both** train and eval inner loops
+    (few_shot_learning_system eval still adapts and still batch-normalizes;
+    SURVEY.md §3.3 note), so normalization always uses the current batch's
+    statistics; running stats are tracked with torch's momentum convention
+    ``r ← (1−m)·r + m·batch`` (unbiased variance for the running update,
+    biased for normalization) but never used to normalize. When
+    ``training=False`` the caller discards the returned state, reproducing
+    the reference's backup/restore-around-eval-tasks behavior functionally.
+
+    ``step`` may be a traced scalar; rows are selected dynamically.
+    """
+    num_steps = params["gamma"].shape[0]
+    idx = jnp.clip(step, 0, num_steps - 1)
+    gamma = jnp.take(params["gamma"], idx, axis=0)
+    beta = jnp.take(params["beta"], idx, axis=0)
+
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))  # all but channel
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+
+    inv = jax.lax.rsqrt(var + eps)
+    y = (xf - mean) * inv * gamma + beta
+
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    unbiased = var * (n / max(n - 1, 1))
+    new_state = {
+        "mean": state["mean"].at[idx].set(
+            (1.0 - momentum) * state["mean"][idx] + momentum * mean),
+        "var": state["var"].at[idx].set(
+            (1.0 - momentum) * state["var"][idx] + momentum * unbiased),
+    }
+    return y.astype(x.dtype), new_state
+
+
+# ---------------------------------------------------------------------------
+# layer norm (reference: MetaLayerNormLayer; rarely used — MAML++ configs use
+# batch_norm — provided for config parity)
+# ---------------------------------------------------------------------------
+
+def layer_norm_init(num_features: int,
+                    dtype: jnp.dtype = jnp.float32) -> Tuple[Params, State]:
+    params = {
+        "gamma": jnp.ones((1, num_features), dtype),
+        "beta": jnp.zeros((1, num_features), dtype),
+    }
+    return params, {}
+
+
+def layer_norm_apply(params: Params, state: State, x: jax.Array,
+                     step: jax.Array, *, training: bool,
+                     eps: float = 1e-5) -> Tuple[jax.Array, State]:
+    """Per-sample normalization over all non-batch dims, per-channel affine.
+
+    Deviation from the reference noted: MetaLayerNormLayer's affine is over
+    the full (C,H,W) feature shape; ours is per-channel, which keeps the
+    parameter pytree shape-stable across stages. MAML++ shipped configs use
+    batch_norm, so this only affects the optional layer_norm mode.
+    """
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(1, x.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * params["gamma"][0] + params["beta"][0]
+    return y.astype(x.dtype), state
+
+
+def max_pool2d(x: jax.Array, window: int = 2, stride: int = 2) -> jax.Array:
+    """2x2 max pool, NHWC, VALID padding (torch F.max_pool2d default:
+    floor)."""
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )

@@ -1,0 +1,119 @@
+"""VGG-style few-shot backbone as a pure init/apply pair.
+
+Reference: ``meta_neural_network_architectures.py § VGGReLUNormNetwork`` —
+``num_stages`` (=4) blocks of [3x3 conv (cnn_num_filters) → norm → ReLU →
+2x2 max-pool] → flatten → linear to ``num_classes_per_set`` logits, where
+every forward accepts external (fast) weights and an inner-step index for the
+per-step norm parameters/statistics.
+
+Here the network is a closure pair built by :func:`make_vgg`:
+
+    init(key)                                  -> (params, bn_state)
+    apply(params, bn_state, x, step, training) -> (logits, new_bn_state)
+
+``params``/``bn_state`` are nested dicts keyed ``conv0..convN-1``,
+``norm0..normN-1``, ``linear`` — the flatten dim for the final linear is
+inferred with ``jax.eval_shape`` (the reference does a dummy forward for the
+same purpose).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.models import layers
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+InitFn = Callable[[jax.Array], Tuple[Params, State]]
+ApplyFn = Callable[..., Tuple[jax.Array, State]]
+
+
+def _features_apply(cfg: MAMLConfig, params: Params, state: State,
+                    x: jax.Array, step: jax.Array,
+                    training: bool) -> Tuple[jax.Array, State]:
+    """Conv tower: returns flattened features and the new norm state."""
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    norm_apply = (layers.batch_norm_apply if cfg.norm_layer == "batch_norm"
+                  else layers.layer_norm_apply)
+    new_state: State = {}
+    stride = 1 if cfg.max_pooling else 2
+    padding = "SAME" if cfg.conv_padding else "VALID"
+    for i in range(cfg.num_stages):
+        x = layers.conv2d_apply(params[f"conv{i}"], x, stride=stride,
+                                padding=padding,
+                                compute_dtype=compute_dtype)
+        norm_kwargs = {}
+        if cfg.norm_layer == "batch_norm":
+            norm_kwargs = dict(momentum=cfg.batch_norm_momentum,
+                               eps=cfg.batch_norm_eps)
+        x, new_state[f"norm{i}"] = norm_apply(
+            params[f"norm{i}"], state[f"norm{i}"], x, step,
+            training=training, **norm_kwargs)
+        x = jax.nn.relu(x)
+        if cfg.max_pooling:
+            x = layers.max_pool2d(x)
+    return x.reshape(x.shape[0], -1), new_state
+
+
+def make_vgg(cfg: MAMLConfig) -> Tuple[InitFn, ApplyFn]:
+    """Build (init, apply) for the VGG backbone described by ``cfg``."""
+    h, w, c = cfg.image_shape
+    num_steps = cfg.bn_num_steps if cfg.norm_layer == "batch_norm" else 1
+
+    def init(key: jax.Array) -> Tuple[Params, State]:
+        params: Params = {}
+        state: State = {}
+        keys = jax.random.split(key, cfg.num_stages + 1)
+        in_ch = c
+        for i in range(cfg.num_stages):
+            params[f"conv{i}"] = layers.conv2d_init(
+                keys[i], in_ch, cfg.cnn_num_filters)
+            if cfg.norm_layer == "batch_norm":
+                params[f"norm{i}"], state[f"norm{i}"] = (
+                    layers.batch_norm_init(cfg.cnn_num_filters, num_steps))
+            else:
+                params[f"norm{i}"], state[f"norm{i}"] = (
+                    layers.layer_norm_init(cfg.cnn_num_filters))
+            in_ch = cfg.cnn_num_filters
+
+        # Infer flatten dim (reference does a dummy forward in __init__).
+        feat_shape = jax.eval_shape(
+            lambda p, s: _features_apply(cfg, p, s, jnp.zeros((1, h, w, c)),
+                                         jnp.int32(0), True)[0],
+            params, state)
+        params["linear"] = layers.linear_init(
+            keys[-1], feat_shape.shape[-1], cfg.num_classes_per_set)
+        return params, state
+
+    def apply(params: Params, state: State, x: jax.Array, step: jax.Array,
+              training: bool) -> Tuple[jax.Array, State]:
+        feats, new_state = _features_apply(cfg, params, state, x, step,
+                                           training)
+        logits = layers.linear_apply(params["linear"], feats,
+                                     compute_dtype=jnp.dtype(
+                                         cfg.compute_dtype))
+        # Logits (and hence losses/softmax) always in f32.
+        return logits.astype(jnp.float32), new_state
+
+    return init, apply
+
+
+def make_model(cfg: MAMLConfig) -> Tuple[InitFn, ApplyFn]:
+    """Backbone dispatch (reference hardwires VGGReLUNormNetwork; we also
+    ship ResNet-12 for the pod-scale tiered-imagenet config)."""
+    if cfg.backbone == "vgg":
+        return make_vgg(cfg)
+    if cfg.backbone == "resnet12":
+        try:
+            from howtotrainyourmamlpytorch_tpu.models import resnet12
+        except ImportError as e:
+            raise NotImplementedError(
+                "resnet12 backbone is not available in this build") from e
+        return resnet12.make_resnet12(cfg)
+    raise ValueError(f"unknown backbone {cfg.backbone!r}")

@@ -1,0 +1,27 @@
+"""Test harness: force an 8-device CPU platform before JAX backends init.
+
+Multi-device sharding tests run on a virtual CPU mesh (SURVEY.md §4); real-TPU
+benchmarking happens only in bench.py.
+
+Note: this environment pre-imports JAX config from a sitecustomize hook (the
+axon TPU tunnel), so JAX_PLATFORMS set here would be read too late — we must
+go through ``jax.config.update``. XLA_FLAGS is still honored because backends
+aren't instantiated until first use.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after env setup, before any test imports)
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+assert jax.device_count() == 8, (
+    f"expected 8 virtual CPU devices, got {jax.devices()}"
+)

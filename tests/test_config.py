@@ -1,0 +1,106 @@
+import json
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+
+def test_defaults_valid():
+    cfg = MAMLConfig()
+    assert cfg.num_support_per_task == 5
+    assert cfg.bn_num_steps == 5  # max(train=5, eval=5)
+    assert cfg.lslr_num_steps == 5
+
+
+def test_eval_longer_than_train_sizes_per_step_rows():
+    cfg = MAMLConfig(number_of_training_steps_per_iter=3,
+                     number_of_evaluation_steps_per_iter=7)
+    assert cfg.bn_num_steps == 7
+    assert cfg.lslr_num_steps == 7
+
+
+def test_unknown_key_warns():
+    import warnings as w
+    with w.catch_warnings(record=True) as rec:
+        w.simplefilter("always")
+        cfg = MAMLConfig.from_dict({"second_ordre": True, "gpu_to_use": 1})
+    msgs = [str(r.message) for r in rec]
+    assert any("second_ordre" in m for m in msgs)       # typo: loud
+    assert not any("gpu_to_use" in m for m in msgs)     # known GPU key: quiet
+    assert "second_ordre" in cfg.ignored_keys
+
+
+def test_reference_json_schema_loads(tmp_path):
+    # A dict shaped like the reference's experiment_config/*.json, including
+    # GPU keys we must accept-and-ignore.
+    ref = {
+        "batch_size": 16,
+        "image_height": 28, "image_width": 28, "image_channels": 1,
+        "gpu_to_use": 0, "num_dataset_workers": 4,
+        "num_of_gpus": 1,
+        "dataset_name": "omniglot_dataset",
+        "dataset_path": "datasets/omniglot_dataset",
+        "reset_stored_filepaths": False,
+        "experiment_name": "omniglot_20_way_1_shot",
+        "train_seed": 0, "val_seed": 0,
+        "num_classes_per_set": 20,
+        "num_samples_per_class": 1,
+        "num_target_samples": 1,
+        "second_order": True,
+        "total_epochs": 100,
+        "total_iter_per_epoch": 500,
+        "number_of_training_steps_per_iter": 5,
+        "number_of_evaluation_steps_per_iter": 5,
+        "learnable_per_layer_per_step_inner_loop_learning_rate": True,
+        "use_multi_step_loss_optimization": True,
+        "multi_step_loss_num_epochs": 10,
+        "first_order_to_second_order_epoch": -1,
+        "task_learning_rate": 0.1,
+        "meta_learning_rate": 0.001,
+        "min_learning_rate": 0.001,
+        "norm_layer": "batch_norm",
+        "cnn_num_filters": 64,
+        "num_stages": 4,
+        "conv_padding": True,
+        "max_pooling": True,
+        "per_step_bn_statistics": True,
+        "learnable_bn_gamma": True,
+        "learnable_bn_beta": True,
+        "enable_inner_loop_optimizable_bn_params": False,
+        "evaluate_on_test_set_only": False,
+        "max_models_to_save": 5,
+        "seed": 104,
+    }
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(ref))
+    cfg = MAMLConfig.from_json_file(p)
+    assert cfg.num_classes_per_set == 20
+    assert cfg.batch_size == 16
+    assert "gpu_to_use" in cfg.ignored_keys
+    assert "reset_stored_filepaths" in cfg.ignored_keys
+    assert cfg.clamp_meta_grad_value is None  # omniglot: no clamp
+
+
+def test_imagenet_gets_grad_clamp():
+    cfg = MAMLConfig.from_dict({"dataset_name": "mini_imagenet_full_size"})
+    assert cfg.clamp_meta_grad_value == 10.0
+
+
+def test_derivative_order_annealing():
+    cfg = MAMLConfig(second_order=True, first_order_to_second_order_epoch=40)
+    assert not cfg.use_second_order(0)
+    assert not cfg.use_second_order(40)
+    assert cfg.use_second_order(41)
+    cfg2 = MAMLConfig(second_order=False)
+    assert not cfg2.use_second_order(99)
+
+
+def test_msl_phase():
+    cfg = MAMLConfig(use_multi_step_loss_optimization=True,
+                     multi_step_loss_num_epochs=15)
+    assert cfg.use_msl(0) and cfg.use_msl(14) and not cfg.use_msl(15)
+
+
+def test_invalid_norm_layer_rejected():
+    with pytest.raises(ValueError):
+        MAMLConfig(norm_layer="group_norm")

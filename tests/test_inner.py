@@ -1,0 +1,210 @@
+"""Inner-loop correctness: MSL schedule parity, LSLR updates, and
+first-order vs second-order meta-gradient semantics against a torch
+autograd oracle (create_graph=False/True) on a tiny linear model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta import inner
+from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+
+
+def reference_msl_schedule(k, msl_epochs, epoch):
+    """Direct loop port of the reference's
+    get_per_step_loss_importance_vector for oracle comparison."""
+    w = np.ones(k) * (1.0 / k)
+    decay = 1.0 / k / msl_epochs
+    min_nonfinal = 0.03 / k
+    for i in range(k - 1):
+        w[i] = max(w[i] - epoch * decay, min_nonfinal)
+    w[-1] = min(w[-1] + epoch * (k - 1) * decay,
+                1.0 - ((k - 1) * min_nonfinal))
+    return w
+
+
+def test_msl_schedule_matches_reference():
+    cfg = MAMLConfig(number_of_training_steps_per_iter=5,
+                     multi_step_loss_num_epochs=15)
+    for epoch in [0, 1, 5, 14, 15, 50]:
+        ours = np.asarray(inner.per_step_loss_importance(cfg, epoch))
+        ref = reference_msl_schedule(5, 15, epoch)
+        np.testing.assert_allclose(ours, ref, rtol=1e-6)
+        np.testing.assert_allclose(ours.sum(), 1.0, atol=1e-6)
+
+
+def test_msl_anneals_to_final_step_only():
+    cfg = MAMLConfig(number_of_training_steps_per_iter=5,
+                     multi_step_loss_num_epochs=10)
+    w = np.asarray(inner.per_step_loss_importance(cfg, 1000))
+    assert w[-1] > 0.97
+    np.testing.assert_allclose(w[:-1], 0.03 / 5, rtol=1e-6)
+
+
+def test_split_fast_slow():
+    cfg = MAMLConfig()
+    params = {"conv0": {"w": jnp.zeros(3)}, "norm0": {"gamma": jnp.ones(3)},
+              "linear": {"w": jnp.zeros(3)}}
+    fast, slow = inner.split_fast_slow(cfg, params)
+    assert set(fast) == {"conv0", "linear"} and set(slow) == {"norm0"}
+    cfg2 = cfg.replace(enable_inner_loop_optimizable_bn_params=True)
+    fast2, slow2 = inner.split_fast_slow(cfg2, params)
+    assert set(fast2) == {"conv0", "norm0", "linear"} and not slow2
+
+
+def test_lslr_init_shapes():
+    cfg = MAMLConfig(number_of_training_steps_per_iter=3,
+                     number_of_evaluation_steps_per_iter=3,
+                     task_learning_rate=0.4)
+    lslr = inner.lslr_init(cfg, {"conv0": {"w": jnp.zeros((2, 2))}})
+    assert lslr["conv0"]["w"].shape == (3,)
+    np.testing.assert_allclose(float(lslr["conv0"]["w"][0]), 0.4, rtol=1e-6)
+    # Longer eval adaptation gets real (untrained) rows.
+    cfg2 = MAMLConfig(number_of_training_steps_per_iter=3,
+                      number_of_evaluation_steps_per_iter=8)
+    lslr2 = inner.lslr_init(cfg2, {"conv0": {"w": jnp.zeros((2, 2))}})
+    assert lslr2["conv0"]["w"].shape == (8,)
+
+
+# ---------------------------------------------------------------------------
+# torch-oracle meta-gradient parity on a linear model (no norm layers)
+# ---------------------------------------------------------------------------
+
+def _linear_apply(params, state, x, step, training):
+    return x @ params["lin"]["w"] + params["lin"]["b"], state
+
+
+def _torch_maml_grads(w0, b0, sx, sy, tx, ty, lr, num_steps, second_order):
+    w = torch.tensor(w0, requires_grad=True, dtype=torch.float64)
+    b = torch.tensor(b0, requires_grad=True, dtype=torch.float64)
+    sx_t, tx_t = torch.tensor(sx).double(), torch.tensor(tx).double()
+    sy_t, ty_t = torch.tensor(sy), torch.tensor(ty)
+    fw, fb = w, b
+    for _ in range(num_steps):
+        loss = torch.nn.functional.cross_entropy(sx_t @ fw + fb, sy_t)
+        gw, gb = torch.autograd.grad(loss, (fw, fb),
+                                     create_graph=second_order)
+        if not second_order:
+            gw, gb = gw.detach(), gb.detach()
+        fw, fb = fw - lr * gw, fb - lr * gb
+    outer = torch.nn.functional.cross_entropy(tx_t @ fw + fb, ty_t)
+    return torch.autograd.grad(outer, (w, b))
+
+
+def _jax_maml_grads(cfg, w0, b0, sx, sy, tx, ty, second_order):
+    """Meta-grads in float64 (second-order in f32 amplifies rounding; the
+    parity claim is about *semantics*, so compare at high precision)."""
+    with jax.enable_x64(True):
+        params = {"lin": {"w": jnp.asarray(w0, jnp.float64),
+                          "b": jnp.asarray(b0, jnp.float64)}}
+        fast0, _ = inner.split_fast_slow(cfg, params)
+        lslr = jax.tree.map(lambda l: l.astype(jnp.float64),
+                            inner.lslr_init(cfg, fast0))
+        ep = Episode(jnp.asarray(sx, jnp.float64), jnp.asarray(sy),
+                     jnp.asarray(tx, jnp.float64), jnp.asarray(ty))
+
+        def loss_fn(p):
+            res = inner.task_forward(
+                cfg, _linear_apply, p, lslr, {}, ep,
+                num_steps=cfg.number_of_training_steps_per_iter,
+                second_order=second_order, use_msl=False, msl_weights=None)
+            return res.loss
+
+        return jax.grad(loss_fn)(params)
+
+
+def _setup(seed=0, n=4, d=6):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(d, n).astype(np.float32) * 0.3,
+            np.zeros(n, np.float32),
+            rng.randn(8, d).astype(np.float32),
+            rng.randint(0, n, 8).astype(np.int64),
+            rng.randn(8, d).astype(np.float32),
+            rng.randint(0, n, 8).astype(np.int64))
+
+
+def _cfg(**kw):
+    kw.setdefault("remat_inner_steps", True)
+    return MAMLConfig(num_classes_per_set=4, task_learning_rate=0.5,
+                      number_of_training_steps_per_iter=3, **kw)
+
+
+def test_second_order_grads_match_torch():
+    w0, b0, sx, sy, tx, ty = _setup()
+    cfg = _cfg()
+    g = _jax_maml_grads(cfg, w0, b0, sx, sy, tx, ty, second_order=True)
+    gw, gb = _torch_maml_grads(w0, b0, sx, sy, tx, ty, 0.5, 3, True)
+    np.testing.assert_allclose(np.asarray(g["lin"]["w"]), gw.numpy(),
+                               rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(g["lin"]["b"]), gb.numpy(),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_first_order_grads_match_torch():
+    w0, b0, sx, sy, tx, ty = _setup(seed=1)
+    cfg = _cfg()
+    g = _jax_maml_grads(cfg, w0, b0, sx, sy, tx, ty, second_order=False)
+    gw, gb = _torch_maml_grads(w0, b0, sx, sy, tx, ty, 0.5, 3, False)
+    np.testing.assert_allclose(np.asarray(g["lin"]["w"]), gw.numpy(),
+                               rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(np.asarray(g["lin"]["b"]), gb.numpy(),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_first_and_second_order_actually_differ():
+    w0, b0, sx, sy, tx, ty = _setup(seed=2)
+    cfg = _cfg()
+    g1 = _jax_maml_grads(cfg, w0, b0, sx, sy, tx, ty, second_order=False)
+    g2 = _jax_maml_grads(cfg, w0, b0, sx, sy, tx, ty, second_order=True)
+    assert not np.allclose(np.asarray(g1["lin"]["w"]),
+                           np.asarray(g2["lin"]["w"]), rtol=1e-3)
+
+
+def test_remat_does_not_change_gradients():
+    w0, b0, sx, sy, tx, ty = _setup(seed=3)
+    g_remat = _jax_maml_grads(_cfg(remat_inner_steps=True),
+                              w0, b0, sx, sy, tx, ty, True)
+    g_plain = _jax_maml_grads(_cfg(remat_inner_steps=False),
+                              w0, b0, sx, sy, tx, ty, True)
+    np.testing.assert_allclose(np.asarray(g_remat["lin"]["w"]),
+                               np.asarray(g_plain["lin"]["w"]), rtol=1e-6)
+
+
+def test_lslr_gradients_flow():
+    """LSLR learning rates receive meta-gradients (they're trainable in
+    MAML++)."""
+    w0, b0, sx, sy, tx, ty = _setup(seed=4)
+    cfg = _cfg()
+    params = {"lin": {"w": jnp.asarray(w0), "b": jnp.asarray(b0)}}
+    fast0, _ = inner.split_fast_slow(cfg, params)
+    lslr = inner.lslr_init(cfg, fast0)
+    ep = Episode(jnp.asarray(sx), jnp.asarray(sy), jnp.asarray(tx),
+                 jnp.asarray(ty))
+
+    def loss_fn(lrs):
+        return inner.task_forward(
+            cfg, _linear_apply, params, lrs, {}, ep, num_steps=3,
+            second_order=True, use_msl=False, msl_weights=None).loss
+
+    g = jax.grad(loss_fn)(lslr)
+    assert np.abs(np.asarray(g["lin"]["w"][:3])).sum() > 0
+    # Step indices beyond num_steps are never used -> zero grad.
+    assert np.asarray(g["lin"]["w"][3]) == 0
+
+
+def test_msl_loss_is_weighted_sum_of_per_step_losses():
+    w0, b0, sx, sy, tx, ty = _setup(seed=5)
+    cfg = _cfg()
+    params = {"lin": {"w": jnp.asarray(w0), "b": jnp.asarray(b0)}}
+    fast0, _ = inner.split_fast_slow(cfg, params)
+    lslr = inner.lslr_init(cfg, fast0)
+    ep = Episode(jnp.asarray(sx), jnp.asarray(sy), jnp.asarray(tx),
+                 jnp.asarray(ty))
+    w = inner.per_step_loss_importance(cfg, 0)
+    res = inner.task_forward(cfg, _linear_apply, params, lslr, {}, ep,
+                             num_steps=3, second_order=True, use_msl=True,
+                             msl_weights=w)
+    expect = float(jnp.sum(w[:3] * res.per_step_target_losses))
+    np.testing.assert_allclose(float(res.loss), expect, rtol=1e-6)

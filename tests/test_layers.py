@@ -1,0 +1,133 @@
+"""Numerical parity of the functional layers against a torch CPU oracle.
+
+The oracle re-implements the reference's layer math via torch.nn.functional
+(SURVEY.md §4: parity tests against a tiny CPU oracle, not copied code).
+All comparisons run in float32.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from howtotrainyourmamlpytorch_tpu.models import layers
+
+F32 = jnp.float32
+
+
+def _rand(key, shape):
+    return jax.random.normal(key, shape, F32)
+
+
+def test_conv2d_matches_torch():
+    key = jax.random.PRNGKey(0)
+    x = _rand(key, (2, 9, 9, 3))
+    params = layers.conv2d_init(jax.random.PRNGKey(1), 3, 8)
+    y = layers.conv2d_apply(params, x, compute_dtype=F32)
+
+    xt = torch.tensor(np.asarray(x)).permute(0, 3, 1, 2)  # NHWC->NCHW
+    wt = torch.tensor(np.asarray(params["w"])).permute(3, 2, 0, 1)  # HWIO->OIHW
+    bt = torch.tensor(np.asarray(params["b"]))
+    yt = F.conv2d(xt, wt, bt, stride=1, padding=1)  # SAME for 3x3
+    np.testing.assert_allclose(np.asarray(y),
+                               yt.permute(0, 2, 3, 1).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_stride2_valid_matches_torch():
+    x = _rand(jax.random.PRNGKey(2), (2, 10, 10, 4))
+    params = layers.conv2d_init(jax.random.PRNGKey(3), 4, 6)
+    y = layers.conv2d_apply(params, x, stride=2, padding="VALID",
+                            compute_dtype=F32)
+    xt = torch.tensor(np.asarray(x)).permute(0, 3, 1, 2)
+    wt = torch.tensor(np.asarray(params["w"])).permute(3, 2, 0, 1)
+    bt = torch.tensor(np.asarray(params["b"]))
+    yt = F.conv2d(xt, wt, bt, stride=2, padding=0)
+    np.testing.assert_allclose(np.asarray(y),
+                               yt.permute(0, 2, 3, 1).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_linear_matches_torch():
+    x = _rand(jax.random.PRNGKey(4), (5, 11))
+    params = layers.linear_init(jax.random.PRNGKey(5), 11, 7)
+    y = layers.linear_apply(params, x, compute_dtype=F32)
+    yt = F.linear(torch.tensor(np.asarray(x)),
+                  torch.tensor(np.asarray(params["w"])).T,
+                  torch.tensor(np.asarray(params["b"])))
+    np.testing.assert_allclose(np.asarray(y), yt.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_max_pool_matches_torch():
+    x = _rand(jax.random.PRNGKey(6), (2, 7, 7, 3))  # odd size: floor mode
+    y = layers.max_pool2d(x)
+    xt = torch.tensor(np.asarray(x)).permute(0, 3, 1, 2)
+    yt = F.max_pool2d(xt, 2).permute(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(y), yt.numpy(), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_batch_norm_matches_torch_training_mode():
+    """Normalization = batch stats; running stats updated with torch's
+    momentum convention (biased var to normalize, unbiased in the running
+    update) — the reference always calls F.batch_norm(training=True)."""
+    num_steps, feats = 4, 5
+    params, state = layers.batch_norm_init(feats, num_steps)
+    # Distinct initial stats so the per-step indexing is observable.
+    state = {"mean": state["mean"] + jnp.arange(num_steps)[:, None] * 0.5,
+             "var": state["var"] * (1 + jnp.arange(num_steps)[:, None])}
+    x = _rand(jax.random.PRNGKey(7), (6, 3, 3, feats))
+    step = 2
+    y, new_state = layers.batch_norm_apply(params, state, x,
+                                           jnp.int32(step), training=True)
+
+    xt = torch.tensor(np.asarray(x)).permute(0, 3, 1, 2).contiguous()
+    rm = torch.tensor(np.asarray(state["mean"][step]))
+    rv = torch.tensor(np.asarray(state["var"][step]))
+    yt = F.batch_norm(xt, rm, rv,
+                      torch.tensor(np.asarray(params["gamma"][step])),
+                      torch.tensor(np.asarray(params["beta"][step])),
+                      training=True, momentum=0.1, eps=1e-5)
+    np.testing.assert_allclose(np.asarray(y),
+                               yt.permute(0, 2, 3, 1).numpy(),
+                               rtol=1e-4, atol=1e-4)
+    # Running-stat update matches torch's in-place update, at row `step` only.
+    np.testing.assert_allclose(np.asarray(new_state["mean"][step]),
+                               rm.numpy(), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_state["var"][step]),
+                               rv.numpy(), rtol=1e-4, atol=1e-4)
+    for other in (0, 1, 3):
+        np.testing.assert_array_equal(np.asarray(new_state["mean"][other]),
+                                      np.asarray(state["mean"][other]))
+
+
+def test_batch_norm_step_index_clipped():
+    params, state = layers.batch_norm_init(3, 2)
+    x = _rand(jax.random.PRNGKey(8), (4, 2, 2, 3))
+    y_hi, _ = layers.batch_norm_apply(params, state, x, jnp.int32(99),
+                                      training=True)
+    y_last, _ = layers.batch_norm_apply(params, state, x, jnp.int32(1),
+                                        training=True)
+    np.testing.assert_array_equal(np.asarray(y_hi), np.asarray(y_last))
+
+
+def test_layer_norm_normalizes():
+    params, state = layers.layer_norm_init(4)
+    x = _rand(jax.random.PRNGKey(9), (3, 5, 5, 4))
+    y, _ = layers.layer_norm_apply(params, state, x, jnp.int32(0),
+                                   training=True)
+    flat = np.asarray(y).reshape(3, -1)
+    np.testing.assert_allclose(flat.mean(axis=1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(flat.std(axis=1), 1.0, atol=1e-3)
+
+
+def test_xavier_uniform_bounds():
+    params = layers.conv2d_init(jax.random.PRNGKey(10), 16, 32)
+    w = np.asarray(params["w"])
+    limit = np.sqrt(6.0 / (16 * 9 + 32 * 9))
+    assert np.all(np.abs(w) <= limit)
+    assert np.abs(w).max() > 0.8 * limit  # actually fills the range
+    assert np.all(np.asarray(params["b"]) == 0)

@@ -1,0 +1,70 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.models import make_model
+
+CFG = MAMLConfig(image_height=28, image_width=28, image_channels=1,
+                 num_classes_per_set=5, cnn_num_filters=16, num_stages=4,
+                 compute_dtype="float32")
+
+
+def test_vgg_shapes_and_state():
+    init, apply = make_model(CFG)
+    params, state = init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (7, 28, 28, 1))
+    logits, new_state = apply(params, state, x, jnp.int32(0), True)
+    assert logits.shape == (7, 5)
+    assert params["norm0"]["gamma"].shape == (CFG.bn_num_steps, 16)
+    assert state["norm0"]["mean"].shape == (CFG.bn_num_steps, 16)
+    # Only step-0 rows of the running stats moved.
+    changed = np.asarray(new_state["norm0"]["mean"]) != np.asarray(
+        state["norm0"]["mean"])
+    assert changed[0].any() and not changed[1:].any()
+
+
+def test_vgg_flatten_dim_inference():
+    # 28x28 with 4 stages of SAME conv + 2x2 pool -> 1x1 spatial.
+    init, _ = make_model(CFG)
+    params, _ = init(jax.random.PRNGKey(0))
+    assert params["linear"]["w"].shape == (16, 5)
+    # Mini-ImageNet geometry: 84 -> 42 -> 21 -> 10 -> 5 => 5*5*filters.
+    cfg = CFG.replace(image_height=84, image_width=84, image_channels=3,
+                      cnn_num_filters=48)
+    init2, _ = make_model(cfg)
+    params2, _ = init2(jax.random.PRNGKey(0))
+    assert params2["linear"]["w"].shape == (5 * 5 * 48, 5)
+
+
+def test_vgg_no_pooling_stride2():
+    cfg = CFG.replace(max_pooling=False)
+    init, apply = make_model(cfg)
+    params, state = init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 28, 28, 1))
+    logits, _ = apply(params, state, x, jnp.int32(0), True)
+    assert logits.shape == (3, 5)
+
+
+def test_vgg_jit_and_traced_step():
+    init, apply = make_model(CFG)
+    params, state = init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 28, 28, 1))
+
+    @jax.jit
+    def run(p, s, x, step):
+        return apply(p, s, x, step, True)
+
+    l0, _ = run(params, state, x, jnp.int32(0))
+    l1, _ = run(params, state, x, jnp.int32(1))  # same trace, dynamic index
+    assert l0.shape == l1.shape
+
+
+def test_layer_norm_backbone():
+    cfg = CFG.replace(norm_layer="layer_norm")
+    init, apply = make_model(cfg)
+    params, state = init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 28, 28, 1))
+    logits, new_state = apply(params, state, x, jnp.int32(0), True)
+    assert logits.shape == (2, 5)
+    assert params["norm0"]["gamma"].shape == (1, 16)

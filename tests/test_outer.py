@@ -1,0 +1,188 @@
+"""Outer-step tests: train_step learns, schedules behave, eval protocol
+returns per-task outputs."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta import (
+    Episode, init_train_state, make_eval_step, make_train_step,
+    meta_lr_schedule)
+from howtotrainyourmamlpytorch_tpu.models import make_model
+
+CFG = MAMLConfig(
+    image_height=12, image_width=12, image_channels=1,
+    num_classes_per_set=3, num_samples_per_class=2, num_target_samples=2,
+    cnn_num_filters=8, num_stages=2, batch_size=4,
+    number_of_training_steps_per_iter=2,
+    number_of_evaluation_steps_per_iter=2,
+    task_learning_rate=0.1, meta_learning_rate=0.01,
+    min_learning_rate=0.001, total_epochs=4, total_iter_per_epoch=10,
+    compute_dtype="float32")
+
+
+def _synthetic_batch(key, cfg, batch_size):
+    """Trivially separable episodes: class i images have mean i."""
+    n, k, t = (cfg.num_classes_per_set, cfg.num_samples_per_class,
+               cfg.num_target_samples)
+    h, w, c = cfg.image_shape
+    keys = jax.random.split(key, 2)
+    means = jnp.arange(n, dtype=jnp.float32)[:, None, None, None, None]
+
+    def gen(key, per_class):
+        noise = jax.random.normal(key,
+                                  (n, per_class * batch_size, h, w, c)) * 0.3
+        x = (noise + means).reshape(n, batch_size, per_class, h, w, c)
+        x = jnp.moveaxis(x, 1, 0).reshape(batch_size, n * per_class, h, w, c)
+        y = jnp.tile(jnp.repeat(jnp.arange(n), per_class)[None],
+                     (batch_size, 1))
+        return x, y
+
+    sx, sy = gen(keys[0], k)
+    tx, ty = gen(keys[1], t)
+    return Episode(sx, sy.astype(jnp.int32), tx, ty.astype(jnp.int32))
+
+
+def test_train_step_decreases_loss():
+    init, apply = make_model(CFG)
+    state = init_train_state(CFG, init, jax.random.PRNGKey(0))
+    train_step = jax.jit(
+        functools.partial(make_train_step(CFG, apply),
+                          second_order=True, use_msl=True))
+    losses = []
+    for i in range(20):
+        batch = _synthetic_batch(jax.random.PRNGKey(100 + i), CFG, 4)
+        state, metrics = train_step(state, batch, jnp.float32(0))
+        losses.append(float(metrics.loss))
+    assert losses[-1] < losses[0] * 0.7, losses
+    assert int(state.step) == 20
+
+
+def test_eval_step_outputs():
+    init, apply = make_model(CFG)
+    state = init_train_state(CFG, init, jax.random.PRNGKey(0))
+    eval_step = jax.jit(make_eval_step(CFG, apply))
+    batch = _synthetic_batch(jax.random.PRNGKey(0), CFG, 4)
+    res = eval_step(state, batch)
+    assert res.loss.shape == (4,)
+    assert res.accuracy.shape == (4,)
+    assert res.target_logits.shape == (4, 6, 3)
+    # Eval must not mutate training state (functional: nothing to assert on
+    # state, but logits must be finite).
+    assert np.isfinite(np.asarray(res.target_logits)).all()
+
+
+def test_first_order_step_runs_and_differs():
+    init, apply = make_model(CFG)
+    state = init_train_state(CFG, init, jax.random.PRNGKey(0))
+    ts = make_train_step(CFG, apply)
+    batch = _synthetic_batch(jax.random.PRNGKey(1), CFG, 4)
+    s_fo, m_fo = jax.jit(functools.partial(ts, second_order=False,
+                                           use_msl=False))(
+        state, batch, jnp.float32(0))
+    s_so, m_so = jax.jit(functools.partial(ts, second_order=True,
+                                           use_msl=False))(
+        state, batch, jnp.float32(0))
+    # Same forward loss (the loss is computed before the update)...
+    np.testing.assert_allclose(float(m_fo.loss), float(m_so.loss),
+                               rtol=1e-5)
+    # ...but different resulting parameters (different meta-gradients).
+    d = jax.tree.map(lambda a, b: float(jnp.abs(a - b).max()),
+                     s_fo.params, s_so.params)
+    assert max(jax.tree.leaves(d)) > 1e-7
+
+
+def test_lslr_frozen_when_not_learnable():
+    cfg = CFG.replace(
+        learnable_per_layer_per_step_inner_loop_learning_rate=False)
+    init, apply = make_model(cfg)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    train_step = jax.jit(functools.partial(make_train_step(cfg, apply),
+                                           second_order=True, use_msl=False))
+    batch = _synthetic_batch(jax.random.PRNGKey(2), cfg, 4)
+    new_state, _ = train_step(state, batch, jnp.float32(0))
+    for a, b in zip(jax.tree.leaves(state.lslr),
+                    jax.tree.leaves(new_state.lslr)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_lslr_updates_when_learnable():
+    init, apply = make_model(CFG)
+    state = init_train_state(CFG, init, jax.random.PRNGKey(0))
+    train_step = jax.jit(functools.partial(make_train_step(CFG, apply),
+                                           second_order=True, use_msl=False))
+    batch = _synthetic_batch(jax.random.PRNGKey(2), CFG, 4)
+    new_state, _ = train_step(state, batch, jnp.float32(0))
+    diffs = [float(jnp.abs(a - b).max())
+             for a, b in zip(jax.tree.leaves(state.lslr),
+                             jax.tree.leaves(new_state.lslr))]
+    assert max(diffs) > 0
+
+
+def test_bnwb_flags_freeze_gamma_beta():
+    """learnable_bn_gamma/beta=False must leave γ/β at their 1/0 init
+    (reference: requires_grad flags on MetaBatchNormLayer weight/bias)."""
+    cfg = CFG.replace(learnable_bn_gamma=False, learnable_bn_beta=False)
+    init, apply = make_model(cfg)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    train_step = jax.jit(functools.partial(make_train_step(cfg, apply),
+                                           second_order=True, use_msl=False))
+    batch = _synthetic_batch(jax.random.PRNGKey(5), cfg, 4)
+    new_state, _ = train_step(state, batch, jnp.float32(0))
+    for name in new_state.params:
+        if "norm" in name:
+            np.testing.assert_array_equal(
+                np.asarray(new_state.params[name]["gamma"]),
+                np.asarray(state.params[name]["gamma"]))
+            np.testing.assert_array_equal(
+                np.asarray(new_state.params[name]["beta"]),
+                np.asarray(state.params[name]["beta"]))
+    # Conv weights still train.
+    assert float(jnp.abs(new_state.params["conv0"]["w"]
+                         - state.params["conv0"]["w"]).max()) > 0
+
+
+def test_eval_steps_exceed_train_steps():
+    cfg = CFG.replace(number_of_evaluation_steps_per_iter=4)
+    init, apply = make_model(cfg)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    res = jax.jit(make_eval_step(cfg, apply))(
+        state, _synthetic_batch(jax.random.PRNGKey(6), cfg, 4))
+    assert np.isfinite(np.asarray(res.loss)).all()
+    assert state.lslr["conv0"]["w"].shape == (4,)
+
+
+def test_cosine_schedule_endpoints():
+    sched = meta_lr_schedule(CFG)
+    assert abs(float(sched(0)) - CFG.meta_learning_rate) < 1e-9
+    last = float(sched(CFG.total_epochs * CFG.total_iter_per_epoch))
+    assert abs(last - CFG.min_learning_rate) < 1e-6
+    # Epoch-granular: constant within an epoch.
+    assert float(sched(0)) == float(sched(CFG.total_iter_per_epoch - 1))
+    assert float(sched(0)) > float(sched(CFG.total_iter_per_epoch))
+
+
+def test_grad_clamp_applied():
+    """A huge clamp is a no-op; a tight clamp changes the update (the
+    reference clamps per-parameter grads to ±10 for *ImageNet runs)."""
+    batch = _synthetic_batch(jax.random.PRNGKey(3), CFG, 4)
+
+    def run(clamp):
+        cfg = CFG.replace(clamp_meta_grad_value=clamp)
+        init, apply = make_model(cfg)
+        state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+        train_step = jax.jit(functools.partial(
+            make_train_step(cfg, apply), second_order=True, use_msl=False))
+        new_state, _ = train_step(state, batch, jnp.float32(0))
+        return new_state.params
+
+    p_none, p_huge, p_tight = run(None), run(1e6), run(1e-5)
+    for a, b in zip(jax.tree.leaves(p_none), jax.tree.leaves(p_huge)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    diffs = [float(jnp.abs(a - b).max())
+             for a, b in zip(jax.tree.leaves(p_none),
+                             jax.tree.leaves(p_tight))]
+    assert max(diffs) > 0
