@@ -3,8 +3,8 @@ switch — as a ``lax.scan`` over inner steps with optional rematerialization.
 
 Reference behavior being reproduced (not translated):
   * ``inner_loop_optimizers.py § LSLRGradientDescentLearningRule`` — one
-    learnable ``(K+1,)`` learning-rate vector per named parameter, update
-    ``w ← w − lr[name][step] · g``.
+    learnable per-step learning-rate vector per named parameter (sized
+    ``cfg.lslr_num_steps``), update ``w ← w − lr[name][step] · g``.
   * ``few_shot_learning_system.py § forward/apply_inner_loop_update`` — per
     task: K steps of (support forward → grad wrt fast weights, second-order
     iff ``create_graph`` → LSLR update), target-set loss either per-step

@@ -17,8 +17,7 @@ Reference behavior reproduced (``few_shot_learning_system.py``):
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +37,7 @@ State = Dict[str, Any]
 class MetaTrainState:
     """Replicated training state (a pure pytree; checkpoint-serializable)."""
     params: Params          # full network params (slow + fast canonical)
-    lslr: Params            # per-leaf (K+1,) inner LRs
+    lslr: Params            # per-leaf per-step inner LRs (cfg.lslr_num_steps,)
     bn_state: State         # per-step running stats (tracked, not used to
                             # normalize — see layers.batch_norm_apply)
     opt_state: Any
@@ -135,8 +134,12 @@ def make_train_step(cfg: MAMLConfig, apply_fn) -> Callable[..., Any]:
                     if not cfg.learnable_bn_beta and "beta" in sub:
                         sub["beta"] = jnp.zeros_like(sub["beta"])
         if cfg.clamp_meta_grad_value is not None:
+            # Reference clamps only the classifier's parameter grads, not
+            # the LSLR learning-rate grads (§ meta_update iterates
+            # classifier named_parameters).
             c = cfg.clamp_meta_grad_value
-            grads = jax.tree.map(lambda g: jnp.clip(g, -c, c), grads)
+            grads["params"] = jax.tree.map(lambda g: jnp.clip(g, -c, c),
+                                           grads["params"])
 
         updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                                   trainable)

@@ -1,0 +1,115 @@
+"""ResNet-12 few-shot backbone (pure init/apply, per-step norm state).
+
+The reference ships only the 4-conv ``VGGReLUNormNetwork``
+(``meta_neural_network_architectures.py``); ResNet-12 is the stronger
+backbone the tiered-imagenet pod-scale config (BASELINE.json config #5)
+calls for. Architecture follows the few-shot standard (TADAM / MetaOptNet):
+four residual blocks of 3×(3x3 conv → per-step BN → LeakyReLU(0.1)) with a
+1x1-conv+BN projection skip, 2x2 max-pool after each block, global average
+pool, linear head. Widths ``f·(1, 2.5, 5, 10)`` with ``f =
+cfg.cnn_num_filters`` (64 → the canonical 64/160/320/640).
+
+Parameter naming stays flat at the top level (``block{i}_conv{j}``,
+``block{i}_norm{j}``, ``block{i}_skip_conv``, ...) so the fast/slow
+partition rule in ``meta.inner.split_fast_slow`` ("norm" in name ⇒ slow)
+applies unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.models import layers
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+_WIDTH_MULTS = (1.0, 2.5, 5.0, 10.0)
+_CONVS_PER_BLOCK = 3
+
+
+def _block_widths(cfg: MAMLConfig) -> Tuple[int, ...]:
+    return tuple(int(cfg.cnn_num_filters * m) for m in _WIDTH_MULTS)
+
+
+def _norm_kwargs(cfg: MAMLConfig) -> Dict[str, float]:
+    return dict(momentum=cfg.batch_norm_momentum, eps=cfg.batch_norm_eps)
+
+
+def _apply_block(cfg: MAMLConfig, params: Params, state: State,
+                 x: jax.Array, block: int, step: jax.Array,
+                 training: bool) -> Tuple[jax.Array, State]:
+    compute_dtype = jnp.dtype(cfg.compute_dtype)
+    new_state: State = {}
+    residual = x
+    for j in range(_CONVS_PER_BLOCK):
+        name = f"block{block}_conv{j}"
+        x = layers.conv2d_apply(params[name], x, compute_dtype=compute_dtype)
+        nname = f"block{block}_norm{j}"
+        x, new_state[nname] = layers.batch_norm_apply(
+            params[nname], state[nname], x, step, training=training,
+            **_norm_kwargs(cfg))
+        if j < _CONVS_PER_BLOCK - 1:
+            x = jax.nn.leaky_relu(x, 0.1)
+    sname = f"block{block}_skip_conv"
+    residual = layers.conv2d_apply(params[sname], residual,
+                                   compute_dtype=compute_dtype)
+    snname = f"block{block}_skip_norm"
+    residual, new_state[snname] = layers.batch_norm_apply(
+        params[snname], state[snname], residual, step, training=training,
+        **_norm_kwargs(cfg))
+    x = jax.nn.leaky_relu(x + residual, 0.1)
+    x = layers.max_pool2d(x)
+    return x, new_state
+
+
+def make_resnet12(cfg: MAMLConfig):
+    """Build (init, apply) for ResNet-12 described by ``cfg``."""
+    if cfg.norm_layer != "batch_norm":
+        raise ValueError("resnet12 backbone supports norm_layer='batch_norm'")
+    h, w, c = cfg.image_shape
+    widths = _block_widths(cfg)
+    num_steps = cfg.bn_num_steps
+
+    def init(key: jax.Array) -> Tuple[Params, State]:
+        params: Params = {}
+        state: State = {}
+        n_keys = 4 * (_CONVS_PER_BLOCK + 1) + 1
+        keys = iter(jax.random.split(key, n_keys))
+        in_ch = c
+        for b, width in enumerate(widths):
+            ch = in_ch
+            for j in range(_CONVS_PER_BLOCK):
+                params[f"block{b}_conv{j}"] = layers.conv2d_init(
+                    next(keys), ch, width)
+                params[f"block{b}_norm{j}"], state[f"block{b}_norm{j}"] = (
+                    layers.batch_norm_init(width, num_steps))
+                ch = width
+            params[f"block{b}_skip_conv"] = layers.conv2d_init(
+                next(keys), in_ch, width, kernel_size=1)
+            (params[f"block{b}_skip_norm"],
+             state[f"block{b}_skip_norm"]) = layers.batch_norm_init(
+                width, num_steps)
+            in_ch = width
+        params["linear"] = layers.linear_init(
+            next(keys), widths[-1], cfg.num_classes_per_set)
+        return params, state
+
+    def apply(params: Params, state: State, x: jax.Array, step: jax.Array,
+              training: bool) -> Tuple[jax.Array, State]:
+        new_state: State = {}
+        for b in range(len(widths)):
+            x, block_state = _apply_block(cfg, params, state, x, b, step,
+                                          training)
+            new_state.update(block_state)
+        feats = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits = layers.linear_apply(
+            params["linear"], feats,
+            compute_dtype=jnp.dtype(cfg.compute_dtype))
+        return logits.astype(jnp.float32), new_state
+
+    return init, apply

@@ -19,7 +19,6 @@ same purpose).
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Dict, Tuple
 
 import jax
@@ -110,10 +109,6 @@ def make_model(cfg: MAMLConfig) -> Tuple[InitFn, ApplyFn]:
     if cfg.backbone == "vgg":
         return make_vgg(cfg)
     if cfg.backbone == "resnet12":
-        try:
-            from howtotrainyourmamlpytorch_tpu.models import resnet12
-        except ImportError as e:
-            raise NotImplementedError(
-                "resnet12 backbone is not available in this build") from e
+        from howtotrainyourmamlpytorch_tpu.models import resnet12
         return resnet12.make_resnet12(cfg)
     raise ValueError(f"unknown backbone {cfg.backbone!r}")

@@ -1,0 +1,13 @@
+from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+    MeshPlan,
+    batch_sharding,
+    make_mesh,
+    make_sharded_steps,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = [
+    "MeshPlan", "batch_sharding", "make_mesh", "make_sharded_steps",
+    "replicated_sharding", "shard_batch",
+]

@@ -1,0 +1,115 @@
+"""Device-mesh sharding: the distributed backend of the framework.
+
+Reference equivalent: ``nn.DataParallel`` + NCCL inside PyTorch
+(``few_shot_learning_system.py`` wraps the classifier when
+``num_of_gpus > 1`` — single-node replicate/scatter/gather, with tasks still
+processed *sequentially* in a Python loop). Here distribution is first-class
+and actually parallel:
+
+  * Mesh axes ``('dcn', 'tasks')`` — ``tasks`` spans chips within a slice
+    (ICI), ``dcn`` spans hosts/pods for the 256-task pod-scale configs.
+  * The meta-batch of episodes is sharded over both axes' product; model
+    parameters, LSLR LRs, BN state and optimizer state are replicated.
+  * Inner-loop adaptation is entirely local to a chip (tasks are
+    embarrassingly parallel — zero communication for K inner steps).
+  * The only collective per outer step is the mean over tasks inside the
+    loss/aux (XLA lowers it to one ``psum`` riding ICI, then DCN), exactly
+    the all-reduce a DDP-style design would issue — but derived by the SPMD
+    partitioner from sharding annotations rather than hand-written.
+
+TP/PP/EP/sequence-parallel axes are deliberately absent: the reference's
+workload (4-conv CNN on 28-84px episodic batches, no sequence dimension) has
+nothing to shard along those axes — SURVEY.md §2.2 documents the N/A. The
+scaling axes that exist are tasks (sharded here) and inner-loop depth
+(lax.scan + remat in meta/inner.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+from howtotrainyourmamlpytorch_tpu.meta.outer import (
+    make_eval_step, make_train_step)
+
+
+def make_mesh(cfg: MAMLConfig,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the (dcn, tasks) mesh. ``mesh_shape`` must multiply to the
+    device count in use; ``(1, 1)`` (the default) works single-chip."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = int(np.prod(cfg.mesh_shape))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh_shape {cfg.mesh_shape} needs {n} devices, "
+            f"got {len(devices)}")
+    dev_array = np.asarray(devices).reshape(cfg.mesh_shape)
+    return Mesh(dev_array, cfg.mesh_axis_names)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Episodes sharded over every mesh axis (task axis 0 of each leaf)."""
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(batch: Episode, mesh: Mesh) -> Episode:
+    """Place a host batch on the mesh, task-sharded (the host→device
+    boundary; reference equivalent: ``.to(device)`` in run_train_iter)."""
+    return jax.device_put(batch, batch_sharding(mesh))
+
+
+class MeshPlan(NamedTuple):
+    """Compiled, sharded step functions for one (cfg, mesh) pair.
+
+    ``train_steps`` maps the two static MAML++ phase flags
+    ``(second_order, use_msl)`` to a compiled executable; the experiment
+    loop indexes it with ``(cfg.use_second_order(epoch),
+    cfg.use_msl(epoch))`` so the DA and MSL epoch boundaries swap
+    executables without recompiling anything else.
+    """
+    mesh: Mesh
+    train_steps: Dict[Tuple[bool, bool], Callable]
+    eval_step: Callable
+
+
+def make_sharded_steps(cfg: MAMLConfig, apply_fn,
+                       mesh: Mesh) -> MeshPlan:
+    """jit the train/eval steps with explicit shardings: state replicated,
+    episode batch task-sharded, outputs replicated. The task-mean in the
+    loss becomes the per-step psum over (tasks, dcn)."""
+    if cfg.batch_size % mesh.size != 0:
+        raise ValueError(
+            f"batch_size {cfg.batch_size} not divisible by mesh size "
+            f"{mesh.size}")
+    repl = replicated_sharding(mesh)
+    bsh = batch_sharding(mesh)
+
+    train_step = make_train_step(cfg, apply_fn)
+    train_steps = {}
+    for so in (False, True):
+        for msl in (False, True):
+            train_steps[(so, msl)] = jax.jit(
+                functools.partial(train_step, second_order=so, use_msl=msl),
+                in_shardings=(repl, bsh, None),
+                out_shardings=(repl, repl),
+                donate_argnums=(0,),
+            )
+
+    eval_step = jax.jit(
+        make_eval_step(cfg, apply_fn),
+        in_shardings=(repl, bsh),
+        # Per-task outputs come back task-sharded; the experiment loop
+        # gathers them host-side for the ensemble protocol.
+        out_shardings=bsh,
+    )
+    return MeshPlan(mesh=mesh, train_steps=train_steps, eval_step=eval_step)

@@ -1,0 +1,70 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta import (
+    Episode, init_train_state, make_train_step, split_fast_slow)
+from howtotrainyourmamlpytorch_tpu.models import make_model
+
+CFG = MAMLConfig(backbone="resnet12", image_height=32, image_width=32,
+                 image_channels=3, num_classes_per_set=4,
+                 num_samples_per_class=1, num_target_samples=1,
+                 cnn_num_filters=8, batch_size=2,
+                 number_of_training_steps_per_iter=2,
+                 number_of_evaluation_steps_per_iter=2,
+                 compute_dtype="float32")
+
+
+def test_resnet12_shapes():
+    init, apply = make_model(CFG)
+    params, state = init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 32, 32, 3))
+    logits, new_state = apply(params, state, x, jnp.int32(0), True)
+    assert logits.shape == (3, 4)
+    # Widths f*(1, 2.5, 5, 10) with f=8.
+    assert params["block0_conv0"]["w"].shape == (3, 3, 3, 8)
+    assert params["block3_conv2"]["w"].shape == (3, 3, 80, 80)
+    assert params["block1_skip_conv"]["w"].shape == (1, 1, 8, 20)
+    assert params["linear"]["w"].shape == (80, 4)
+    # All norm states updated at step row 0 only.
+    for name, sub in new_state.items():
+        changed = np.asarray(sub["mean"]) != 0
+        assert changed[0].any() and not changed[1:].any(), name
+
+
+def test_resnet12_norms_are_slow():
+    init, _ = make_model(CFG)
+    params, _ = init(jax.random.PRNGKey(0))
+    fast, slow = split_fast_slow(CFG, params)
+    assert "block0_norm0" in slow and "block0_skip_norm" in slow
+    assert "block0_conv0" in fast and "block0_skip_conv" in fast
+    assert "linear" in fast
+
+
+def test_resnet12_meta_trains():
+    init, apply = make_model(CFG)
+    state = init_train_state(CFG, init, jax.random.PRNGKey(0))
+    step = jax.jit(functools.partial(make_train_step(CFG, apply),
+                                     second_order=True, use_msl=True))
+    n, h, w, c = 4, 32, 32, 3
+    key = jax.random.PRNGKey(2)
+    protos = jax.random.normal(key, (2, n, h, w, c))
+    x = (protos + jax.random.normal(jax.random.PRNGKey(3),
+                                    (2, n, h, w, c)) * 0.3)
+    y = jnp.tile(jnp.arange(n)[None], (2, 1)).astype(jnp.int32)
+    batch = Episode(x, y, x, y)
+    losses = []
+    for i in range(6):
+        state, m = step(state, batch, jnp.float32(0))
+        losses.append(float(m.loss))
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_resnet12_rejects_layer_norm():
+    with pytest.raises(ValueError, match="batch_norm"):
+        make_model(CFG.replace(norm_layer="layer_norm"))

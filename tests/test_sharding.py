@@ -1,0 +1,118 @@
+"""Mesh sharding tests on the virtual 8-device CPU platform: sharded
+training must match single-device numerics, both mesh factorizations must
+work, and the driver dry-run must pass."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta import Episode, init_train_state
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    make_mesh, make_sharded_steps, shard_batch)
+
+CFG = MAMLConfig(
+    image_height=10, image_width=10, image_channels=1,
+    num_classes_per_set=3, num_samples_per_class=2, num_target_samples=2,
+    cnn_num_filters=8, num_stages=2, batch_size=8,
+    number_of_training_steps_per_iter=2,
+    number_of_evaluation_steps_per_iter=2,
+    compute_dtype="float32", meta_learning_rate=0.01)
+
+
+def _batch(key, cfg):
+    n, k, t, b = (cfg.num_classes_per_set, cfg.num_samples_per_class,
+                  cfg.num_target_samples, cfg.batch_size)
+    h, w, c = cfg.image_shape
+    ks = jax.random.split(key, 3)
+    protos = jax.random.normal(ks[0], (b, n, h, w, c))
+
+    def mk(key, per):
+        noise = jax.random.normal(key, (b, n, per, h, w, c)) * 0.4
+        x = (protos[:, :, None] + noise).reshape(b, n * per, h, w, c)
+        y = jnp.tile(jnp.repeat(jnp.arange(n), per)[None], (b, 1))
+        return x, y.astype(jnp.int32)
+
+    sx, sy = mk(ks[1], k)
+    tx, ty = mk(ks[2], t)
+    return Episode(sx, sy, tx, ty)
+
+
+def _run_steps(cfg, mesh_shape, devices, n_iters=3):
+    cfg = cfg.replace(mesh_shape=mesh_shape)
+    init, apply = make_model(cfg)
+    mesh = make_mesh(cfg, devices)
+    plan = make_sharded_steps(cfg, apply, mesh)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    state = jax.device_put(
+        state, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    losses = []
+    for i in range(n_iters):
+        batch = shard_batch(_batch(jax.random.PRNGKey(10 + i), cfg), mesh)
+        state, m = plan.train_steps[(True, True)](state, batch,
+                                                 jnp.float32(0))
+        losses.append(float(m.loss))
+    return state, losses
+
+
+def test_sharded_matches_single_device():
+    """8-way task sharding must reproduce single-device numerics: the psum
+    over the tasks axis is exactly the unsharded mean."""
+    state1, losses1 = _run_steps(CFG, (1, 1), jax.devices()[:1])
+    state8, losses8 = _run_steps(CFG, (1, 8), jax.devices())
+    np.testing.assert_allclose(losses1, losses8, rtol=2e-4)
+    for name, sub in state1.params.items():
+        for leaf, a in sub.items():
+            if name.startswith("conv") and leaf == "b":
+                # Conv biases are BN-shadowed: their true gradient is zero
+                # (batch norm subtracts the mean), so Adam amplifies pure
+                # reduction-order noise into a random walk — excluded.
+                continue
+            b = state8.params[name][leaf]
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-5,
+                                       err_msg=f"{name}.{leaf}")
+
+
+def test_dcn_by_tasks_mesh():
+    """(dcn=2, tasks=4) factorization: collectives ride both axes."""
+    _, losses24 = _run_steps(CFG, (2, 4), jax.devices())
+    _, losses18 = _run_steps(CFG, (1, 8), jax.devices())
+    np.testing.assert_allclose(losses24, losses18, rtol=2e-4)
+
+
+def test_eval_step_sharded_outputs():
+    cfg = CFG.replace(mesh_shape=(1, 8))
+    init, apply = make_model(cfg)
+    mesh = make_mesh(cfg, jax.devices())
+    plan = make_sharded_steps(cfg, apply, mesh)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    state = jax.device_put(
+        state, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    res = plan.eval_step(state, shard_batch(_batch(jax.random.PRNGKey(0),
+                                                   cfg), mesh))
+    assert np.asarray(res.loss).shape == (8,)
+    assert np.asarray(res.target_logits).shape == (8, 6, 3)
+
+
+def test_mesh_validation_errors():
+    with pytest.raises(ValueError, match="devices"):
+        make_mesh(CFG.replace(mesh_shape=(1, 3)), jax.devices())
+    cfg = CFG.replace(mesh_shape=(1, 8), batch_size=6)
+    init, apply = make_model(cfg)
+    with pytest.raises(ValueError, match="divisible"):
+        make_sharded_steps(cfg, apply, make_mesh(cfg, jax.devices()))
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+
+
+def test_graft_entry_compiles():
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (25, 5)
