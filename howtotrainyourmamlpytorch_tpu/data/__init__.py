@@ -1,0 +1,13 @@
+from howtotrainyourmamlpytorch_tpu.data.sources import (
+    ArraySource,
+    DiskImageSource,
+    SyntheticSource,
+    build_source,
+)
+from howtotrainyourmamlpytorch_tpu.data.sampler import EpisodeSampler
+from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
+
+__all__ = [
+    "ArraySource", "DiskImageSource", "SyntheticSource", "build_source",
+    "EpisodeSampler", "MetaLearningDataLoader",
+]

@@ -1,0 +1,128 @@
+"""Host-side batch pipeline with background prefetch to device.
+
+Reference: ``data.py § MetaLearningSystemDataLoader`` — a torch DataLoader
+with ``num_dataset_workers`` processes and ``batch_size = meta-batch``.
+Here the sampler is cheap host numpy (no JPEG decode in the loop for the
+packaged episodic datasets), so a thread pool + a small prefetch queue
+suffices and avoids process-fork overhead; batches are placed on the mesh
+(task-sharded) while the previous step computes — the host→device overlap
+the reference gets from CUDA streams.
+
+Episode-index contract (resume correctness, reference
+``continue_from_iter``): train batch ``i`` uses episode indices
+``[i·B, (i+1)·B)`` of a stream seeded by ``train_seed`` — resuming at
+iteration ``i`` reproduces exactly the batches an uninterrupted run would
+have seen. Val/test use fixed ``val_seed`` streams with indices
+``[0, num_evaluation_tasks)``, so evaluation episodes are identical every
+epoch and across runs.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.data.sampler import EpisodeSampler
+from howtotrainyourmamlpytorch_tpu.data.sources import build_source
+from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+
+_STOP = object()
+
+
+class MetaLearningDataLoader:
+    """Builds per-split samplers and yields (optionally device-placed)
+    meta-batches."""
+
+    def __init__(self, cfg: MAMLConfig, mesh=None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self._samplers = {}
+
+    def sampler(self, split: str) -> EpisodeSampler:
+        if split not in self._samplers:
+            cfg = self.cfg
+            seed = {"train": cfg.train_seed,
+                    "val": cfg.val_seed,
+                    # Offset test from val so the two fixed eval streams
+                    # differ even when val_seed == test-time seed flag.
+                    "test": cfg.val_seed + 104729}[split]
+            self._samplers[split] = EpisodeSampler(
+                build_source(cfg, split), cfg, seed,
+                # Reference augments classes for training only.
+                augment_classes=cfg.augment_images and split == "train")
+        return self._samplers[split]
+
+    # -- iteration --------------------------------------------------------
+    def _place(self, batch: Episode) -> Episode:
+        if self.mesh is None:
+            return batch
+        from howtotrainyourmamlpytorch_tpu.parallel.mesh import shard_batch
+        return shard_batch(batch, self.mesh)
+
+    def _batches(self, split: str, start_idx: int,
+                 num_batches: int, batch_size: int) -> Iterator[Episode]:
+        sampler = self.sampler(split)
+        prefetch = max(1, self.cfg.prefetch_batches)
+        q: "queue.Queue" = queue.Queue(maxsize=prefetch)
+        abandoned = threading.Event()
+
+        def worker():
+            try:
+                for b in range(num_batches):
+                    if abandoned.is_set():
+                        return
+                    base = (start_idx + b) * batch_size
+                    batch = sampler.sample_batch(
+                        range(base, base + batch_size))
+                    # Bounded put so an abandoned consumer can't strand us
+                    # on a full queue.
+                    while not abandoned.is_set():
+                        try:
+                            q.put(batch, timeout=0.1)
+                            break
+                        except queue.Full:
+                            pass
+            except Exception as e:  # surface in consumer, don't hang
+                q.put(e)
+            q.put(_STOP)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _STOP:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                yield self._place(item)
+        finally:
+            # Consumer abandoned (error or early break): stop the worker
+            # instead of letting it produce the rest of the epoch.
+            abandoned.set()
+            t.join(timeout=5)
+
+    def get_train_batches(self, start_iter: int,
+                          num_iters: int) -> Iterator[Episode]:
+        """Batches for train iterations [start_iter, start_iter+num_iters)."""
+        return self._batches("train", start_iter, num_iters,
+                             self.cfg.batch_size)
+
+    def _eval_batches(self, split: str) -> Iterator[Episode]:
+        cfg = self.cfg
+        b = cfg.batch_size
+        # Pad the fixed episode count up to a full final batch; the caller
+        # truncates to num_evaluation_tasks (episodes are deterministic, so
+        # the padding episodes are well-defined, just extra).
+        num_batches = -(-cfg.num_evaluation_tasks // b)
+        return self._batches(split, 0, num_batches, b)
+
+    def get_val_batches(self) -> Iterator[Episode]:
+        return self._eval_batches("val")
+
+    def get_test_batches(self) -> Iterator[Episode]:
+        return self._eval_batches("test")

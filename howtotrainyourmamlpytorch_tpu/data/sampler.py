@@ -1,0 +1,99 @@
+"""Deterministic episodic sampler.
+
+Reference: ``data.py § FewShotLearningDatasetParallel.__getitem__`` — each
+episode index seeds its own RNG (``np.random.RandomState(seed + idx)``),
+samples N classes from the split's pool, K support + T target images per
+class, relabels classes to 0..N-1. Fixed val/test seeds ⇒ identical
+evaluation episodes every epoch and across runs; the train seed stream is a
+pure function of the episode index ⇒ exact resume alignment with no
+worker-offset bookkeeping (SURVEY.md §7 hard-part #3: counter-based keys
+derived from (split_seed, idx) instead of RNG-state-in-worker).
+
+Omniglot class augmentation (``augment_images``): each physical class
+appears as four virtual classes, one per 90° rotation (reference rotates at
+load; rotation identity is part of the *class*, not a random transform).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+
+_ROTATIONS = 4
+
+
+class EpisodeSampler:
+    """Maps an episode index deterministically to an Episode (numpy)."""
+
+    def __init__(self, source, cfg: MAMLConfig, split_seed: int,
+                 augment_classes: Optional[bool] = None):
+        self.source = source
+        self.cfg = cfg
+        self.split_seed = int(split_seed)
+        self.augment = (cfg.augment_images if augment_classes is None
+                        else augment_classes)
+        base = list(source.class_names)
+        if self.augment:
+            # Virtual class = (physical class, rotation quarter-turns).
+            self.classes = [(name, rot) for name in base
+                            for rot in range(_ROTATIONS)]
+        else:
+            self.classes = [(name, 0) for name in base]
+        n = cfg.num_classes_per_set
+        if len(self.classes) < n:
+            raise ValueError(
+                f"split has {len(self.classes)} (virtual) classes, "
+                f"need {n} for {n}-way sampling")
+
+    # -- normalization ---------------------------------------------------
+    def _normalize(self, x: np.ndarray) -> np.ndarray:
+        """Per-dataset affine normalization on [0,1] inputs.
+
+        Assumption (reference mount empty — re-verify if it appears):
+        Omniglot-style grayscale stays in [0, 1]; RGB datasets are
+        standardized to zero-mean/unit-ish range via 2x-0.5 scaling.
+        """
+        if self.cfg.image_channels == 1:
+            return x
+        x = 2.0 * x - 1.0
+        if self.cfg.reverse_channels:
+            x = x[..., ::-1]
+        return x
+
+    # -- episode sampling ------------------------------------------------
+    def sample(self, idx: int) -> Episode:
+        cfg = self.cfg
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.split_seed, int(idx)]))
+        n, k, t = (cfg.num_classes_per_set, cfg.num_samples_per_class,
+                   cfg.num_target_samples)
+        h, w, c = cfg.image_shape
+
+        chosen = rng.choice(len(self.classes), size=n, replace=False)
+        sx = np.empty((n, k, h, w, c), np.float32)
+        tx = np.empty((n, t, h, w, c), np.float32)
+        for slot, class_id in enumerate(chosen):
+            name, rot = self.classes[class_id]
+            avail = self.source.num_images(name)
+            need = k + t
+            picks = rng.choice(avail, size=need, replace=avail < need)
+            imgs = self.source.get_images(name, picks)
+            if rot:
+                imgs = np.rot90(imgs, rot, axes=(1, 2)).copy()
+            sx[slot] = imgs[:k]
+            tx[slot] = imgs[k:]
+
+        sx = self._normalize(sx.reshape(n * k, h, w, c))
+        tx = self._normalize(tx.reshape(n * t, h, w, c))
+        sy = np.repeat(np.arange(n, dtype=np.int32), k)
+        ty = np.repeat(np.arange(n, dtype=np.int32), t)
+        return Episode(sx, sy, tx, ty)
+
+    def sample_batch(self, indices) -> Episode:
+        """Stack episodes on a leading task axis: the meta-batch."""
+        eps = [self.sample(i) for i in indices]
+        return Episode(*(np.stack(field) for field in zip(*eps)))

@@ -1,0 +1,159 @@
+"""Image sources: where episode images come from.
+
+Reference: ``data.py § FewShotLearningDatasetParallel.load_dataset`` builds a
+class→image-path index from ``datasets/<name>/{train,val,test}/<class>/…``
+(disjoint class splits per directory). We keep that on-disk contract
+(:class:`DiskImageSource`) and add an in-memory :class:`ArraySource` (the
+TPU-friendly path: the episodic datasets are small — Omniglot ~14MB,
+Mini-ImageNet ~5GB resized — and host RAM beats per-episode JPEG decode) and
+a deterministic :class:`SyntheticSource` for tests/benchmarks.
+
+Normalization note: images are returned float32 in [0, 1]; per-dataset
+affine normalization is applied by the sampler. The reference mount was
+empty at survey time (SURVEY.md § Provenance) so the exact reference
+normalization constants could not be read — the sampler's scheme is
+documented where it is defined and must be re-checked if the mount appears.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+SPLITS = ("train", "val", "test")
+
+
+class ArraySource:
+    """Class-indexed images held in host memory as uint8 NHWC arrays."""
+
+    def __init__(self, classes: Dict[str, np.ndarray]):
+        if not classes:
+            raise ValueError("ArraySource needs at least one class")
+        for name, arr in classes.items():
+            if arr.ndim != 4 or arr.dtype != np.uint8:
+                raise ValueError(
+                    f"class {name!r}: expected uint8 (n,H,W,C), got "
+                    f"{arr.dtype} {arr.shape}")
+        self._classes = classes
+
+    @property
+    def class_names(self) -> List[str]:
+        return sorted(self._classes)
+
+    def num_images(self, class_name: str) -> int:
+        return len(self._classes[class_name])
+
+    def get_images(self, class_name: str,
+                   indices: np.ndarray) -> np.ndarray:
+        """(len(indices), H, W, C) float32 in [0, 1]."""
+        return (self._classes[class_name][indices].astype(np.float32)
+                / 255.0)
+
+
+class DiskImageSource:
+    """Lazy class→file-path index over the reference's directory layout.
+
+    ``root/<class>/<image files>``; images are decoded with PIL and resized
+    to ``image_size`` on access. Decoded classes are memoized (the episodic
+    benchmarks revisit classes constantly and fit in RAM).
+    """
+
+    IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+
+    def __init__(self, root: str, image_size: Tuple[int, int, int]):
+        self.root = root
+        self.image_size = image_size
+        self._index: Dict[str, List[str]] = {}
+        self._cache: Dict[str, np.ndarray] = {}
+        for cls in sorted(os.listdir(root)):
+            cdir = os.path.join(root, cls)
+            if not os.path.isdir(cdir):
+                continue
+            files = sorted(
+                os.path.join(cdir, f) for f in os.listdir(cdir)
+                if f.lower().endswith(self.IMAGE_EXTS))
+            if files:
+                self._index[cls] = files
+        if not self._index:
+            raise ValueError(f"no image classes found under {root}")
+
+    @property
+    def class_names(self) -> List[str]:
+        return sorted(self._index)
+
+    def num_images(self, class_name: str) -> int:
+        return len(self._index[class_name])
+
+    def _load_class(self, class_name: str) -> np.ndarray:
+        if class_name not in self._cache:
+            from PIL import Image
+            h, w, c = self.image_size
+            imgs = []
+            for path in self._index[class_name]:
+                im = Image.open(path)
+                im = im.convert("L" if c == 1 else "RGB")
+                if im.size != (w, h):
+                    im = im.resize((w, h), Image.LANCZOS)
+                arr = np.asarray(im, np.uint8)
+                if c == 1:
+                    arr = arr[..., None]
+                imgs.append(arr)
+            self._cache[class_name] = np.stack(imgs)
+        return self._cache[class_name]
+
+    def get_images(self, class_name: str,
+                   indices: np.ndarray) -> np.ndarray:
+        return (self._load_class(class_name)[indices].astype(np.float32)
+                / 255.0)
+
+
+class SyntheticSource(ArraySource):
+    """Deterministic procedurally-generated classes (tests / benchmarks).
+
+    Each class is a fixed random prototype plus per-image noise, generated
+    from ``seed`` — distinct (split, seed) pairs give disjoint statistics.
+    """
+
+    def __init__(self, num_classes: int, images_per_class: int,
+                 image_size: Tuple[int, int, int], seed: int = 0):
+        h, w, c = image_size
+        rng = np.random.default_rng(seed)
+        classes = {}
+        for i in range(num_classes):
+            proto = rng.uniform(0, 255, (1, h, w, c))
+            noise = rng.normal(0, 40, (images_per_class, h, w, c))
+            classes[f"class_{i:05d}"] = np.clip(
+                proto + noise, 0, 255).astype(np.uint8)
+        super().__init__(classes)
+
+
+_SPLIT_SEEDS = {"train": 0, "val": 1, "test": 2}
+
+
+def build_source(cfg, split: str):
+    """Resolve a split's image source from the config.
+
+    Disk layout ``<dataset_path>/<split>/<class>/…`` when present (the
+    reference's contract); otherwise a synthetic fallback (with a warning
+    unless the dataset name says 'synthetic') so the framework runs
+    end-to-end with no datasets installed.
+    """
+    if split not in SPLITS:
+        raise ValueError(f"unknown split {split!r}")
+    root = os.path.join(cfg.dataset_path, split)
+    if os.path.isdir(root):
+        return DiskImageSource(root, cfg.image_shape)
+    if "synthetic" not in cfg.dataset_name:
+        warnings.warn(
+            f"dataset split directory {root!r} not found; using a "
+            f"synthetic source", stacklevel=2)
+    # Enough classes for 20-way sampling and disjoint per split.
+    return SyntheticSource(
+        num_classes=max(4 * cfg.num_classes_per_set, 40),
+        images_per_class=max(
+            2 * (cfg.num_samples_per_class + cfg.num_target_samples), 20),
+        image_size=cfg.image_shape,
+        seed=1000 * _SPLIT_SEEDS[split] + cfg.seed)

@@ -1,0 +1,236 @@
+"""Experiment orchestration: train loop, validation sweeps, checkpointing,
+and the top-5-ensemble test protocol.
+
+Reference: ``experiment_builder.py § ExperimentBuilder`` — main loop
+``while current_iter < total_epochs * total_iter_per_epoch``; per epoch:
+``total_iter_per_epoch`` train iterations → full validation sweep → CSV
+stats row → save latest + epoch checkpoint (keep top-5 by val accuracy) →
+after training, load the top-5 checkpoints, run each over the fixed test
+episodes, ensemble their per-sample predictions, write ``test_summary.csv``.
+
+TPU-first notes:
+  * Phase flags (derivative-order annealing, MSL window) select one of the
+    pre-jitted executables per epoch — no retracing inside an epoch.
+  * Per-iteration metrics are accumulated as device arrays and fetched once
+    per epoch, so the host never blocks the async dispatch queue.
+  * Throughput (meta-tasks/sec/chip) is measured per epoch and logged in
+    the stats CSV — the driver metric (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
+from howtotrainyourmamlpytorch_tpu.meta.outer import (
+    MetaTrainState, init_train_state)
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+    make_mesh, make_sharded_steps, replicated_sharding)
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+    LATEST, CheckpointManager)
+from howtotrainyourmamlpytorch_tpu.utils.storage import (
+    build_experiment_folder, save_statistics, save_to_json)
+
+
+class ExperimentBuilder:
+    """Builds and runs one experiment described by a :class:`MAMLConfig`."""
+
+    def __init__(self, cfg: MAMLConfig,
+                 devices: Optional[List[jax.Device]] = None):
+        self.paths = build_experiment_folder(cfg.experiment_root,
+                                             cfg.experiment_name)
+
+        devices = list(devices if devices is not None else jax.devices())
+        n_mesh = int(np.prod(cfg.mesh_shape))
+        if n_mesh != len(devices):
+            if n_mesh != 1:
+                warnings.warn(
+                    f"mesh_shape {cfg.mesh_shape} needs {n_mesh} devices "
+                    f"but {len(devices)} are visible; falling back to a "
+                    f"single-device mesh")
+            cfg = cfg.replace(mesh_shape=(1, 1))
+            devices = devices[:1]
+        self.cfg = cfg
+        # Recorded config reflects what actually runs (incl. any fallback).
+        save_to_json(f"{self.paths['base']}/config.json", cfg.to_dict())
+
+        self.model_init, self.model_apply = make_model(cfg)
+        self.mesh = make_mesh(cfg, devices)
+        self.plan = make_sharded_steps(cfg, self.model_apply, self.mesh)
+        self.data = MetaLearningDataLoader(cfg, mesh=self.mesh)
+        self.ckpt = CheckpointManager(self.paths["saved_models"],
+                                      max_to_keep=cfg.max_models_to_save)
+
+        self.state = init_train_state(cfg, self.model_init,
+                                      jax.random.PRNGKey(cfg.seed))
+        self.current_iter = 0
+        if cfg.continue_from_epoch != "from_scratch":
+            self._resume(cfg.continue_from_epoch)
+        self.state = jax.device_put(self.state,
+                                    replicated_sharding(self.mesh))
+
+    # ------------------------------------------------------------------
+    def _resume(self, tag) -> None:
+        if tag == LATEST and not self.ckpt.has_checkpoint(LATEST):
+            return  # fresh run with continue_from_epoch='latest' (reference
+                    # default for restartable jobs): nothing to resume yet
+        self.state, meta = self.ckpt.load(self.state, tag)
+        self.current_iter = int(meta["current_iter"])
+        if tag != LATEST:
+            # Rewind: epochs after the resume point are abandoned; their
+            # checkpoints must not feed the top-k ensemble.
+            self.ckpt.rewind_to(int(tag))
+        print(f"resumed from checkpoint {tag!r} at iter "
+              f"{self.current_iter}")
+
+    # ------------------------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self.current_iter // self.cfg.total_iter_per_epoch
+
+    def _train_epoch(self) -> Dict[str, float]:
+        cfg = self.cfg
+        epoch = self.epoch
+        step_fn = self.plan.train_steps[(cfg.use_second_order(epoch),
+                                         cfg.use_msl(epoch))]
+        metrics_acc = []
+        t0 = time.time()
+        for batch in self.data.get_train_batches(self.current_iter,
+                                                 cfg.total_iter_per_epoch):
+            self.state, metrics = step_fn(self.state, batch,
+                                          jnp.float32(epoch))
+            metrics_acc.append(metrics)
+            self.current_iter += 1
+        jax.block_until_ready(self.state.params)
+        dt = time.time() - t0
+        stacked = jax.device_get(
+            jax.tree.map(lambda *xs: np.stack(xs), *metrics_acc))
+        tasks = cfg.total_iter_per_epoch * cfg.batch_size
+        return {
+            "train_loss": float(np.mean(stacked.loss)),
+            "train_accuracy": float(np.mean(stacked.accuracy)),
+            "train_support_loss": float(np.mean(stacked.support_loss)),
+            "meta_lr": float(stacked.learning_rate[-1]),
+            "epoch_seconds": dt,
+            "meta_tasks_per_sec": tasks / dt,
+            "meta_tasks_per_sec_per_chip": tasks / dt / self.mesh.size,
+        }
+
+    def _evaluate(self, batches: Iterable, state: MetaTrainState,
+                  collect_logits: bool = False) -> Dict[str, Any]:
+        """Run eval batches, truncated to exactly num_evaluation_tasks
+        episodes (the loader pads the final batch)."""
+        n_left = self.cfg.num_evaluation_tasks
+        losses, accs, logits = [], [], []
+        for batch in batches:
+            res = self.plan.eval_step(state, batch)
+            res = jax.device_get(res)
+            take = min(n_left, len(res.loss))
+            losses.append(res.loss[:take])
+            accs.append(res.accuracy[:take])
+            if collect_logits:
+                logits.append(res.target_logits[:take])
+            n_left -= take
+        out: Dict[str, Any] = {
+            "loss": float(np.mean(np.concatenate(losses))),
+            "accuracy": float(np.mean(np.concatenate(accs))),
+            "per_task_accuracy": np.concatenate(accs),
+        }
+        if collect_logits:
+            out["logits"] = np.concatenate(logits)  # (E, N*T, N)
+        return out
+
+    # ------------------------------------------------------------------
+    def run_experiment(self) -> Dict[str, Any]:
+        cfg = self.cfg
+        if cfg.evaluate_on_test_set_only:
+            return self.run_test_protocol()
+
+        total_iters = cfg.total_epochs * cfg.total_iter_per_epoch
+        epochs_this_session = 0
+        while (self.current_iter < total_iters
+               and epochs_this_session < cfg.total_epochs_before_pause):
+            epoch = self.epoch
+            train_stats = self._train_epoch()
+            val_stats = self._evaluate(self.data.get_val_batches(),
+                                       self.state)
+            epochs_this_session += 1
+
+            row = {"epoch": epoch, **{k: v for k, v in train_stats.items()},
+                   "val_loss": val_stats["loss"],
+                   "val_accuracy": val_stats["accuracy"]}
+            save_statistics(self.paths["logs"], row)
+            self.ckpt.save(self.state, epoch, self.current_iter,
+                           val_stats["accuracy"])
+            print(f"epoch {epoch}: "
+                  f"train loss {train_stats['train_loss']:.4f} "
+                  f"acc {train_stats['train_accuracy']:.4f} | "
+                  f"val loss {val_stats['loss']:.4f} "
+                  f"acc {val_stats['accuracy']:.4f} | "
+                  f"{train_stats['meta_tasks_per_sec']:.1f} tasks/s | "
+                  f"lr {train_stats['meta_lr']:.2e}")
+
+        if self.current_iter >= total_iters:
+            return self.run_test_protocol()
+        return {"paused_at_iter": self.current_iter}
+
+    # ------------------------------------------------------------------
+    def run_test_protocol(self) -> Dict[str, Any]:
+        """Reference test protocol: ensemble the top-5 checkpoints by val
+        accuracy over the fixed test episodes; majority vote by summed
+        per-sample probabilities; report mean ± std of per-episode
+        accuracy; write ``test_summary.csv``."""
+        cfg = self.cfg
+        top = self.ckpt.top_epochs(cfg.max_models_to_save)
+        per_model_logits, per_model_acc = [], {}
+        if not top:
+            warnings.warn("no checkpoints recorded; testing current state")
+            res = self._evaluate(self.data.get_test_batches(), self.state,
+                                 collect_logits=True)
+            per_model_logits.append(res["logits"])
+            per_model_acc["current"] = res["accuracy"]
+        for epoch in top:
+            state, _ = self.ckpt.load(self.state, epoch)
+            state = jax.device_put(state, replicated_sharding(self.mesh))
+            res = self._evaluate(self.data.get_test_batches(), state,
+                                 collect_logits=True)
+            per_model_logits.append(res["logits"])
+            per_model_acc[f"epoch_{epoch}"] = res["accuracy"]
+
+        # Ensemble: sum of softmax probabilities over models, then argmax.
+        probs = sum(jax.nn.softmax(jnp.asarray(lg), axis=-1)
+                    for lg in per_model_logits)
+        preds = np.asarray(jnp.argmax(probs, axis=-1))  # (E, N*T)
+        n, t = cfg.num_classes_per_set, cfg.num_target_samples
+        labels = np.tile(np.repeat(np.arange(n), t)[None],
+                         (preds.shape[0], 1))
+        per_episode_acc = (preds == labels).mean(axis=1)
+        result = {
+            "test_accuracy_mean": float(per_episode_acc.mean()),
+            "test_accuracy_std": float(per_episode_acc.std()),
+            "num_models": len(per_model_logits),
+            "num_episodes": int(per_episode_acc.shape[0]),
+            "per_model_accuracy": per_model_acc,
+        }
+        # CSV schema must be stable across re-runs (the ensemble member set
+        # changes), so per-model accuracies go in one packed column.
+        save_statistics(
+            self.paths["logs"],
+            {**{k: v for k, v in result.items()
+                if k != "per_model_accuracy"},
+             "per_model_accuracy": "|".join(
+                 f"{k}:{v:.6f}" for k, v in per_model_acc.items())},
+            filename="test_summary.csv")
+        print(f"test: {result['test_accuracy_mean']:.4f} "
+              f"± {result['test_accuracy_std']:.4f} "
+              f"({result['num_models']}-model ensemble, "
+              f"{result['num_episodes']} episodes)")
+        return result

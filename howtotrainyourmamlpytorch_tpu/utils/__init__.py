@@ -1,0 +1,13 @@
+from howtotrainyourmamlpytorch_tpu.utils.storage import (
+    build_experiment_folder,
+    load_statistics,
+    save_statistics,
+    load_from_json,
+    save_to_json,
+)
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import CheckpointManager
+
+__all__ = [
+    "build_experiment_folder", "load_statistics", "save_statistics",
+    "load_from_json", "save_to_json", "CheckpointManager",
+]

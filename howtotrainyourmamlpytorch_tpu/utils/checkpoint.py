@@ -1,0 +1,140 @@
+"""Pytree checkpointing with the reference's retention policy.
+
+Reference: ``few_shot_learning_system.py § save_model/load_model`` +
+``experiment_builder.py`` bookkeeping — ``train_model_latest`` plus
+per-epoch files, keep the top ``max_models_to_save`` (5) epochs by
+validation accuracy (those feed the final ensemble test), and a state dict
+carrying current_iter / best-val bookkeeping.
+
+TPU-native: state is a pure pytree (flax.serialization msgpack bytes), so a
+checkpoint is one atomic file write (tmp + rename) — no pickled module
+objects. Metadata (iteration, epoch, per-epoch val accuracy) lives in a
+sidecar JSON, human-readable for debugging and resume.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+from flax import serialization
+
+from howtotrainyourmamlpytorch_tpu.utils.storage import (
+    load_from_json, save_to_json)
+
+LATEST = "latest"
+
+
+class CheckpointManager:
+    """Manages ``train_model_<epoch>.ckpt`` files + ``state.json``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 5):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+        self._meta_path = os.path.join(directory, "state.json")
+        if os.path.isfile(self._meta_path):
+            self.meta: Dict[str, Any] = load_from_json(self._meta_path)
+            self.meta.setdefault("iter_at_epoch", {})
+        else:
+            self.meta = {"current_iter": 0, "current_epoch": 0,
+                         "val_acc_per_epoch": {}, "iter_at_epoch": {},
+                         "best_val_acc": 0.0, "best_val_epoch": -1}
+
+    # -- paths ----------------------------------------------------------
+    def _ckpt_path(self, tag) -> str:
+        return os.path.join(self.directory, f"train_model_{tag}.ckpt")
+
+    # -- save -----------------------------------------------------------
+    def save(self, state, epoch: int, current_iter: int,
+             val_acc: float) -> None:
+        """Write the epoch checkpoint + latest, update bookkeeping, prune
+        checkpoints outside the top ``max_to_keep`` by val accuracy."""
+        state = jax.device_get(state)
+        data = serialization.to_bytes(state)
+        for tag in (epoch, LATEST):
+            path = self._ckpt_path(tag)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+
+        self.meta["current_iter"] = int(current_iter)
+        self.meta["current_epoch"] = int(epoch)
+        self.meta["val_acc_per_epoch"][str(epoch)] = float(val_acc)
+        self.meta["iter_at_epoch"][str(epoch)] = int(current_iter)
+        if val_acc >= self.meta["best_val_acc"]:
+            self.meta["best_val_acc"] = float(val_acc)
+            self.meta["best_val_epoch"] = int(epoch)
+        self._prune()
+        save_to_json(self._meta_path, self.meta)
+
+    def _prune(self) -> None:
+        keep = {int(e) for e in self.top_epochs(self.max_to_keep)}
+        for name in os.listdir(self.directory):
+            if not (name.startswith("train_model_")
+                    and name.endswith(".ckpt")):
+                continue
+            tag = name[len("train_model_"):-len(".ckpt")]
+            if tag == LATEST or not tag.isdigit():
+                continue
+            if int(tag) not in keep:
+                os.remove(os.path.join(self.directory, name))
+
+    # -- load -----------------------------------------------------------
+    def load(self, template_state, tag=LATEST):
+        """Restore a checkpoint into the template's pytree structure.
+
+        Returns (state, meta). ``tag`` is ``'latest'`` or an epoch int
+        (reference ``continue_from_epoch`` semantics). For an epoch tag,
+        the returned meta's ``current_iter`` is that *epoch's* iteration
+        (not the global latest), so resuming from an earlier epoch
+        retrains from the right place.
+        """
+        path = self._ckpt_path(tag)
+        if not os.path.isfile(path):
+            raise FileNotFoundError(path)
+        with open(path, "rb") as f:
+            state = serialization.from_bytes(template_state, f.read())
+        meta = dict(self.meta)
+        if tag != LATEST:
+            epoch_iter = self.meta["iter_at_epoch"].get(str(int(tag)))
+            if epoch_iter is not None:
+                meta["current_iter"] = epoch_iter
+                meta["current_epoch"] = int(tag)
+        return state, meta
+
+    def rewind_to(self, epoch: int) -> None:
+        """Discard bookkeeping newer than ``epoch`` (for
+        ``continue_from_epoch=<int>`` rewinds): later epochs' val
+        accuracies must not feed the top-k ensemble once retraining
+        overwrites those checkpoints."""
+        epoch = int(epoch)
+        if str(epoch) not in self.meta["iter_at_epoch"]:
+            raise KeyError(f"no bookkeeping for epoch {epoch}")
+        for key in ("val_acc_per_epoch", "iter_at_epoch"):
+            self.meta[key] = {e: v for e, v in self.meta[key].items()
+                              if int(e) <= epoch}
+        self.meta["current_iter"] = self.meta["iter_at_epoch"][str(epoch)]
+        self.meta["current_epoch"] = epoch
+        kept = self.meta["val_acc_per_epoch"]
+        if kept:
+            best = max(kept.items(), key=lambda kv: (kv[1], int(kv[0])))
+            self.meta["best_val_acc"] = best[1]
+            self.meta["best_val_epoch"] = int(best[0])
+        else:
+            self.meta["best_val_acc"] = 0.0
+            self.meta["best_val_epoch"] = -1
+        save_to_json(self._meta_path, self.meta)
+
+    # -- queries ---------------------------------------------------------
+    def top_epochs(self, k: Optional[int] = None) -> List[int]:
+        """Epochs sorted by val accuracy, best first (the ensemble set)."""
+        k = k if k is not None else self.max_to_keep
+        items = sorted(self.meta["val_acc_per_epoch"].items(),
+                       key=lambda kv: (-kv[1], -int(kv[0])))
+        return [int(e) for e, _ in items[:k]]
+
+    def has_checkpoint(self, tag=LATEST) -> bool:
+        return os.path.isfile(self._ckpt_path(tag))

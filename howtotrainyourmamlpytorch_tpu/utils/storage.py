@@ -1,0 +1,80 @@
+"""Experiment folder scaffolding and CSV statistics.
+
+Reference: ``utils/storage.py`` — ``build_experiment_folder``,
+``save_statistics`` (append-style CSV keyed by column names),
+``load_statistics``, JSON helpers. Same filenames and layout so downstream
+tooling pointed at a reference experiment dir keeps working:
+
+    <experiment_root>/<experiment_name>/
+        saved_models/
+        logs/summary_statistics.csv
+        logs/test_summary.csv
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Any, Dict, List, Sequence
+
+
+def build_experiment_folder(experiment_root: str,
+                            experiment_name: str) -> Dict[str, str]:
+    base = os.path.join(experiment_root, experiment_name)
+    paths = {
+        "base": base,
+        "saved_models": os.path.join(base, "saved_models"),
+        "logs": os.path.join(base, "logs"),
+    }
+    for p in paths.values():
+        os.makedirs(p, exist_ok=True)
+    return paths
+
+
+def save_statistics(logs_dir: str, stats: Dict[str, Any],
+                    filename: str = "summary_statistics.csv") -> str:
+    """Append one row; writes the header on first use. Columns are fixed by
+    the first call (extra keys in later rows would be silently misaligned,
+    so they raise)."""
+    path = os.path.join(logs_dir, filename)
+    exists = os.path.isfile(path)
+    if exists:
+        with open(path, newline="") as f:
+            header = next(csv.reader(f))
+        if set(stats) != set(header):
+            raise ValueError(
+                f"stats keys {sorted(stats)} != existing columns "
+                f"{sorted(header)} in {path}")
+    else:
+        header = list(stats)
+    with open(path, "a", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=header)
+        if not exists:
+            writer.writeheader()
+        writer.writerow(stats)
+    return path
+
+
+def load_statistics(logs_dir: str,
+                    filename: str = "summary_statistics.csv"
+                    ) -> Dict[str, List[str]]:
+    """Column-name → list of values (strings, as the reference returns)."""
+    path = os.path.join(logs_dir, filename)
+    with open(path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    if not rows:
+        return {}
+    return {k: [r[k] for r in rows] for k in rows[0]}
+
+
+def save_to_json(path: str, obj: Any) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2)
+    os.replace(tmp, path)
+
+
+def load_from_json(path: str) -> Any:
+    with open(path) as f:
+        return json.load(f)

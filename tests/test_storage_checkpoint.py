@@ -1,0 +1,104 @@
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.utils import (
+    CheckpointManager, build_experiment_folder, load_statistics,
+    save_statistics)
+
+CFG = MAMLConfig(image_height=8, image_width=8, image_channels=1,
+                 num_classes_per_set=2, cnn_num_filters=4, num_stages=1,
+                 number_of_training_steps_per_iter=2,
+                 number_of_evaluation_steps_per_iter=2,
+                 compute_dtype="float32")
+
+
+def test_experiment_folder_layout(tmp_path):
+    paths = build_experiment_folder(str(tmp_path), "exp1")
+    assert os.path.isdir(paths["saved_models"])
+    assert os.path.isdir(paths["logs"])
+
+
+def test_statistics_roundtrip(tmp_path):
+    logs = str(tmp_path)
+    save_statistics(logs, {"epoch": 0, "loss": 1.5})
+    save_statistics(logs, {"epoch": 1, "loss": 1.2})
+    stats = load_statistics(logs)
+    assert stats["epoch"] == ["0", "1"]
+    assert stats["loss"] == ["1.5", "1.2"]
+    with pytest.raises(ValueError, match="columns"):
+        save_statistics(logs, {"epoch": 2, "other": 1})
+
+
+def _state():
+    init, _ = make_model(CFG)
+    return init_train_state(CFG, init, jax.random.PRNGKey(0))
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    state = _state()
+    mgr.save(state, epoch=0, current_iter=10, val_acc=0.5)
+    loaded, meta = mgr.load(_state(), 0)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert meta["current_iter"] == 10
+
+
+def test_checkpoint_retention_top_k(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    state = _state()
+    accs = {0: 0.1, 1: 0.9, 2: 0.3, 3: 0.7, 4: 0.5, 5: 0.6}
+    for epoch, acc in accs.items():
+        mgr.save(state, epoch, current_iter=epoch * 10, val_acc=acc)
+    assert mgr.top_epochs() == [1, 3, 5]  # by val acc desc
+    kept = {f for f in os.listdir(tmp_path) if f.endswith(".ckpt")}
+    assert kept == {"train_model_1.ckpt", "train_model_3.ckpt",
+                    "train_model_5.ckpt", "train_model_latest.ckpt"}
+    assert mgr.meta["best_val_acc"] == 0.9
+    assert mgr.meta["best_val_epoch"] == 1
+
+
+def test_checkpoint_manager_reloads_meta(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(), 0, current_iter=7, val_acc=0.4)
+    mgr2 = CheckpointManager(str(tmp_path))
+    assert mgr2.meta["current_iter"] == 7
+    assert mgr2.has_checkpoint("latest")
+    loaded, meta = mgr2.load(_state(), "latest")
+    assert meta["val_acc_per_epoch"]["0"] == 0.4
+
+
+def test_epoch_tag_load_returns_epoch_iter(tmp_path):
+    """Loading a specific epoch must return that epoch's iteration, and
+    rewinding must drop later epochs from the ensemble bookkeeping."""
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+    state = _state()
+    for epoch in range(4):
+        mgr.save(state, epoch, current_iter=(epoch + 1) * 10,
+                 val_acc=0.1 * (epoch + 1))
+    _, meta = mgr.load(_state(), 1)
+    assert meta["current_iter"] == 20
+    assert meta["current_epoch"] == 1
+    # latest still reports the global position
+    _, meta_l = mgr.load(_state(), "latest")
+    assert meta_l["current_iter"] == 40
+
+    mgr.rewind_to(1)
+    assert set(mgr.meta["val_acc_per_epoch"]) == {"0", "1"}
+    assert mgr.meta["best_val_epoch"] == 1
+    assert mgr.top_epochs() == [1, 0]
+    with pytest.raises(KeyError):
+        mgr.rewind_to(77)
+
+
+def test_missing_checkpoint_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        mgr.load(_state(), 99)

@@ -1,0 +1,92 @@
+"""CLI entry point — same contract as the reference's
+``train_maml_system.py``:
+
+    python train_maml_system.py --name_of_args_json_file \\
+        experiment_config/omniglot_maml++_5-way_1-shot.json [--key value ...]
+
+Any config field can be overridden on the command line after the JSON is
+applied (reference: argparse defaults → JSON override; here: dataclass
+defaults → JSON → CLI overrides).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+
+def _coerce(parser, field, key: str, raw: str):
+    """Parse a CLI override against its dataclass field type.
+
+    JSON literals are accepted for every type; additionally bools take
+    true/false in any case ('--second_order False' must not become the
+    truthy string 'False'). Non-string fields reject unparseable values
+    loudly instead of smuggling strings into the config.
+    """
+    if field.type in ("bool", bool):
+        low = raw.strip().lower()
+        if low in ("true", "1", "yes"):
+            return True
+        if low in ("false", "0", "no"):
+            return False
+        parser.error(f"--{key} expects a boolean, got {raw!r}")
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError:
+        if "str" in str(field.type):
+            return raw  # bare string (e.g. --experiment_name foo)
+        parser.error(f"--{key}: could not parse {raw!r} as "
+                     f"{field.type}")
+
+
+def get_args(argv=None) -> MAMLConfig:
+    parser = argparse.ArgumentParser(
+        description="TPU-native MAML++ few-shot meta-learning")
+    parser.add_argument("--name_of_args_json_file", type=str, default=None,
+                        help="experiment_config/*.json (reference schema)")
+    known, overrides = parser.parse_known_args(argv)
+
+    values = {}
+    if known.name_of_args_json_file:
+        with open(known.name_of_args_json_file) as f:
+            values.update(json.load(f))
+
+    fields = {f.name: f for f in dataclasses.fields(MAMLConfig)}
+    i = 0
+    while i < len(overrides):
+        tok = overrides[i]
+        if not tok.startswith("--"):
+            parser.error(f"unexpected argument {tok!r}")
+        key, eq, inline = tok[2:].partition("=")
+        if eq:
+            raw = inline
+            i += 1
+        else:
+            if i + 1 >= len(overrides):
+                parser.error(f"--{key} needs a value")
+            raw = overrides[i + 1]
+            i += 2
+        if key not in fields:
+            parser.error(f"unknown config field --{key}")
+        values[key] = _coerce(parser, fields[key], key, raw)
+
+    return MAMLConfig.from_dict(values)
+
+
+def main(argv=None) -> int:
+    cfg = get_args(argv)
+    print(f"experiment: {cfg.experiment_name} | dataset: "
+          f"{cfg.dataset_name} | {cfg.num_classes_per_set}-way "
+          f"{cfg.num_samples_per_class}-shot | mesh {cfg.mesh_shape}")
+    builder = ExperimentBuilder(cfg)
+    builder.run_experiment()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
