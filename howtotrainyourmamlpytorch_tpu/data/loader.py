@@ -70,6 +70,16 @@ class MetaLearningDataLoader:
         q: "queue.Queue" = queue.Queue(maxsize=prefetch)
         abandoned = threading.Event()
 
+        def put_bounded(item) -> None:
+            # Bounded put so an abandoned consumer can't strand the worker
+            # on a full queue (applies to batches AND terminal items).
+            while not abandoned.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    pass
+
         def worker():
             try:
                 for b in range(num_batches):
@@ -78,17 +88,10 @@ class MetaLearningDataLoader:
                     base = (start_idx + b) * batch_size
                     batch = sampler.sample_batch(
                         range(base, base + batch_size))
-                    # Bounded put so an abandoned consumer can't strand us
-                    # on a full queue.
-                    while not abandoned.is_set():
-                        try:
-                            q.put(batch, timeout=0.1)
-                            break
-                        except queue.Full:
-                            pass
+                    put_bounded(batch)
             except Exception as e:  # surface in consumer, don't hang
-                q.put(e)
-            q.put(_STOP)
+                put_bounded(e)
+            put_bounded(_STOP)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()

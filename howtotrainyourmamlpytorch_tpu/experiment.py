@@ -50,12 +50,13 @@ class ExperimentBuilder:
 
         devices = list(devices if devices is not None else jax.devices())
         n_mesh = int(np.prod(cfg.mesh_shape))
-        if n_mesh != len(devices):
-            if n_mesh != 1:
-                warnings.warn(
-                    f"mesh_shape {cfg.mesh_shape} needs {n_mesh} devices "
-                    f"but {len(devices)} are visible; falling back to a "
-                    f"single-device mesh")
+        if n_mesh <= len(devices):
+            devices = devices[:n_mesh]
+        else:
+            warnings.warn(
+                f"mesh_shape {cfg.mesh_shape} needs {n_mesh} devices "
+                f"but {len(devices)} are visible; falling back to a "
+                f"single-device mesh")
             cfg = cfg.replace(mesh_shape=(1, 1))
             devices = devices[:1]
         self.cfg = cfg
@@ -111,8 +112,11 @@ class ExperimentBuilder:
             self.current_iter += 1
         jax.block_until_ready(self.state.params)
         dt = time.time() - t0
+        # jnp.stack keeps the stack on device so the device_get below is one
+        # batched transfer per leaf (np.stack would pull each per-iteration
+        # scalar across individually).
         stacked = jax.device_get(
-            jax.tree.map(lambda *xs: np.stack(xs), *metrics_acc))
+            jax.tree.map(lambda *xs: jnp.stack(xs), *metrics_acc))
         tasks = cfg.total_iter_per_epoch * cfg.batch_size
         return {
             "train_loss": float(np.mean(stacked.loss)),
@@ -164,7 +168,7 @@ class ExperimentBuilder:
                                        self.state)
             epochs_this_session += 1
 
-            row = {"epoch": epoch, **{k: v for k, v in train_stats.items()},
+            row = {"epoch": epoch, **train_stats,
                    "val_loss": val_stats["loss"],
                    "val_accuracy": val_stats["accuracy"]}
             save_statistics(self.paths["logs"], row)

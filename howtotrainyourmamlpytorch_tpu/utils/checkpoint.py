@@ -53,12 +53,18 @@ class CheckpointManager:
         checkpoints outside the top ``max_to_keep`` by val accuracy."""
         state = jax.device_get(state)
         data = serialization.to_bytes(state)
-        for tag in (epoch, LATEST):
-            path = self._ckpt_path(tag)
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
+        epoch_path = self._ckpt_path(epoch)
+        tmp = epoch_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, epoch_path)
+        # 'latest' is a hard link to the epoch file (atomic via tmp link +
+        # rename) — one full write per save instead of two.
+        latest_tmp = self._ckpt_path(LATEST) + ".tmp"
+        if os.path.exists(latest_tmp):
+            os.remove(latest_tmp)
+        os.link(epoch_path, latest_tmp)
+        os.replace(latest_tmp, self._ckpt_path(LATEST))
 
         self.meta["current_iter"] = int(current_iter)
         self.meta["current_epoch"] = int(epoch)
