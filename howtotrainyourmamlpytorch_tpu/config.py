@@ -143,6 +143,25 @@ class MAMLConfig:
         return (self.image_height, self.image_width, self.image_channels)
 
     @property
+    def dataset_dir(self) -> str:
+        """Directory holding the split subdirectories.
+
+        Reference semantics (``data.py § load_dataset``): ``dataset_path``
+        is a parent directory joined with ``dataset_name``. The join is
+        skipped when ``dataset_path`` already ends with the dataset name
+        (shipped configs set the full path directly) or when it itself
+        holds split subdirectories (full-path configs whose basename is
+        not the dataset name must not be silently re-pointed).
+        """
+        path = self.dataset_path.rstrip("/\\")
+        if os.path.basename(path) == self.dataset_name:
+            return path
+        if any(os.path.isdir(os.path.join(path, s))
+               for s in ("train", "val", "test")):
+            return path
+        return os.path.join(path, self.dataset_name)
+
+    @property
     def bn_num_steps(self) -> int:
         """Leading dim of per-step BN state/γ/β.
 

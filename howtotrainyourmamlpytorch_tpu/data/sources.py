@@ -136,14 +136,16 @@ _SPLIT_SEEDS = {"train": 0, "val": 1, "test": 2}
 def build_source(cfg, split: str):
     """Resolve a split's image source from the config.
 
-    Disk layout ``<dataset_path>/<split>/<class>/…`` when present (the
-    reference's contract); otherwise a synthetic fallback (with a warning
-    unless the dataset name says 'synthetic') so the framework runs
-    end-to-end with no datasets installed.
+    Disk layout ``<cfg.dataset_dir>/<split>/<class>/…`` when present —
+    where ``dataset_dir`` is ``dataset_path/dataset_name`` (the reference's
+    contract) or ``dataset_path`` itself if it already holds the split
+    dirs. Otherwise a synthetic fallback (with a warning unless the
+    dataset name says 'synthetic') so the framework runs end-to-end with
+    no datasets installed.
     """
     if split not in SPLITS:
         raise ValueError(f"unknown split {split!r}")
-    root = os.path.join(cfg.dataset_path, split)
+    root = os.path.join(cfg.dataset_dir, split)
     if os.path.isdir(root):
         return DiskImageSource(root, cfg.image_shape)
     if "synthetic" not in cfg.dataset_name:

@@ -6,8 +6,12 @@ from howtotrainyourmamlpytorch_tpu.utils.storage import (
     save_to_json,
 )
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import CheckpointManager
+from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import (
+    maybe_unzip_dataset,
+)
 
 __all__ = [
     "build_experiment_folder", "load_statistics", "save_statistics",
     "load_from_json", "save_to_json", "CheckpointManager",
+    "maybe_unzip_dataset",
 ]

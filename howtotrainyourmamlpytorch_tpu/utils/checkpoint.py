@@ -59,11 +59,17 @@ class CheckpointManager:
             f.write(data)
         os.replace(tmp, epoch_path)
         # 'latest' is a hard link to the epoch file (atomic via tmp link +
-        # rename) — one full write per save instead of two.
+        # rename) — one full write per save instead of two. Filesystems
+        # without hard links (gcsfuse, some NFS/overlay mounts) fall back
+        # to a second full write.
         latest_tmp = self._ckpt_path(LATEST) + ".tmp"
         if os.path.exists(latest_tmp):
             os.remove(latest_tmp)
-        os.link(epoch_path, latest_tmp)
+        try:
+            os.link(epoch_path, latest_tmp)
+        except OSError:
+            with open(latest_tmp, "wb") as f:
+                f.write(data)
         os.replace(latest_tmp, self._ckpt_path(LATEST))
 
         self.meta["current_iter"] = int(current_iter)

@@ -1,0 +1,116 @@
+"""Dataset provisioning: resolve a dataset directory before training.
+
+Reference: ``utils/dataset_tools.py § maybe_unzip_dataset`` — if
+``datasets/<dataset_name>`` is missing, extract ``datasets/<name>.zip``;
+failing that, download the packaged dataset (Google-Drive file IDs) and
+extract it. Same resolution order here, with two TPU-environment changes:
+
+* Extraction is zip-slip-safe (member paths are validated before write).
+* The download step is a registry + pluggable fetcher rather than a
+  hard-coded Google-Drive client: this build environment has zero network
+  egress, so by default a missing dataset raises a clear, actionable error
+  (where to place the zip) instead of attempting a doomed download. Callers
+  with connectivity can pass ``fetcher=`` (e.g. wrapping ``requests``) and
+  get the reference's download-then-extract behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import zipfile
+from typing import Callable, Dict, Optional
+
+# dataset_name -> URL of the packaged zip. The reference ships Google-Drive
+# file IDs for omniglot and mini_imagenet; recorded here as the documented
+# provenance for a user-provided fetcher (the IDs themselves could not be
+# read from the empty reference mount — SURVEY.md § Provenance).
+DATASET_URLS: Dict[str, str] = {
+    "omniglot_dataset": "https://drive.google.com/open?id=<omniglot>",
+    "mini_imagenet_full_size": "https://drive.google.com/open?id=<mini-imagenet>",
+}
+
+Fetcher = Callable[[str, str], None]  # (url, dest_zip_path) -> None
+
+
+def _safe_extract(zip_path: str, dest_dir: str) -> None:
+    """Extract ``zip_path`` under ``dest_dir``, rejecting members that would
+    escape it (zip-slip)."""
+    dest_real = os.path.realpath(dest_dir)
+    with zipfile.ZipFile(zip_path) as zf:
+        for member in zf.infolist():
+            target = os.path.realpath(os.path.join(dest_dir, member.filename))
+            if not (target == dest_real
+                    or target.startswith(dest_real + os.sep)):
+                raise ValueError(
+                    f"zip member {member.filename!r} escapes {dest_dir!r}")
+        zf.extractall(dest_dir)
+
+
+def dataset_dir_is_ready(dataset_path: str) -> bool:
+    """A dataset directory is usable when it holds at least one split
+    subdirectory (the reference's ``{train,val,test}/<class>/...`` layout)."""
+    if not os.path.isdir(dataset_path):
+        return False
+    from howtotrainyourmamlpytorch_tpu.data.sources import SPLITS
+    return any(os.path.isdir(os.path.join(dataset_path, s)) for s in SPLITS)
+
+
+def maybe_unzip_dataset(cfg, fetcher: Optional[Fetcher] = None,
+                        require: bool = False) -> bool:
+    """Ensure ``cfg.dataset_path`` is populated; returns True when ready.
+
+    Resolution order (reference parity): directory exists → extract
+    ``<dataset_path>.zip`` (or ``<parent>/<dataset_name>.zip``) → fetch via
+    ``fetcher`` then extract. With no fetcher and no zip, returns False
+    (the data layer falls back to a synthetic source) unless ``require``,
+    which raises with instructions instead.
+    """
+    path = cfg.dataset_dir
+    if dataset_dir_is_ready(path):
+        return True
+
+    candidates = [path.rstrip("/\\") + ".zip",
+                  os.path.join(os.path.dirname(path.rstrip("/\\")) or ".",
+                               cfg.dataset_name + ".zip")]
+    # De-dup while keeping order (the two coincide when dataset_path ends
+    # with the dataset name).
+    candidates = list(dict.fromkeys(candidates))
+    zip_path = next((c for c in candidates if os.path.isfile(c)), None)
+
+    if zip_path is None and fetcher is not None:
+        url = DATASET_URLS.get(cfg.dataset_name)
+        if url is None:
+            raise KeyError(
+                f"no download URL registered for {cfg.dataset_name!r}; "
+                f"known: {sorted(DATASET_URLS)}")
+        zip_path = candidates[0]
+        os.makedirs(os.path.dirname(zip_path) or ".", exist_ok=True)
+        fetcher(url, zip_path)
+
+    if zip_path is not None:
+        # Zips may nest everything under a top-level <dataset_name>/ dir or
+        # hold the split dirs at the root; extract to the parent in the
+        # first case (tolerating archiver junk like __MACOSX/ alongside),
+        # into the dataset dir in the second.
+        parent = os.path.dirname(path.rstrip("/\\")) or "."
+        with zipfile.ZipFile(zip_path) as zf:
+            names = zf.namelist()
+        top = {n.split("/", 1)[0] for n in names if n.strip("/")}
+        base = os.path.basename(path.rstrip("/\\"))
+        if base in top:
+            _safe_extract(zip_path, parent)
+        else:
+            _safe_extract(zip_path, path)
+        if dataset_dir_is_ready(path):
+            return True
+        raise ValueError(
+            f"extracted {zip_path!r} but {path!r} still has no "
+            f"train/val/test split directories")
+
+    if require:
+        raise FileNotFoundError(
+            f"dataset {cfg.dataset_name!r} not found: no directory at "
+            f"{path!r}, no zip at {candidates}, and no fetcher provided "
+            f"(this environment has no network). Place the packaged zip at "
+            f"{candidates[0]!r} or the extracted splits under {path!r}.")
+    return False

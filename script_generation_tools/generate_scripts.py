@@ -1,0 +1,39 @@
+"""CLI: regenerate experiment launch scripts from experiment_config/*.json.
+
+Reference: ``script_generation_tools/`` generator. Usage (from repo root):
+
+    python script_generation_tools/generate_scripts.py [--cluster]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_ROOT)
+
+from howtotrainyourmamlpytorch_tpu.utils.script_gen import (  # noqa: E402
+    generate_launch_scripts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cluster", action="store_true",
+                    help="also generate multi-host TPU launch variants")
+    args = ap.parse_args(argv)
+
+    config_dir = os.path.join(_REPO_ROOT, "experiment_config")
+    scripts_dir = os.path.join(_REPO_ROOT, "experiment_scripts")
+    written = generate_launch_scripts(config_dir, scripts_dir)
+    if args.cluster:
+        written += generate_launch_scripts(config_dir, scripts_dir,
+                                           cluster=True)
+    for path in written:
+        print(os.path.relpath(path, _REPO_ROOT))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,8 +98,9 @@ def test_way_exceeds_classes_raises():
 def test_disk_source_roundtrip(tmp_path):
     from PIL import Image
     rng = np.random.default_rng(0)
+    # Reference layout: <dataset_path>/<dataset_name>/<split>/<class>/…
     for cls in ("alpha", "beta", "gamma", "delta", "eps", "zeta"):
-        d = tmp_path / "train" / cls
+        d = tmp_path / CFG.dataset_name / "train" / cls
         d.mkdir(parents=True)
         for i in range(6):
             Image.fromarray(

@@ -1,0 +1,131 @@
+"""Tests for dataset provisioning (utils/dataset_tools.py).
+
+Reference behavior being checked: ``maybe_unzip_dataset`` resolution order
+(directory → zip → fetch), zip-slip safety, and the no-network failure mode.
+"""
+
+import io
+import os
+import zipfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import (
+    DATASET_URLS, dataset_dir_is_ready, maybe_unzip_dataset)
+
+
+def _cfg(tmp_path, name="toy_dataset"):
+    return MAMLConfig(dataset_name=name,
+                      dataset_path=str(tmp_path / name))
+
+
+def _png_bytes() -> bytes:
+    buf = io.BytesIO()
+    Image.fromarray(np.zeros((8, 8), np.uint8)).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _make_zip(path, prefix=""):
+    with zipfile.ZipFile(path, "w") as zf:
+        for split in ("train", "val", "test"):
+            zf.writestr(f"{prefix}{split}/class_a/im0.png", _png_bytes())
+
+
+def test_ready_directory_short_circuits(tmp_path):
+    cfg = _cfg(tmp_path)
+    os.makedirs(os.path.join(cfg.dataset_path, "train", "class_a"))
+    assert maybe_unzip_dataset(cfg) is True
+
+
+def test_extracts_zip_with_splits_at_root(tmp_path):
+    cfg = _cfg(tmp_path)
+    _make_zip(tmp_path / "toy_dataset.zip")
+    assert maybe_unzip_dataset(cfg) is True
+    assert dataset_dir_is_ready(cfg.dataset_path)
+    assert os.path.isfile(os.path.join(cfg.dataset_path, "train",
+                                       "class_a", "im0.png"))
+
+
+def test_extracts_zip_with_toplevel_dataset_dir(tmp_path):
+    cfg = _cfg(tmp_path)
+    _make_zip(tmp_path / "toy_dataset.zip", prefix="toy_dataset/")
+    assert maybe_unzip_dataset(cfg) is True
+    assert dataset_dir_is_ready(cfg.dataset_path)
+
+
+def test_default_parent_dataset_path_finds_zip(tmp_path):
+    """Reference layout: dataset_path is a PARENT dir ('datasets') joined
+    with dataset_name; the zip lives at datasets/<name>.zip."""
+    cfg = MAMLConfig(dataset_name="toy_dataset",
+                     dataset_path=str(tmp_path))
+    _make_zip(tmp_path / "toy_dataset.zip")
+    assert maybe_unzip_dataset(cfg) is True
+    assert dataset_dir_is_ready(str(tmp_path / "toy_dataset"))
+
+
+def test_toplevel_dir_with_archiver_junk(tmp_path):
+    """macOS-style zips carry a __MACOSX/ sibling of the dataset dir."""
+    cfg = _cfg(tmp_path)
+    zpath = tmp_path / "toy_dataset.zip"
+    _make_zip(zpath, prefix="toy_dataset/")
+    with zipfile.ZipFile(zpath, "a") as zf:
+        zf.writestr("__MACOSX/toy_dataset/._train", b"\x00")
+    assert maybe_unzip_dataset(cfg) is True
+    assert dataset_dir_is_ready(cfg.dataset_path)
+
+
+def test_full_path_config_with_mismatched_basename(tmp_path):
+    """Legacy full-path configs (basename != dataset_name) keep working:
+    dataset_dir must not re-point a directory that already holds splits."""
+    root = tmp_path / "miniimagenet"
+    os.makedirs(root / "train" / "class_a")
+    cfg = MAMLConfig(dataset_name="mini_imagenet_full_size",
+                     dataset_path=str(root))
+    assert cfg.dataset_dir == str(root)
+    assert maybe_unzip_dataset(cfg) is True
+
+
+def test_missing_everything_returns_false_or_raises(tmp_path):
+    cfg = _cfg(tmp_path)
+    assert maybe_unzip_dataset(cfg) is False
+    with pytest.raises(FileNotFoundError, match="no network"):
+        maybe_unzip_dataset(cfg, require=True)
+
+
+def test_fetcher_is_used_then_extracted(tmp_path):
+    cfg = _cfg(tmp_path, name="omniglot_dataset")
+    cfg = cfg.replace(dataset_path=str(tmp_path / "omniglot_dataset"))
+    calls = []
+
+    def fetcher(url, dest):
+        calls.append(url)
+        _make_zip(dest)
+
+    assert maybe_unzip_dataset(cfg, fetcher=fetcher) is True
+    assert calls == [DATASET_URLS["omniglot_dataset"]]
+    assert dataset_dir_is_ready(cfg.dataset_path)
+
+
+def test_fetcher_unknown_dataset_raises(tmp_path):
+    cfg = _cfg(tmp_path, name="not_a_registered_dataset")
+    with pytest.raises(KeyError, match="no download URL"):
+        maybe_unzip_dataset(cfg, fetcher=lambda u, d: None)
+
+
+def test_zip_slip_rejected(tmp_path):
+    cfg = _cfg(tmp_path)
+    zpath = tmp_path / "toy_dataset.zip"
+    with zipfile.ZipFile(zpath, "w") as zf:
+        zf.writestr("train/class_a/im0.png", _png_bytes())
+        zf.writestr("../evil.txt", "pwned")
+    with pytest.raises(ValueError, match="escapes"):
+        maybe_unzip_dataset(cfg)
+    # Members are validated before ANY write: neither the escapee at its
+    # escape target (dest_dir is cfg.dataset_path, so ../ lands in
+    # tmp_path) nor the benign member may exist.
+    assert not (tmp_path / "evil.txt").exists()
+    assert not os.path.exists(
+        os.path.join(cfg.dataset_path, "train", "class_a", "im0.png"))

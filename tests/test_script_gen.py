@@ -1,0 +1,88 @@
+"""Tests for launch-script + config-variant generation (utils/script_gen.py).
+
+Reference behavior: one launch .sh per experiment config, executable, using
+the ``cd ..; python train_maml_system.py --name_of_args_json_file`` contract;
+the generator also stamps config JSONs from a template + grid.
+"""
+
+import json
+import os
+import subprocess
+
+from howtotrainyourmamlpytorch_tpu.utils.script_gen import (
+    generate_config_variants, generate_launch_scripts)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_cfg(d, name, **kv):
+    with open(os.path.join(d, name + ".json"), "w") as f:
+        json.dump({"experiment_name": name, **kv}, f)
+
+
+def test_generates_one_executable_script_per_config(tmp_path):
+    cfg_dir = tmp_path / "experiment_config"
+    cfg_dir.mkdir()
+    _write_cfg(str(cfg_dir), "exp_a")
+    _write_cfg(str(cfg_dir), "exp_b")
+    (cfg_dir / "notes.txt").write_text("ignored")
+
+    out = generate_launch_scripts(str(cfg_dir), str(tmp_path / "scripts"))
+    names = [os.path.basename(p) for p in out]
+    assert names == ["exp_a.sh", "exp_b.sh"]
+    for p in out:
+        assert os.access(p, os.X_OK)
+        text = open(p).read()
+        assert "train_maml_system.py" in text
+        assert "experiment_config/" in text
+        assert '"$@"' in text  # CLI overrides pass through
+
+
+def test_cluster_variant_resumes_from_latest(tmp_path):
+    cfg_dir = tmp_path / "experiment_config"
+    cfg_dir.mkdir()
+    _write_cfg(str(cfg_dir), "exp_a")
+    out = generate_launch_scripts(str(cfg_dir), str(tmp_path / "scripts"),
+                                  cluster=True)
+    text = open(out[0]).read()
+    assert "continue_from_epoch latest" in text
+    assert out[0].endswith("_cluster.sh")
+
+
+def test_config_variant_grid(tmp_path):
+    base = {"dataset_name": "omniglot_dataset", "batch_size": 16}
+    written = generate_config_variants(
+        base,
+        grid={"num_classes_per_set": [5, 20],
+              "num_samples_per_class": [1, 5]},
+        name_template=("omniglot_{num_classes_per_set}-way_"
+                       "{num_samples_per_class}-shot"),
+        config_dir=str(tmp_path / "cfgs"))
+    assert len(written) == 4
+    cfg = json.load(open(os.path.join(
+        str(tmp_path / "cfgs"), "omniglot_20-way_1-shot.json")))
+    assert cfg["num_classes_per_set"] == 20
+    assert cfg["num_samples_per_class"] == 1
+    assert cfg["batch_size"] == 16
+    assert cfg["experiment_name"] == "omniglot_20-way_1-shot"
+
+
+def test_shipped_scripts_match_shipped_configs():
+    """The repo ships experiment_scripts/ regenerated from
+    experiment_config/; drift fails here."""
+    cfg_dir = os.path.join(REPO_ROOT, "experiment_config")
+    scripts_dir = os.path.join(REPO_ROOT, "experiment_scripts")
+    expected = {f[:-5] + ".sh" for f in os.listdir(cfg_dir)
+                if f.endswith(".json")}
+    actual = {f for f in os.listdir(scripts_dir) if f.endswith(".sh")
+              and not f.endswith("_cluster.sh")}
+    assert expected == actual
+
+
+def test_shipped_smoke_script_dry_runs():
+    """`bash -n` parses every shipped script (no exec)."""
+    scripts_dir = os.path.join(REPO_ROOT, "experiment_scripts")
+    for f in sorted(os.listdir(scripts_dir)):
+        if f.endswith(".sh"):
+            subprocess.run(["bash", "-n", os.path.join(scripts_dir, f)],
+                           check=True)

@@ -18,6 +18,8 @@ import sys
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import (
+    maybe_unzip_dataset)
 
 
 def _coerce(parser, field, key: str, raw: str):
@@ -83,6 +85,7 @@ def main(argv=None) -> int:
     print(f"experiment: {cfg.experiment_name} | dataset: "
           f"{cfg.dataset_name} | {cfg.num_classes_per_set}-way "
           f"{cfg.num_samples_per_class}-shot | mesh {cfg.mesh_shape}")
+    maybe_unzip_dataset(cfg)  # reference entry behavior; synthetic fallback
     builder = ExperimentBuilder(cfg)
     builder.run_experiment()
     return 0
