@@ -111,8 +111,15 @@ class MAMLConfig:
     compute_dtype: str = "bfloat16"        # matmul/conv compute dtype
     param_dtype: str = "float32"
     remat_inner_steps: bool = True         # jax.checkpoint per inner step
+    remat_policy: str = "nothing"          # 'nothing' | 'dots' | 'conv_outs'
+    inner_unroll: int = 1                  # lax.scan unroll factor (K-divisor
+                                           # or 1; higher = more fusion across
+                                           # inner steps, longer compiles)
     prefetch_batches: int = 2              # host->device prefetch depth
     experiment_root: str = "experiments"
+    profile_dir: Optional[str] = None      # jax.profiler trace output dir
+    profile_epoch: int = 0                 # epoch whose first steps to trace
+    profile_num_steps: int = 5             # steps to trace at that epoch
 
     # Keys found in a loaded JSON that we accepted-and-ignored (for logging).
     ignored_keys: Tuple[str, ...] = ()

@@ -38,6 +38,8 @@ from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
     LATEST, CheckpointManager)
 from howtotrainyourmamlpytorch_tpu.utils.storage import (
     build_experiment_folder, save_statistics, save_to_json)
+from howtotrainyourmamlpytorch_tpu.utils.tracing import (
+    JsonlLogger, StepTimer, profile_trace)
 
 
 class ExperimentBuilder:
@@ -70,6 +72,7 @@ class ExperimentBuilder:
         self.ckpt = CheckpointManager(self.paths["saved_models"],
                                       max_to_keep=cfg.max_models_to_save)
 
+        self.jsonl = JsonlLogger(f"{self.paths['logs']}/events.jsonl")
         self.state = init_train_state(cfg, self.model_init,
                                       jax.random.PRNGKey(cfg.seed))
         self.current_iter = 0
@@ -103,13 +106,33 @@ class ExperimentBuilder:
         step_fn = self.plan.train_steps[(cfg.use_second_order(epoch),
                                          cfg.use_msl(epoch))]
         metrics_acc = []
+        timer = StepTimer()
         t0 = time.time()
-        for batch in self.data.get_train_batches(self.current_iter,
-                                                 cfg.total_iter_per_epoch):
-            self.state, metrics = step_fn(self.state, batch,
-                                          jnp.float32(epoch))
-            metrics_acc.append(metrics)
-            self.current_iter += 1
+        timer.start()
+        # Profiling traces the epoch's first N *real* steps (no extra
+        # optimizer updates; training is bit-identical with/without it).
+        prof = None
+        if cfg.profile_dir and epoch == cfg.profile_epoch:
+            prof = profile_trace(cfg.profile_dir, f"epoch{epoch}")
+            prof.__enter__()
+        try:
+            for i, batch in enumerate(self.data.get_train_batches(
+                    self.current_iter, cfg.total_iter_per_epoch)):
+                if prof is not None and i == cfg.profile_num_steps:
+                    jax.block_until_ready(self.state.params)
+                    prof.__exit__(None, None, None)
+                    prof = None
+                self.state, metrics = step_fn(self.state, batch,
+                                              jnp.float32(epoch))
+                metrics_acc.append(metrics)
+                self.current_iter += 1
+                timer.tick()  # dispatch-interval under async execution;
+                              # the epoch-end sync folds device time into
+                              # the tail
+        finally:
+            if prof is not None:
+                jax.block_until_ready(self.state.params)
+                prof.__exit__(None, None, None)
         jax.block_until_ready(self.state.params)
         dt = time.time() - t0
         # jnp.stack keeps the stack on device so the device_get below is one
@@ -118,7 +141,7 @@ class ExperimentBuilder:
         stacked = jax.device_get(
             jax.tree.map(lambda *xs: jnp.stack(xs), *metrics_acc))
         tasks = cfg.total_iter_per_epoch * cfg.batch_size
-        return {
+        stats = {
             "train_loss": float(np.mean(stacked.loss)),
             "train_accuracy": float(np.mean(stacked.accuracy)),
             "train_support_loss": float(np.mean(stacked.support_loss)),
@@ -127,6 +150,14 @@ class ExperimentBuilder:
             "meta_tasks_per_sec": tasks / dt,
             "meta_tasks_per_sec_per_chip": tasks / dt / self.mesh.size,
         }
+        # Timer keys are prefixed: they measure host dispatch intervals
+        # (async), distinct from the synced whole-epoch throughput above.
+        self.jsonl.log("train_epoch", epoch=epoch, iter=self.current_iter,
+                       **stats,
+                       **{f"dispatch_{k}": v for k, v in
+                          timer.summary(cfg.batch_size,
+                                        self.mesh.size).items()})
+        return stats
 
     def _evaluate(self, batches: Iterable, state: MetaTrainState,
                   collect_logits: bool = False) -> Dict[str, Any]:
@@ -172,8 +203,13 @@ class ExperimentBuilder:
                    "val_loss": val_stats["loss"],
                    "val_accuracy": val_stats["accuracy"]}
             save_statistics(self.paths["logs"], row)
+            self.jsonl.log("validation", epoch=epoch,
+                           val_loss=val_stats["loss"],
+                           val_accuracy=val_stats["accuracy"])
             self.ckpt.save(self.state, epoch, self.current_iter,
                            val_stats["accuracy"])
+            self.jsonl.log("checkpoint", epoch=epoch,
+                           iter=self.current_iter)
             print(f"epoch {epoch}: "
                   f"train loss {train_stats['train_loss']:.4f} "
                   f"acc {train_stats['train_accuracy']:.4f} | "
@@ -233,6 +269,9 @@ class ExperimentBuilder:
              "per_model_accuracy": "|".join(
                  f"{k}:{v:.6f}" for k, v in per_model_acc.items())},
             filename="test_summary.csv")
+        self.jsonl.log("test_protocol", **{
+            k: v for k, v in result.items() if k != "per_model_accuracy"},
+            per_model_accuracy=per_model_acc)
         print(f"test: {result['test_accuracy_mean']:.4f} "
               f"± {result['test_accuracy_std']:.4f} "
               f"({result['num_models']}-model ensemble, "

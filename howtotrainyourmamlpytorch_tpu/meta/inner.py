@@ -114,6 +114,26 @@ def per_step_loss_importance(cfg: MAMLConfig,
     return jnp.where(idx == k - 1, final, nonfinal)
 
 
+def _remat_policy(cfg: MAMLConfig):
+    """Checkpoint policy for the inner-step remat.
+
+    'nothing' rematerializes everything (lowest memory); 'dots' saves
+    matmul results; 'conv_outs' saves tensors tagged ``conv_out`` by the
+    conv layer (the expensive activations — backward then skips re-running
+    convolutions at ~2x the memory of 'nothing').
+    """
+    policies = {
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "conv_outs": jax.checkpoint_policies.save_only_these_names(
+            "conv_out"),
+    }
+    if cfg.remat_policy not in policies:
+        raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
+                         f"one of {sorted(policies)}")
+    return policies[cfg.remat_policy]
+
+
 def _lslr_update(fast: Params, grads: Params, lslr: Params,
                  step: jax.Array) -> Params:
     """``w ← w − lr[step] · g`` per fast leaf (reference §
@@ -165,10 +185,11 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
         return (fast, bn), (s_loss, t_loss, t_logits)
 
     if cfg.remat_inner_steps:
-        inner_step = jax.checkpoint(inner_step)
+        inner_step = jax.checkpoint(inner_step, policy=_remat_policy(cfg))
 
     (fast, bn), (s_losses, t_losses, t_logits_steps) = jax.lax.scan(
-        inner_step, (fast0, bn_state), jnp.arange(num_steps))
+        inner_step, (fast0, bn_state), jnp.arange(num_steps),
+        unroll=cfg.inner_unroll)
 
     if use_msl:
         assert msl_weights is not None

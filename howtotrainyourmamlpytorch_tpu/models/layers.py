@@ -25,6 +25,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 Params = Dict[str, Any]
 State = Dict[str, Any]
@@ -76,6 +77,9 @@ def conv2d_apply(params: Params, x: jax.Array, *, stride: int = 1,
         padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
+    # Tag for the 'conv_outs' remat policy (meta/inner.py § _remat_policy):
+    # saving these lets the outer backward skip re-running convs.
+    y = checkpoint_name(y, "conv_out")
     return y + params["b"].astype(compute_dtype)
 
 

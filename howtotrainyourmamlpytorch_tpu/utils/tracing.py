@@ -1,0 +1,144 @@
+"""Profiling and structured logging.
+
+The reference's observability is tqdm progress bars + CSV rows
+(``experiment_builder.py``; SURVEY.md §5 "Tracing/profiling: minimal").
+The TPU build upgrades this to:
+
+* :class:`JsonlLogger` — append-only structured JSONL event log next to the
+  reference-parity CSVs (one object per line; safe to tail, trivially
+  machine-readable).
+* :class:`StepTimer` — wall-clock stats for the hot loop, reporting the
+  driver metric (meta-tasks/sec/chip) without blocking device dispatch.
+* :func:`profile_trace` — a context manager around ``jax.profiler`` device
+  tracing, opt-in via config (``profile_dir``), fail-soft: profiling is
+  diagnostics, so a backend that cannot trace (seen with remote-tunneled
+  devices) degrades to a warning, never an aborted run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+import warnings
+from typing import Any, Dict, List, Optional
+
+
+class JsonlLogger:
+    """Append-only JSONL event log.
+
+    Each event gets ``ts`` (unix seconds) and ``event`` fields; everything
+    else is caller payload. Values must be JSON-serializable; numpy scalars
+    are coerced via ``float``/``int`` fallback.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    @staticmethod
+    def _coerce(value: Any) -> Any:
+        if isinstance(value, (str, int, float, bool)) or value is None:
+            return value
+        if isinstance(value, dict):
+            return {k: JsonlLogger._coerce(v) for k, v in value.items()}
+        if isinstance(value, (list, tuple)):
+            return [JsonlLogger._coerce(v) for v in value]
+        if hasattr(value, "item"):  # numpy / jax scalar
+            try:
+                item = value.item()
+                if isinstance(item, (int, float, bool, str)):
+                    return item
+            except (TypeError, ValueError):
+                pass
+        return str(value)
+
+    def log(self, event: str, **payload: Any) -> Dict[str, Any]:
+        row = {"ts": time.time(), "event": event,
+               **{k: self._coerce(v) for k, v in payload.items()}}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        return row
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+class StepTimer:
+    """Wall-clock stats over a window of step durations.
+
+    Usage: ``tick()`` once per completed step; ``summary(tasks_per_step,
+    n_chips)`` yields mean/p50/p95 step seconds and tasks/sec/chip. The
+    timer never calls ``block_until_ready`` — callers decide where the
+    synchronization point is (the experiment loop syncs once per epoch).
+    """
+
+    def __init__(self) -> None:
+        self._durations: List[float] = []
+        self._last: Optional[float] = None
+
+    def start(self) -> None:
+        self._last = time.perf_counter()
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self._durations.append(now - self._last)
+        self._last = now
+
+    @property
+    def num_steps(self) -> int:
+        return len(self._durations)
+
+    def summary(self, tasks_per_step: int,
+                n_chips: int = 1) -> Dict[str, float]:
+        if not self._durations:
+            return {}
+        d = sorted(self._durations)
+        n = len(d)
+        total = sum(d)
+        return {
+            "steps": n,
+            "mean_step_seconds": total / n,
+            "p50_step_seconds": d[n // 2],
+            "p95_step_seconds": d[min(n - 1, int(0.95 * n))],
+            "meta_tasks_per_sec": tasks_per_step * n / total,
+            "meta_tasks_per_sec_per_chip":
+                tasks_per_step * n / total / n_chips,
+        }
+
+    def reset(self) -> None:
+        self._durations.clear()
+        self._last = None
+
+
+@contextlib.contextmanager
+def profile_trace(profile_dir: Optional[str], tag: str = "trace"):
+    """Trace device execution into ``profile_dir/tag`` via ``jax.profiler``.
+
+    No-op when ``profile_dir`` is falsy. Fail-soft on backends that cannot
+    trace: a warning is emitted and the body still runs.
+    """
+    if not profile_dir:
+        yield
+        return
+    import jax
+    out = os.path.join(profile_dir, tag)
+    os.makedirs(out, exist_ok=True)
+    started = False
+    try:
+        jax.profiler.start_trace(out)
+        started = True
+    except Exception as e:  # diagnostics must never kill training
+        warnings.warn(f"profiling unavailable ({e}); continuing untraced")
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                warnings.warn(f"profiler stop failed ({e})")

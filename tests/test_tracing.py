@@ -1,0 +1,101 @@
+"""Tests for profiling/structured-logging (utils/tracing.py) and its
+ExperimentBuilder integration (events.jsonl, profiler fail-soft)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.utils.tracing import (
+    JsonlLogger, StepTimer, profile_trace, read_jsonl)
+
+
+def test_jsonl_logger_roundtrip(tmp_path):
+    log = JsonlLogger(str(tmp_path / "events.jsonl"))
+    log.log("train_epoch", epoch=0, loss=1.5)
+    log.log("checkpoint", epoch=0, path="x.ckpt")
+    rows = read_jsonl(log.path)
+    assert [r["event"] for r in rows] == ["train_epoch", "checkpoint"]
+    assert rows[0]["loss"] == 1.5
+    assert all("ts" in r for r in rows)
+
+
+def test_jsonl_logger_coerces_numpy_and_objects(tmp_path):
+    log = JsonlLogger(str(tmp_path / "e.jsonl"))
+    row = log.log("m", acc=np.float32(0.5), n=np.int64(3),
+                  nested={"a": np.float64(1.0)}, seq=(np.int32(1), 2),
+                  obj=object())
+    # written line must be valid JSON
+    parsed = read_jsonl(log.path)[0]
+    assert parsed["acc"] == 0.5
+    assert parsed["n"] == 3
+    assert parsed["nested"]["a"] == 1.0
+    assert parsed["seq"] == [1, 2]
+    assert isinstance(parsed["obj"], str)
+    assert row["acc"] == 0.5
+
+
+def test_step_timer_summary():
+    t = StepTimer()
+    t.start()
+    for _ in range(5):
+        time.sleep(0.01)
+        t.tick()
+    s = t.summary(tasks_per_step=4, n_chips=2)
+    assert s["steps"] == 5
+    assert s["mean_step_seconds"] >= 0.009
+    assert s["p50_step_seconds"] <= s["p95_step_seconds"] * 1.5
+    assert s["meta_tasks_per_sec_per_chip"] == pytest.approx(
+        s["meta_tasks_per_sec"] / 2)
+    t.reset()
+    assert t.summary(1) == {}
+
+
+def test_profile_trace_noop_without_dir():
+    with profile_trace(None):
+        pass  # must not touch jax at all
+
+
+def test_profile_trace_fail_soft(tmp_path, monkeypatch):
+    import jax
+    def boom(*a, **k):
+        raise RuntimeError("backend cannot trace")
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    with pytest.warns(UserWarning, match="profiling unavailable"):
+        with profile_trace(str(tmp_path), "t"):
+            ran = True
+    assert ran
+
+
+def test_experiment_writes_events_jsonl(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    cfg = MAMLConfig(
+        experiment_name="trace_smoke",
+        experiment_root=str(tmp_path),
+        dataset_name="synthetic",
+        image_height=12, image_width=12, image_channels=1,
+        num_classes_per_set=3, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2,
+        cnn_num_filters=4, num_stages=2,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        second_order=False, use_multi_step_loss_optimization=False,
+        total_epochs=1, total_iter_per_epoch=2,
+        num_evaluation_tasks=2, max_models_to_save=2)
+    result = ExperimentBuilder(cfg).run_experiment()
+    events = read_jsonl(os.path.join(
+        str(tmp_path), "trace_smoke", "logs", "events.jsonl"))
+    kinds = [e["event"] for e in events]
+    assert "train_epoch" in kinds
+    assert "validation" in kinds
+    assert "checkpoint" in kinds
+    assert "test_protocol" in kinds
+    tp = [e for e in events if e["event"] == "train_epoch"][0]
+    assert tp["meta_tasks_per_sec"] > 0
+    assert "test_accuracy_mean" in [
+        e for e in events if e["event"] == "test_protocol"][0]
+    assert 0.0 <= result["test_accuracy_mean"] <= 1.0
