@@ -110,8 +110,15 @@ class MAMLConfig:
     mesh_axis_names: Tuple[str, ...] = ("dcn", "tasks")
     compute_dtype: str = "bfloat16"        # matmul/conv compute dtype
     param_dtype: str = "float32"
+    bn_fast_math: bool = False             # fold BN stats into a bf16
+                                           # scale/shift (stats stay f32)
     remat_inner_steps: bool = True         # jax.checkpoint per inner step
-    remat_policy: str = "nothing"          # 'nothing' | 'dots' | 'conv_outs'
+    remat_policy: str = "block_outs"       # 'nothing' | 'dots' | 'conv_outs'
+                                           # | 'block_outs' (default: saves
+                                           # the 4x-smaller pooled stage
+                                           # outputs; gradient-identical,
+                                           # measured fastest with
+                                           # bn_fast_math)
     inner_unroll: int = 1                  # lax.scan unroll factor (K-divisor
                                            # or 1; higher = more fusion across
                                            # inner steps, longer compiles)

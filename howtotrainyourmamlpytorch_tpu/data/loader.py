@@ -41,6 +41,11 @@ class MetaLearningDataLoader:
         self.cfg = cfg
         self.mesh = mesh
         self._samplers = {}
+        # Multi-host: each process samples only the episode positions that
+        # land on its own chips (parallel/multihost.py). Deterministic
+        # episode streams make this coordination-free.
+        import jax
+        self._multihost = mesh is not None and jax.process_count() > 1
 
     def sampler(self, split: str) -> EpisodeSampler:
         if split not in self._samplers:
@@ -58,8 +63,8 @@ class MetaLearningDataLoader:
 
     # -- iteration --------------------------------------------------------
     def _place(self, batch: Episode) -> Episode:
-        if self.mesh is None:
-            return batch
+        if self.mesh is None or self._multihost:
+            return batch  # multihost batches are assembled already sharded
         from howtotrainyourmamlpytorch_tpu.parallel.mesh import shard_batch
         return shard_batch(batch, self.mesh)
 
@@ -69,6 +74,15 @@ class MetaLearningDataLoader:
         prefetch = max(1, self.cfg.prefetch_batches)
         q: "queue.Queue" = queue.Queue(maxsize=prefetch)
         abandoned = threading.Event()
+
+        if self._multihost:
+            # Loop-invariant: the sharding and per-device slice map depend
+            # only on (mesh, batch_size).
+            from howtotrainyourmamlpytorch_tpu.parallel import (
+                assemble_global_batch, batch_sharding,
+                local_batch_positions)
+            mh_sharding = batch_sharding(self.mesh)
+            mh_positions = local_batch_positions(mh_sharding, batch_size)
 
         def put_bounded(item) -> None:
             # Bounded put so an abandoned consumer can't strand the worker
@@ -86,8 +100,15 @@ class MetaLearningDataLoader:
                     if abandoned.is_set():
                         return
                     base = (start_idx + b) * batch_size
-                    batch = sampler.sample_batch(
-                        range(base, base + batch_size))
+                    if self._multihost:
+                        batch = assemble_global_batch(
+                            lambda s, e: sampler.sample_batch(
+                                range(base + s, base + e)),
+                            batch_size, mh_sharding,
+                            positions=mh_positions)
+                    else:
+                        batch = sampler.sample_batch(
+                            range(base, base + batch_size))
                     put_bounded(batch)
             except Exception as e:  # surface in consumer, don't hang
                 put_bounded(e)

@@ -47,11 +47,22 @@ class ExperimentBuilder:
 
     def __init__(self, cfg: MAMLConfig,
                  devices: Optional[List[jax.Device]] = None):
+        # Multi-host: every process computes, only process 0 writes
+        # checkpoints/stats (shared-filesystem single-writer discipline).
+        self.is_main_process = jax.process_index() == 0
         self.paths = build_experiment_folder(cfg.experiment_root,
                                              cfg.experiment_name)
 
         devices = list(devices if devices is not None else jax.devices())
         n_mesh = int(np.prod(cfg.mesh_shape))
+        if jax.process_count() > 1 and n_mesh != len(devices):
+            # Multi-host meshes must cover the pod exactly: truncating the
+            # global device list would strand whole hosts with zero
+            # addressable mesh devices (and a too-big mesh can't exist).
+            raise ValueError(
+                f"mesh_shape {cfg.mesh_shape} covers {n_mesh} devices but "
+                f"the pod exposes {len(devices)}; multi-host runs need "
+                f"mesh size == global device count")
         if n_mesh <= len(devices):
             devices = devices[:n_mesh]
         else:
@@ -63,7 +74,8 @@ class ExperimentBuilder:
             devices = devices[:1]
         self.cfg = cfg
         # Recorded config reflects what actually runs (incl. any fallback).
-        save_to_json(f"{self.paths['base']}/config.json", cfg.to_dict())
+        if self.is_main_process:
+            save_to_json(f"{self.paths['base']}/config.json", cfg.to_dict())
 
         self.model_init, self.model_apply = make_model(cfg)
         self.mesh = make_mesh(cfg, devices)
@@ -72,7 +84,8 @@ class ExperimentBuilder:
         self.ckpt = CheckpointManager(self.paths["saved_models"],
                                       max_to_keep=cfg.max_models_to_save)
 
-        self.jsonl = JsonlLogger(f"{self.paths['logs']}/events.jsonl")
+        self.jsonl = JsonlLogger(f"{self.paths['logs']}/events.jsonl",
+                                 enabled=self.is_main_process)
         self.state = init_train_state(cfg, self.model_init,
                                       jax.random.PRNGKey(cfg.seed))
         self.current_iter = 0
@@ -91,7 +104,7 @@ class ExperimentBuilder:
         if tag != LATEST:
             # Rewind: epochs after the resume point are abandoned; their
             # checkpoints must not feed the top-k ensemble.
-            self.ckpt.rewind_to(int(tag))
+            self.ckpt.rewind_to(int(tag), write=self.is_main_process)
         print(f"resumed from checkpoint {tag!r} at iter "
               f"{self.current_iter}")
 
@@ -202,12 +215,14 @@ class ExperimentBuilder:
             row = {"epoch": epoch, **train_stats,
                    "val_loss": val_stats["loss"],
                    "val_accuracy": val_stats["accuracy"]}
-            save_statistics(self.paths["logs"], row)
+            if self.is_main_process:
+                save_statistics(self.paths["logs"], row)
             self.jsonl.log("validation", epoch=epoch,
                            val_loss=val_stats["loss"],
                            val_accuracy=val_stats["accuracy"])
             self.ckpt.save(self.state, epoch, self.current_iter,
-                           val_stats["accuracy"])
+                           val_stats["accuracy"],
+                           write=self.is_main_process)
             self.jsonl.log("checkpoint", epoch=epoch,
                            iter=self.current_iter)
             print(f"epoch {epoch}: "
@@ -228,7 +243,10 @@ class ExperimentBuilder:
         accuracy over the fixed test episodes; majority vote by summed
         per-sample probabilities; report mean ± std of per-episode
         accuracy; write ``test_summary.csv``."""
+        from howtotrainyourmamlpytorch_tpu.parallel import barrier
         cfg = self.cfg
+        # Order process 0's checkpoint writes before everyone's reads.
+        barrier("checkpoints_written")
         top = self.ckpt.top_epochs(cfg.max_models_to_save)
         per_model_logits, per_model_acc = [], {}
         if not top:
@@ -262,13 +280,14 @@ class ExperimentBuilder:
         }
         # CSV schema must be stable across re-runs (the ensemble member set
         # changes), so per-model accuracies go in one packed column.
-        save_statistics(
-            self.paths["logs"],
-            {**{k: v for k, v in result.items()
-                if k != "per_model_accuracy"},
-             "per_model_accuracy": "|".join(
-                 f"{k}:{v:.6f}" for k, v in per_model_acc.items())},
-            filename="test_summary.csv")
+        if self.is_main_process:
+            save_statistics(
+                self.paths["logs"],
+                {**{k: v for k, v in result.items()
+                    if k != "per_model_accuracy"},
+                 "per_model_accuracy": "|".join(
+                     f"{k}:{v:.6f}" for k, v in per_model_acc.items())},
+                filename="test_summary.csv")
         self.jsonl.log("test_protocol", **{
             k: v for k, v in result.items() if k != "per_model_accuracy"},
             per_model_accuracy=per_model_acc)

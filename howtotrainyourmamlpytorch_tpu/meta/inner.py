@@ -127,6 +127,10 @@ def _remat_policy(cfg: MAMLConfig):
         "dots": jax.checkpoint_policies.dots_saveable,
         "conv_outs": jax.checkpoint_policies.save_only_these_names(
             "conv_out"),
+        # Pooled stage outputs: 4x smaller than conv_outs, lets the
+        # backward restart each stage's recompute from its own input.
+        "block_outs": jax.checkpoint_policies.save_only_these_names(
+            "block_out"),
     }
     if cfg.remat_policy not in policies:
         raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "

@@ -124,7 +124,8 @@ def batch_norm_init(num_features: int, num_steps: int,
 def batch_norm_apply(params: Params, state: State, x: jax.Array,
                      step: jax.Array, *, training: bool,
                      momentum: float = 0.1,
-                     eps: float = 1e-5) -> Tuple[jax.Array, State]:
+                     eps: float = 1e-5,
+                     fast_math: bool = False) -> Tuple[jax.Array, State]:
     """Normalize with *batch* statistics and update the step's running stats.
 
     Matches the reference's semantics exactly: ``F.batch_norm(...,
@@ -138,19 +139,34 @@ def batch_norm_apply(params: Params, state: State, x: jax.Array,
     the reference's backup/restore-around-eval-tasks behavior functionally.
 
     ``step`` may be a traced scalar; rows are selected dynamically.
+
+    ``fast_math`` keeps the statistics in f32 (accumulating reductions —
+    no materialized f32 copy of ``x``) but folds them into a per-channel
+    scale/shift applied in ``x``'s own dtype. On TPU this cuts the
+    dominant elementwise cost of the forward (measured ~2x on the 84x84
+    stage); the default f32 path is bit-compatible with the PyTorch
+    oracle and remains the parity/test reference.
     """
     num_steps = params["gamma"].shape[0]
     idx = jnp.clip(step, 0, num_steps - 1)
     gamma = jnp.take(params["gamma"], idx, axis=0)
     beta = jnp.take(params["beta"], idx, axis=0)
 
-    xf = x.astype(jnp.float32)
     axes = tuple(range(x.ndim - 1))  # all but channel
-    mean = jnp.mean(xf, axis=axes)
-    var = jnp.var(xf, axis=axes)
-
-    inv = jax.lax.rsqrt(var + eps)
-    y = (xf - mean) * inv * gamma + beta
+    if fast_math:
+        mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        mean_sq = jnp.mean(jax.lax.square(x.astype(jnp.float32)), axis=axes)
+        var = jnp.maximum(mean_sq - jax.lax.square(mean), 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        scale = (inv * gamma).astype(x.dtype)
+        shift = (beta - mean * inv * gamma).astype(x.dtype)
+        y = x * scale + shift
+    else:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        inv = jax.lax.rsqrt(var + eps)
+        y = (xf - mean) * inv * gamma + beta
 
     n = 1
     for a in axes:

@@ -21,6 +21,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.models import layers
@@ -36,8 +37,9 @@ def _block_widths(cfg: MAMLConfig) -> Tuple[int, ...]:
     return tuple(int(cfg.cnn_num_filters * m) for m in _WIDTH_MULTS)
 
 
-def _norm_kwargs(cfg: MAMLConfig) -> Dict[str, float]:
-    return dict(momentum=cfg.batch_norm_momentum, eps=cfg.batch_norm_eps)
+def _norm_kwargs(cfg: MAMLConfig) -> Dict[str, Any]:
+    return dict(momentum=cfg.batch_norm_momentum, eps=cfg.batch_norm_eps,
+                fast_math=cfg.bn_fast_math)
 
 
 def _apply_block(cfg: MAMLConfig, params: Params, state: State,
@@ -64,6 +66,10 @@ def _apply_block(cfg: MAMLConfig, params: Params, state: State,
         **_norm_kwargs(cfg))
     x = jax.nn.leaky_relu(x + residual, 0.1)
     x = layers.max_pool2d(x)
+    # Remat tag consumed by the 'block_outs' checkpoint policy (the
+    # default; meta/inner.py § _remat_policy) — without it that policy
+    # would silently save nothing for this backbone.
+    x = checkpoint_name(x, "block_out")
     return x, new_state
 
 

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.models import layers
@@ -50,13 +51,18 @@ def _features_apply(cfg: MAMLConfig, params: Params, state: State,
         norm_kwargs = {}
         if cfg.norm_layer == "batch_norm":
             norm_kwargs = dict(momentum=cfg.batch_norm_momentum,
-                               eps=cfg.batch_norm_eps)
+                               eps=cfg.batch_norm_eps,
+                               fast_math=cfg.bn_fast_math)
         x, new_state[f"norm{i}"] = norm_apply(
             params[f"norm{i}"], state[f"norm{i}"], x, step,
             training=training, **norm_kwargs)
         x = jax.nn.relu(x)
         if cfg.max_pooling:
             x = layers.max_pool2d(x)
+        # Remat tag: the 'block_outs' policy saves these pooled (4x
+        # smaller) stage outputs so the outer backward restarts each
+        # stage's recompute from its input instead of the image.
+        x = checkpoint_name(x, "block_out")
     return x.reshape(x.shape[0], -1), new_state
 
 

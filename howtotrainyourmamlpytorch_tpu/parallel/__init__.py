@@ -6,8 +6,16 @@ from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
     replicated_sharding,
     shard_batch,
 )
+from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
+    assemble_global_batch,
+    barrier,
+    initialize_distributed,
+    local_batch_positions,
+)
 
 __all__ = [
     "MeshPlan", "batch_sharding", "make_mesh", "make_sharded_steps",
     "replicated_sharding", "shard_batch",
+    "assemble_global_batch", "barrier", "initialize_distributed",
+    "local_batch_positions",
 ]

@@ -108,8 +108,9 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
     eval_step = jax.jit(
         make_eval_step(cfg, apply_fn),
         in_shardings=(repl, bsh),
-        # Per-task outputs come back task-sharded; the experiment loop
-        # gathers them host-side for the ensemble protocol.
-        out_shardings=bsh,
+        # Replicated outputs: the trailing all-gather (tiny per-task
+        # scalars + logits) makes every host able to device_get the full
+        # result — required for multi-host, harmless single-host.
+        out_shardings=repl,
     )
     return MeshPlan(mesh=mesh, train_steps=train_steps, eval_step=eval_step)

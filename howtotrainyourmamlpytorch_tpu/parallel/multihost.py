@@ -1,0 +1,147 @@
+"""Multi-host distributed runtime: process bootstrap + host-sharded feeding.
+
+Reference equivalent: none — the reference is strictly single-node
+(``nn.DataParallel``; SURVEY.md §5 "Distributed communication backend").
+This module is the upgrade that makes the (dcn, tasks) mesh span hosts:
+
+  * :func:`initialize_distributed` — ``jax.distributed.initialize`` wrapper
+    (JAX's PJRT/coordination-service bootstrap, the NCCL-process-group
+    equivalent). After it returns, ``jax.devices()`` is the *global* device
+    list and every jitted step with sharding annotations runs SPMD across
+    hosts; meta-gradient means psum over ICI within a slice and DCN across
+    slices with no further code changes.
+  * :func:`local_batch_positions` / :func:`assemble_global_batch` — each
+    process samples ONLY the episodes that land on its own chips, then the
+    per-device shards are stitched into a global ``jax.Array``
+    (``make_array_from_single_device_arrays``). The deterministic episode
+    streams (data/sampler.py) make this coordination-free: position ``i`` of
+    outer-batch ``b`` is episode index ``b·B + i`` on every host, so hosts
+    agree on the global batch without exchanging a byte.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+
+_ENV_COORD = "JAX_COORDINATOR_ADDRESS"
+_ENV_NPROC = "JAX_NUM_PROCESSES"
+_ENV_PID = "JAX_PROCESS_ID"
+_ENV_AUTO = "JAX_AUTO_DISTRIBUTED"
+
+
+def _already_initialized() -> bool:
+    """Whether the JAX coordination service is already up — probed WITHOUT
+    touching ``jax.devices()``/``process_count()``, which would instantiate
+    backends and make a later ``jax.distributed.initialize`` call illegal."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client is not None
+    except Exception:
+        return False
+
+
+def initialize_distributed() -> bool:
+    """Bootstrap multi-process JAX if the environment asks for it.
+
+    Two launch modes (checked BEFORE any backend/device query — calling
+    ``jax.distributed.initialize`` after backends exist is an error):
+
+    * Explicit: ``JAX_COORDINATOR_ADDRESS`` + ``JAX_NUM_PROCESSES`` +
+      ``JAX_PROCESS_ID`` env trio (one process per host started by a
+      cluster scheduler).
+    * Auto-detect: set ``JAX_AUTO_DISTRIBUTED=1`` on a Cloud TPU pod and
+      ``jax.distributed.initialize()`` fills everything in from the TPU
+      metadata server.
+
+    Single-process runs (none of the env vars set) are a no-op.
+    Returns True iff running multi-process after the call.
+    """
+    if _already_initialized():
+        return jax.process_count() > 1
+    coord = os.environ.get(_ENV_COORD)
+    if coord:
+        missing = [v for v in (_ENV_NPROC, _ENV_PID)
+                   if v not in os.environ]
+        if missing:
+            raise RuntimeError(
+                f"{_ENV_COORD} is set but {', '.join(missing)} "
+                f"missing; explicit multi-host launch needs all of "
+                f"{_ENV_COORD}, {_ENV_NPROC}, {_ENV_PID}")
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ[_ENV_NPROC]),
+            process_id=int(os.environ[_ENV_PID]),
+        )
+        return jax.process_count() > 1
+    if os.environ.get(_ENV_AUTO, "").lower() in ("1", "true", "yes"):
+        jax.distributed.initialize()  # pod metadata auto-detection
+        return jax.process_count() > 1
+    return False
+
+
+def barrier(tag: str) -> None:
+    """Cross-process barrier (no-op single-process).
+
+    Used to order shared-filesystem effects: process 0 writes (checkpoint,
+    dataset extraction), everyone barriers, then all processes read.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(tag)
+
+
+def local_batch_positions(sharding: NamedSharding,
+                          batch_size: int) -> List[Tuple[jax.Device, int, int]]:
+    """Per-addressable-device contiguous [start, stop) slices of the global
+    batch axis (axis 0) under ``sharding``.
+
+    The batch axis is sharded over the whole mesh (parallel/mesh.py §
+    batch_sharding), so each device owns one contiguous run of task
+    positions; a process feeds exactly the union of its devices' runs.
+    """
+    index_map = sharding.addressable_devices_indices_map((batch_size,))
+    out: List[Tuple[jax.Device, int, int]] = []
+    for dev, idx in index_map.items():
+        sl = idx[0]
+        start = 0 if sl.start is None else int(sl.start)
+        stop = batch_size if sl.stop is None else int(sl.stop)
+        out.append((dev, start, stop))
+    out.sort(key=lambda t: t[1])
+    return out
+
+
+def assemble_global_batch(
+        sample_range: Callable[[int, int], Episode],
+        batch_size: int,
+        sharding: NamedSharding,
+        positions: Sequence[Tuple[jax.Device, int, int]] = None) -> Episode:
+    """Build a globally-sharded Episode by sampling only local positions.
+
+    ``sample_range(start, stop)`` returns a host Episode for global batch
+    positions [start, stop) (leaves shaped ``(stop-start, ...)``). Each
+    per-device shard is placed on its device and the shards are declared as
+    one global array of leading dimension ``batch_size``. Pass a
+    precomputed ``positions`` (from :func:`local_batch_positions`) when
+    assembling many batches — the slice map is loop-invariant.
+    """
+    slices = (local_batch_positions(sharding, batch_size)
+              if positions is None else positions)
+    per_device = [(dev, sample_range(start, stop))
+                  for dev, start, stop in slices]
+
+    def leaf(field: str) -> jax.Array:
+        shards = [jax.device_put(np.asarray(getattr(ep, field)), dev)
+                  for dev, ep in per_device]
+        trailing = shards[0].shape[1:]
+        return jax.make_array_from_single_device_arrays(
+            (batch_size,) + trailing, sharding, shards)
+
+    return Episode(leaf("support_x"), leaf("support_y"),
+                   leaf("target_x"), leaf("target_y"))

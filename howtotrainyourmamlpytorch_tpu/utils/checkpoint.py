@@ -48,29 +48,36 @@ class CheckpointManager:
 
     # -- save -----------------------------------------------------------
     def save(self, state, epoch: int, current_iter: int,
-             val_acc: float) -> None:
+             val_acc: float, write: bool = True) -> None:
         """Write the epoch checkpoint + latest, update bookkeeping, prune
-        checkpoints outside the top ``max_to_keep`` by val accuracy."""
-        state = jax.device_get(state)
-        data = serialization.to_bytes(state)
-        epoch_path = self._ckpt_path(epoch)
-        tmp = epoch_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, epoch_path)
-        # 'latest' is a hard link to the epoch file (atomic via tmp link +
-        # rename) — one full write per save instead of two. Filesystems
-        # without hard links (gcsfuse, some NFS/overlay mounts) fall back
-        # to a second full write.
-        latest_tmp = self._ckpt_path(LATEST) + ".tmp"
-        if os.path.exists(latest_tmp):
-            os.remove(latest_tmp)
-        try:
-            os.link(epoch_path, latest_tmp)
-        except OSError:
-            with open(latest_tmp, "wb") as f:
+        checkpoints outside the top ``max_to_keep`` by val accuracy.
+
+        ``write=False`` (multi-host non-zero processes) updates only the
+        in-memory bookkeeping — every process needs ``top_epochs`` for the
+        ensemble test protocol, but exactly one may touch the shared
+        filesystem.
+        """
+        if write:
+            state = jax.device_get(state)
+            data = serialization.to_bytes(state)
+            epoch_path = self._ckpt_path(epoch)
+            tmp = epoch_path + ".tmp"
+            with open(tmp, "wb") as f:
                 f.write(data)
-        os.replace(latest_tmp, self._ckpt_path(LATEST))
+            os.replace(tmp, epoch_path)
+            # 'latest' is a hard link to the epoch file (atomic via tmp
+            # link + rename) — one full write per save instead of two.
+            # Filesystems without hard links (gcsfuse, some NFS/overlay
+            # mounts) fall back to a second full write.
+            latest_tmp = self._ckpt_path(LATEST) + ".tmp"
+            if os.path.exists(latest_tmp):
+                os.remove(latest_tmp)
+            try:
+                os.link(epoch_path, latest_tmp)
+            except OSError:
+                with open(latest_tmp, "wb") as f:
+                    f.write(data)
+            os.replace(latest_tmp, self._ckpt_path(LATEST))
 
         self.meta["current_iter"] = int(current_iter)
         self.meta["current_epoch"] = int(epoch)
@@ -79,8 +86,9 @@ class CheckpointManager:
         if val_acc >= self.meta["best_val_acc"]:
             self.meta["best_val_acc"] = float(val_acc)
             self.meta["best_val_epoch"] = int(epoch)
-        self._prune()
-        save_to_json(self._meta_path, self.meta)
+        if write:
+            self._prune()
+            save_to_json(self._meta_path, self.meta)
 
     def _prune(self) -> None:
         keep = {int(e) for e in self.top_epochs(self.max_to_keep)}
@@ -117,7 +125,7 @@ class CheckpointManager:
                 meta["current_epoch"] = int(tag)
         return state, meta
 
-    def rewind_to(self, epoch: int) -> None:
+    def rewind_to(self, epoch: int, write: bool = True) -> None:
         """Discard bookkeeping newer than ``epoch`` (for
         ``continue_from_epoch=<int>`` rewinds): later epochs' val
         accuracies must not feed the top-k ensemble once retraining
@@ -138,7 +146,8 @@ class CheckpointManager:
         else:
             self.meta["best_val_acc"] = 0.0
             self.meta["best_val_epoch"] = -1
-        save_to_json(self._meta_path, self.meta)
+        if write:
+            save_to_json(self._meta_path, self.meta)
 
     # -- queries ---------------------------------------------------------
     def top_epochs(self, k: Optional[int] = None) -> List[int]:

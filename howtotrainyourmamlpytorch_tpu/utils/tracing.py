@@ -33,9 +33,13 @@ class JsonlLogger:
     are coerced via ``float``/``int`` fallback.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, enabled: bool = True):
+        """``enabled=False`` keeps the logger callable but writes nothing —
+        multi-host runs disable every process but 0 (single-writer)."""
         self.path = path
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.enabled = enabled
+        if enabled:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
     @staticmethod
     def _coerce(value: Any) -> Any:
@@ -57,8 +61,9 @@ class JsonlLogger:
     def log(self, event: str, **payload: Any) -> Dict[str, Any]:
         row = {"ts": time.time(), "event": event,
                **{k: self._coerce(v) for k, v in payload.items()}}
-        with open(self.path, "a") as f:
-            f.write(json.dumps(row) + "\n")
+        if self.enabled:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(row) + "\n")
         return row
 
 

@@ -1,0 +1,119 @@
+"""Component-level timing: where does a train step's time go?
+
+Times (a) plain model forward, (b) forward+backward wrt fast weights,
+(c) one full inner step chain without outer grad, (d) full train step —
+on the flagship bench shapes. Used to target kernel-level optimization.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import flagship_config, synthetic_batch
+from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+from howtotrainyourmamlpytorch_tpu.meta.inner import task_forward
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.ops.losses import cross_entropy
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    make_mesh, make_sharded_steps, shard_batch)
+
+
+def timeit(fn, *args, n=10):
+    for _ in range(2):
+        out = fn(*args)
+        jax.tree.map(lambda x: x.block_until_ready(), out)
+        _ = float(np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+        _ = float(np.asarray(jax.device_get(jax.tree.leaves(out)[0])).ravel()[0])
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    cfg = flagship_config(16, 1)
+    init, apply = make_model(cfg)
+    params, bn_state = init(jax.random.PRNGKey(0))
+    ep = synthetic_batch(cfg, 0)
+    b = cfg.batch_size
+    # All support images of the meta-batch as one conv batch (what vmap
+    # effectively gives the convs).
+    xs = jnp.asarray(ep.support_x.reshape(-1, *cfg.image_shape))
+    ys = jnp.asarray(ep.support_y.reshape(-1))
+
+    @jax.jit
+    def fwd(params, bn_state, x):
+        logits, _ = apply(params, bn_state, x, jnp.int32(0), True)
+        return logits
+
+    @jax.jit
+    def fwd_bwd(params, bn_state, x, y):
+        def loss_fn(p):
+            logits, _ = apply(p, bn_state, x, jnp.int32(0), True)
+            return cross_entropy(logits, y)
+        return jax.value_and_grad(loss_fn)(p := params)[0], None
+
+    t_fwd = timeit(lambda: fwd(params, bn_state, xs), n=20)
+    t_fb = timeit(lambda: fwd_bwd(params, bn_state, xs, ys), n=20)
+    print(json.dumps({"what": f"forward {xs.shape[0]} imgs",
+                      "ms": round(t_fwd * 1e3, 2)}), flush=True)
+    print(json.dumps({"what": f"fwd+bwd {xs.shape[0]} imgs",
+                      "ms": round(t_fb * 1e3, 2)}), flush=True)
+
+    # Inner adaptation only (no outer grad), vmapped over tasks.
+    from howtotrainyourmamlpytorch_tpu.meta.inner import lslr_init, split_fast_slow
+    fast0, _ = split_fast_slow(cfg, params)
+    lslr = lslr_init(cfg, fast0)
+    ep_dev = jax.device_put(ep)
+
+    @jax.jit
+    def inner_only(params, lslr, bn_state, batch):
+        def one(task_ep):
+            return task_forward(cfg, apply, params, lslr, bn_state, task_ep,
+                                num_steps=5, second_order=False,
+                                use_msl=False, msl_weights=None).loss
+        return jnp.mean(jax.vmap(one)(batch))
+
+    t_inner = timeit(lambda: inner_only(params, lslr, bn_state, ep_dev), n=5)
+    print(json.dumps({"what": f"inner K=5 x {b} tasks, first-order, no outer",
+                      "ms": round(t_inner * 1e3, 2),
+                      "tasks_per_s": round(b / t_inner, 1)}), flush=True)
+
+    # Full sharded train step (second-order + MSL).
+    mesh = make_mesh(cfg, jax.devices()[:1])
+    plan = make_sharded_steps(cfg, apply, mesh)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    state = jax.device_put(
+        state, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    epb = shard_batch(synthetic_batch(cfg, 0), mesh)
+
+    def full(state):
+        s2, m = plan.train_steps[(True, True)](state, epb, jnp.float32(20.0))
+        return s2, m
+
+    # manual timing to thread state
+    for _ in range(3):
+        state, m = full(state)
+        float(jax.device_get(m.loss))
+    t0 = time.perf_counter()
+    n = 10
+    for _ in range(n):
+        state, m = full(state)
+        float(jax.device_get(m.loss))
+    t_full = (time.perf_counter() - t0) / n
+    print(json.dumps({"what": f"full train step (2nd order + MSL), {b} tasks",
+                      "ms": round(t_full * 1e3, 2),
+                      "tasks_per_s": round(b / t_full, 1)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

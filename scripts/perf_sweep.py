@@ -1,0 +1,87 @@
+"""Perf sweep over remat/unroll/batch on the flagship bench workload.
+
+Usage: python scripts/perf_sweep.py [--steps N]
+Prints one JSON line per variant; used to pick bench.py's defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import flagship_config, synthetic_batch
+from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    make_mesh, make_sharded_steps, shard_batch)
+
+
+def run_variant(batch, remat, policy, unroll, steps):
+    n_dev = len(jax.devices())
+    cfg = flagship_config(batch * n_dev, n_dev).replace(
+        remat_inner_steps=remat, remat_policy=policy, inner_unroll=unroll)
+    init, apply = make_model(cfg)
+    mesh = make_mesh(cfg, jax.devices())
+    plan = make_sharded_steps(cfg, apply, mesh)
+    train = plan.train_steps[(True, True)]
+    state = jax.device_put(
+        init_train_state(cfg, init, jax.random.PRNGKey(0)),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+    ep = shard_batch(synthetic_batch(cfg, 0), mesh)
+    epoch = jnp.float32(20.0)
+    for _ in range(3):
+        state, m = train(state, ep, epoch)
+        float(jax.device_get(m.loss))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = train(state, ep, epoch)
+        float(jax.device_get(m.loss))
+    dt = time.perf_counter() - t0
+    if not np.isfinite(float(jax.device_get(m.loss))):
+        raise RuntimeError("non-finite loss")
+    return cfg.batch_size * steps / dt / n_dev
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+    grid = [
+        # (batch/chip, remat, policy, unroll)
+        (16, True, "nothing", 1),   # current default
+        (16, True, "conv_outs", 1),
+        (16, True, "dots", 1),
+        (16, False, "nothing", 1),  # no remat at all
+        (16, True, "nothing", 5),
+        (16, False, "nothing", 5),
+        (32, True, "nothing", 1),
+        (32, False, "nothing", 1),
+        (32, True, "conv_outs", 1),
+        (64, True, "nothing", 1),
+    ]
+    for batch, remat, policy, unroll in grid:
+        try:
+            v = run_variant(batch, remat, policy, unroll, args.steps)
+            print(json.dumps({"batch_per_chip": batch, "remat": remat,
+                              "policy": policy, "unroll": unroll,
+                              "tasks_per_sec_per_chip": round(v, 2)}),
+                  flush=True)
+        except Exception as e:
+            print(json.dumps({"batch_per_chip": batch, "remat": remat,
+                              "policy": policy, "unroll": unroll,
+                              "error": str(e)[:200]}), flush=True)
+            traceback.print_exc()
+
+
+if __name__ == "__main__":
+    main()

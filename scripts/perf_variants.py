@@ -1,0 +1,72 @@
+"""Full-train-step timing across optimization variants (pipelined timing).
+
+Variants: bn_fast_math on/off x remat policy. Used to pick shipped defaults.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import flagship_config, synthetic_batch
+from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    make_mesh, make_sharded_steps, replicated_sharding, shard_batch)
+
+
+def run_variant(cfg, steps):
+    init, apply = make_model(cfg)
+    mesh = make_mesh(cfg, jax.devices()[:1])
+    plan = make_sharded_steps(cfg, apply, mesh)
+    train = plan.train_steps[(True, True)]
+    state = jax.device_put(
+        init_train_state(cfg, init, jax.random.PRNGKey(0)),
+        replicated_sharding(mesh))
+    ep = shard_batch(synthetic_batch(cfg, 0), mesh)
+    epoch = jnp.float32(20.0)
+    for _ in range(3):
+        state, m = train(state, ep, epoch)
+        float(jax.device_get(m.loss))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = train(state, ep, epoch)
+    loss = float(jax.device_get(m.loss))
+    dt = time.perf_counter() - t0
+    if not np.isfinite(loss):
+        raise RuntimeError(f"non-finite loss {loss}")
+    return cfg.batch_size * steps / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args()
+    grid = [
+        dict(bn_fast_math=False, remat_policy="nothing"),   # shipped today
+        dict(bn_fast_math=True, remat_policy="nothing"),
+        dict(bn_fast_math=False, remat_policy="block_outs"),
+        dict(bn_fast_math=True, remat_policy="block_outs"),
+    ]
+    for over in grid:
+        cfg = flagship_config(args.batch, 1).replace(**over)
+        try:
+            v = run_variant(cfg, args.steps)
+            print(json.dumps({**over, "tasks_per_sec_per_chip": round(v, 2)}),
+                  flush=True)
+        except Exception as e:
+            print(json.dumps({**over, "error": str(e)[:160]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -131,3 +131,40 @@ def test_xavier_uniform_bounds():
     assert np.all(np.abs(w) <= limit)
     assert np.abs(w).max() > 0.8 * limit  # actually fills the range
     assert np.all(np.asarray(params["b"]) == 0)
+
+
+def test_batch_norm_fast_math_close_to_f32_path():
+    """fast_math folds stats into scale/shift applied in x.dtype; on f32
+    inputs it must agree with the reference path to float tolerance, and
+    running-stat updates must be identical math."""
+    params, state = layers.batch_norm_init(4, 3)
+    x = _rand(jax.random.PRNGKey(3), (8, 5, 5, 4)) * 3.0 + 1.5
+    y_ref, st_ref = layers.batch_norm_apply(params, state, x, jnp.int32(1),
+                                            training=True)
+    y_fast, st_fast = layers.batch_norm_apply(params, state, x, jnp.int32(1),
+                                              training=True, fast_math=True)
+    np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(st_fast["mean"]),
+                               np.asarray(st_ref["mean"]), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(st_fast["var"]),
+                               np.asarray(st_ref["var"]), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_batch_norm_fast_math_grads_close():
+    """Second-order-relevant: gradients through the fast_math path agree
+    with the f32 path (both are plain jnp ops, differentiable twice)."""
+    params, state = layers.batch_norm_init(4, 2)
+    x = _rand(jax.random.PRNGKey(4), (6, 3, 3, 4))
+
+    def loss(x, fast):
+        y, _ = layers.batch_norm_apply(params, state, x, jnp.int32(0),
+                                       training=True, fast_math=fast)
+        return jnp.sum(y ** 2)
+
+    g_ref = jax.grad(lambda x: loss(x, False))(x)
+    g_fast = jax.grad(lambda x: loss(x, True))(x)
+    np.testing.assert_allclose(np.asarray(g_fast), np.asarray(g_ref),
+                               rtol=1e-3, atol=1e-4)

@@ -186,3 +186,39 @@ def test_grad_clamp_applied():
              for a, b in zip(jax.tree.leaves(p_none),
                              jax.tree.leaves(p_tight))]
     assert max(diffs) > 0
+
+
+def test_block_outs_remat_and_fast_bn_match_default_grads():
+    """The perf variants (remat_policy='block_outs', bn_fast_math) must not
+    change the meta-gradient. Gradients are compared directly — comparing
+    post-Adam params would amount to a sign test (Adam's first update is
+    ±lr for any nonzero grad), infinitely sensitive at near-zero grads."""
+    from howtotrainyourmamlpytorch_tpu.meta.inner import (
+        lslr_init, per_step_loss_importance, split_fast_slow, task_forward)
+
+    batch = _synthetic_batch(jax.random.PRNGKey(9), CFG, 4)
+
+    def meta_grads(cfg):
+        init, apply = make_model(cfg)
+        params, bn_state = init(jax.random.PRNGKey(0))
+        fast0, _ = split_fast_slow(cfg, params)
+        lslr = lslr_init(cfg, fast0)
+        msl_w = per_step_loss_importance(cfg, jnp.float32(0))
+
+        def loss_fn(params):
+            def one(ep):
+                return task_forward(
+                    cfg, apply, params, lslr, bn_state, ep,
+                    num_steps=cfg.number_of_training_steps_per_iter,
+                    second_order=True, use_msl=True,
+                    msl_weights=msl_w).loss
+            return jnp.mean(jax.vmap(one)(batch))
+
+        return jax.jit(jax.grad(loss_fn))(params)
+
+    g_ref = meta_grads(CFG)
+    g_var = meta_grads(CFG.replace(remat_policy="block_outs",
+                                   bn_fast_math=True))
+    for (p1, p2) in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_var)):
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                                   rtol=5e-3, atol=1e-5)

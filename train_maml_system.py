@@ -82,10 +82,27 @@ def get_args(argv=None) -> MAMLConfig:
 
 def main(argv=None) -> int:
     cfg = get_args(argv)
+    # Multi-host bootstrap (no-op single-process); must run before any
+    # device query so jax.devices() is the global pod device list.
+    from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
+    multihost = initialize_distributed()
     print(f"experiment: {cfg.experiment_name} | dataset: "
           f"{cfg.dataset_name} | {cfg.num_classes_per_set}-way "
-          f"{cfg.num_samples_per_class}-shot | mesh {cfg.mesh_shape}")
-    maybe_unzip_dataset(cfg)  # reference entry behavior; synthetic fallback
+          f"{cfg.num_samples_per_class}-shot | mesh {cfg.mesh_shape}"
+          + (f" | multihost: {multihost}" if multihost else ""))
+    # Dataset provisioning: single extractor (process 0), everyone waits —
+    # concurrent unzip into a shared dataset dir would corrupt it. The
+    # barrier sits in a finally so a provisioning failure on process 0
+    # still releases the other hosts (they fail on the missing data)
+    # instead of deadlocking them at the barrier.
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.parallel import barrier
+    try:
+        if jax.process_index() == 0:
+            maybe_unzip_dataset(cfg)  # reference behavior; synthetic fallback
+    finally:
+        barrier("dataset_ready")
     builder = ExperimentBuilder(cfg)
     builder.run_experiment()
     return 0
