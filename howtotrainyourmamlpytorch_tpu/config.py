@@ -112,6 +112,10 @@ class MAMLConfig:
     param_dtype: str = "float32"
     bn_fast_math: bool = False             # fold BN stats into a bf16
                                            # scale/shift (stats stay f32)
+    bn_backend: str = "composite"          # 'composite' (XLA) | 'pallas'
+                                           # (fused BN+ReLU kernel; fast_math
+                                           # numerics; best when channels %
+                                           # 128 == 0)
     remat_inner_steps: bool = True         # jax.checkpoint per inner step
     remat_policy: str = "block_outs"       # 'nothing' | 'dots' | 'conv_outs'
                                            # | 'block_outs' (default: saves
@@ -135,6 +139,8 @@ class MAMLConfig:
     def __post_init__(self) -> None:
         if self.norm_layer not in ("batch_norm", "layer_norm"):
             raise ValueError(f"unknown norm_layer {self.norm_layer!r}")
+        if self.bn_backend not in ("composite", "pallas"):
+            raise ValueError(f"unknown bn_backend {self.bn_backend!r}")
         if self.backbone not in ("vgg", "resnet12"):
             raise ValueError(f"unknown backbone {self.backbone!r}")
         if self.num_classes_per_set < 2:

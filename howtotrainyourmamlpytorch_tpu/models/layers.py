@@ -214,6 +214,43 @@ def layer_norm_apply(params: Params, state: State, x: jax.Array,
     return y.astype(x.dtype), state
 
 
+def fused_batch_norm_relu_apply(
+        params: Params, state: State, x: jax.Array, step: jax.Array, *,
+        training: bool, momentum: float = 0.1, eps: float = 1e-5,
+        interpret: bool = False) -> Tuple[jax.Array, State]:
+    """Per-step BN + ReLU through the Pallas fused kernel
+    (ops/pallas_fused.py) — numerics of the ``fast_math`` path, ReLU
+    included (callers must NOT apply their own ReLU after this).
+
+    Opt-in via config ``bn_backend='pallas'``. Measured on v5e: slower
+    than XLA's composite for C=48 (the lane repack is a real relayout of
+    (8,128)-tiled memory), roughly break-even-or-better when C is a
+    multiple of 128 (repack becomes a free reshape) — see the module
+    docstring of ops/pallas_fused.py for numbers.
+    """
+    from howtotrainyourmamlpytorch_tpu.ops.pallas_fused import fused_bn_relu
+
+    num_steps = params["gamma"].shape[0]
+    idx = jnp.clip(step, 0, num_steps - 1)
+    gamma = jnp.take(params["gamma"], idx, axis=0)
+    beta = jnp.take(params["beta"], idx, axis=0)
+
+    y, mean, var = fused_bn_relu(x, gamma, beta, eps, interpret)
+
+    axes = tuple(range(x.ndim - 1))
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    unbiased = var * (n / max(n - 1, 1))
+    new_state = {
+        "mean": state["mean"].at[idx].set(
+            (1.0 - momentum) * state["mean"][idx] + momentum * mean),
+        "var": state["var"].at[idx].set(
+            (1.0 - momentum) * state["var"][idx] + momentum * unbiased),
+    }
+    return y, new_state
+
+
 def max_pool2d(x: jax.Array, window: int = 2, stride: int = 2) -> jax.Array:
     """2x2 max pool, NHWC, VALID padding (torch F.max_pool2d default:
     floor)."""

@@ -77,6 +77,13 @@ def make_resnet12(cfg: MAMLConfig):
     """Build (init, apply) for ResNet-12 described by ``cfg``."""
     if cfg.norm_layer != "batch_norm":
         raise ValueError("resnet12 backbone supports norm_layer='batch_norm'")
+    if cfg.bn_backend != "composite":
+        # The fused Pallas kernel bakes in plain ReLU; this backbone's
+        # norms are followed by leaky-relu (or nothing, on the skip
+        # branch), so silently accepting the flag would measure nothing.
+        raise ValueError("bn_backend='pallas' is not supported by the "
+                         "resnet12 backbone (leaky-relu activations); "
+                         "use the default composite backend")
     h, w, c = cfg.image_shape
     widths = _block_widths(cfg)
     num_steps = cfg.bn_num_steps

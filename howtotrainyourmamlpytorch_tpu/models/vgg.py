@@ -44,19 +44,28 @@ def _features_apply(cfg: MAMLConfig, params: Params, state: State,
     new_state: State = {}
     stride = 1 if cfg.max_pooling else 2
     padding = "SAME" if cfg.conv_padding else "VALID"
+    use_pallas_bn = (cfg.norm_layer == "batch_norm"
+                     and cfg.bn_backend == "pallas")
     for i in range(cfg.num_stages):
         x = layers.conv2d_apply(params[f"conv{i}"], x, stride=stride,
                                 padding=padding,
                                 compute_dtype=compute_dtype)
-        norm_kwargs = {}
-        if cfg.norm_layer == "batch_norm":
-            norm_kwargs = dict(momentum=cfg.batch_norm_momentum,
-                               eps=cfg.batch_norm_eps,
-                               fast_math=cfg.bn_fast_math)
-        x, new_state[f"norm{i}"] = norm_apply(
-            params[f"norm{i}"], state[f"norm{i}"], x, step,
-            training=training, **norm_kwargs)
-        x = jax.nn.relu(x)
+        if use_pallas_bn:
+            # Kernel fuses the ReLU; do not reapply it.
+            x, new_state[f"norm{i}"] = layers.fused_batch_norm_relu_apply(
+                params[f"norm{i}"], state[f"norm{i}"], x, step,
+                training=training, momentum=cfg.batch_norm_momentum,
+                eps=cfg.batch_norm_eps)
+        else:
+            norm_kwargs = {}
+            if cfg.norm_layer == "batch_norm":
+                norm_kwargs = dict(momentum=cfg.batch_norm_momentum,
+                                   eps=cfg.batch_norm_eps,
+                                   fast_math=cfg.bn_fast_math)
+            x, new_state[f"norm{i}"] = norm_apply(
+                params[f"norm{i}"], state[f"norm{i}"], x, step,
+                training=training, **norm_kwargs)
+            x = jax.nn.relu(x)
         if cfg.max_pooling:
             x = layers.max_pool2d(x)
         # Remat tag: the 'block_outs' policy saves these pooled (4x

@@ -1,0 +1,236 @@
+"""Pallas TPU kernel: fused batch-norm (batch statistics) + affine + ReLU.
+
+Why a kernel: the backbone's channel count (48) occupies 48/128 VPU lanes in
+the natural NHWC layout, so XLA's elementwise BN chain wastes ~62% of vector
+throughput — measured as the dominant cost of the flagship forward (the
+convs' MXU work is comparatively small; see scripts/perf_bisect.py). The
+kernel repacks the tensor so the lane dimension is ``lcm(C, 128)`` (384 for
+C=48: 3 full 128-lane registers, zero padding waste) and fuses the whole
+stats → normalize → scale/shift → ReLU chain into one two-phase pass:
+
+  phase 0  stream x blocks, accumulate per-lane-position sum / sum-of-squares
+           in VMEM scratch (f32);
+  phase 1  fold the per-position partials into per-channel statistics with
+           lane rolls (position l and l+48k share a channel; summing 8 rolls
+           broadcasts each channel's total back to every position — no
+           lane-gather needed), compute folded scale/shift once, then stream
+           x again writing ``relu(x·scale+shift)``.
+
+TPU grids execute sequentially on a core, which is what makes the two-phase
+single-kernel design sound (phase 1 sees phase 0's scratch).
+
+Differentiation: the public entry :func:`fused_bn_relu` carries a
+``jax.custom_jvp`` whose tangent rule is plain jnp math on the primal
+outputs — differentiable again, so the second-order meta-gradients of the
+MAML++ objective (SURVEY.md §2.2) compose through it; the kernel accelerates
+every primal forward (including remat recomputes) while backward math stays
+in XLA.
+
+Numerics match the ``bn_fast_math`` composite path exactly (f32 statistics
+via E[x²]−E[x]², clamped; scale/shift rounded to and applied in x.dtype —
+including on bfloat16 inputs), NOT the bit-exact f32 reference path — both
+are opt-in performance modes (config ``bn_backend``).
+
+Measured (v5e, 400×84×84×48 bf16): the kernel runs ~2x slower than XLA's
+fused composite for C=48 because the lane repack to width 384 is a real
+relayout of (8,128)-tiled memory. It is shipped as an opt-in backend; the
+repack is free when C % 128 == 0 (wider backbones), where the full-lane
+normalize pays off.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+_BM = 512          # rows per block (multiple of 8 f32 sublanes)
+_LANES = 128
+
+
+def _packed_width(c: int) -> int:
+    return c * _LANES // math.gcd(c, _LANES)   # lcm(c, 128)
+
+
+def supported(x_rows: int, c: int) -> bool:
+    """Whether the kernel handles this shape: the flat row count must fold
+    evenly into the packed width."""
+    return (x_rows * c) % _packed_width(c) == 0
+
+
+def _kernel(c: int, eps: float, x_ref, gamma_ref, beta_ref, count_ref,
+            y_ref, stats_ref, acc_ref, coef_ref):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    phase = pl.program_id(0)
+    b = pl.program_id(1)
+    p = gamma_ref.shape[-1]          # packed width (e.g. 384)
+    folds = p // c                   # positions per channel (e.g. 8)
+
+    @pl.when((phase == 0) & (b == 0))
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(phase == 0)
+    def _():
+        xf = x_ref[:].astype(jnp.float32)
+        acc_ref[0:1] = acc_ref[0:1] + jnp.sum(xf, axis=0, keepdims=True)
+        acc_ref[1:2] = acc_ref[1:2] + jnp.sum(xf * xf, axis=0,
+                                              keepdims=True)
+
+    @pl.when((phase == 1) & (b == 0))
+    def _():
+        s = acc_ref[0:1]
+        q = acc_ref[1:2]
+        tot_s, tot_q = s, q
+        for k in range(1, folds):
+            # Position l and (l+c·k) mod p hold the same channel; summing
+            # all rolls yields each channel's total, already broadcast to
+            # every position of that channel.
+            tot_s = tot_s + pltpu.roll(s, shift=c * k, axis=1)
+            tot_q = tot_q + pltpu.roll(q, shift=c * k, axis=1)
+        count = count_ref[0, 0]      # true per-channel element count
+        mean = tot_s / count
+        var = jnp.maximum(tot_q / count - mean * mean, 0.0)
+        inv = jax.lax.rsqrt(var + eps)
+        scale = inv * gamma_ref[:]
+        shift = beta_ref[:] - mean * scale
+        coef_ref[0:1] = scale
+        coef_ref[1:2] = shift
+        stats_ref[0:1] = mean
+        stats_ref[1:2] = var
+
+    @pl.when(phase == 1)
+    def _():
+        # Normalize in x's own dtype (scale/shift rounded to it first) —
+        # bit-matching the bn_fast_math composite path on bf16 inputs.
+        dt = x_ref.dtype
+        y = x_ref[:] * coef_ref[0:1].astype(dt) + coef_ref[1:2].astype(dt)
+        y_ref[:] = jnp.maximum(y, jnp.zeros((), dt))
+
+
+def _fused_call(x2: jax.Array, gamma_p: jax.Array, beta_p: jax.Array,
+                count: jax.Array, c: int, eps: float,
+                interpret: bool) -> Tuple[jax.Array, jax.Array]:
+    """Invoke the kernel on the packed (rows, p) view. Returns (y2, stats)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    # Mosaic targets TPU; on the CPU backend (tests, virtual meshes) run
+    # the interpreter instead of failing to lower.
+    interpret = interpret or jax.default_backend() == "cpu"
+
+    rows, p = x2.shape
+    nb = pl.cdiv(rows, _BM)
+    pad = nb * _BM - rows
+    if pad:
+        # Zero rows are neutral for sum/sumsq; count uses the true total.
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+
+    grid = (2, nb)
+    y2, stats = pl.pallas_call(
+        functools.partial(_kernel, c, eps),
+        out_shape=(
+            jax.ShapeDtypeStruct(x2.shape, x2.dtype),
+            jax.ShapeDtypeStruct((2, p), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BM, p), lambda ph, b: (b, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, p), lambda ph, b: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, p), lambda ph, b: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            # During phase 0 every step parks on block 0 so each real
+            # block's visits are contiguous (single fetch/flush).
+            pl.BlockSpec((_BM, p),
+                         lambda ph, b: (jnp.where(ph == 1, b, 0), 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, p), lambda ph, b: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, p), jnp.float32),
+            pltpu.VMEM((2, p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, gamma_p, beta_p, count)
+    if pad:
+        y2 = y2[:rows]
+    return y2, stats
+
+
+def _bn_relu_reference(x, gamma, beta, eps):
+    """jnp composite with identical numerics (fallback + tangent basis):
+    f32 statistics, scale/shift rounded to and applied in x.dtype — the
+    ``bn_fast_math`` recipe (models/layers.py § batch_norm_apply)."""
+    axes = tuple(range(x.ndim - 1))
+    mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+    mean_sq = jnp.mean(jax.lax.square(x.astype(jnp.float32)), axis=axes)
+    var = jnp.maximum(mean_sq - jax.lax.square(mean), 0.0)
+    inv = jax.lax.rsqrt(var + eps)
+    scale = (inv * gamma).astype(x.dtype)
+    shift = (beta - mean * inv * gamma).astype(x.dtype)
+    y = jnp.maximum(x * scale + shift, jnp.zeros((), x.dtype))
+    return y, mean, var
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(3, 4))
+def fused_bn_relu(x, gamma, beta, eps: float = 1e-5,
+                  interpret: bool = False):
+    """``relu(batch_norm(x)·gamma + beta)`` with batch statistics.
+
+    x: (..., C) — statistics over all leading axes. Returns
+    ``(y, mean, var)`` with mean/var f32 (biased var, as normalization
+    uses). Uses the Pallas kernel when the shape folds evenly into the
+    packed lane width; jnp composite otherwise.
+    """
+    c = x.shape[-1]
+    rows = math.prod(x.shape[:-1])
+    if not supported(rows, c):
+        return _bn_relu_reference(x, gamma, beta, eps)
+    p = _packed_width(c)
+    folds = p // c
+    x2 = x.reshape(rows * c // p, p)
+    gamma_p = jnp.tile(gamma.astype(jnp.float32), folds)[None, :]
+    beta_p = jnp.tile(beta.astype(jnp.float32), folds)[None, :]
+    # Per-channel element count, (1,1) f32 for SMEM.
+    count = jnp.full((1, 1), rows, jnp.float32)
+    y2, stats = _fused_call(x2, gamma_p, beta_p, count, c, eps, interpret)
+    return (y2.reshape(x.shape), stats[0, :c], stats[1, :c])
+
+
+@fused_bn_relu.defjvp
+def _fused_bn_relu_jvp(eps, interpret, primals, tangents):
+    """Tangent rule in plain jnp (differentiable again → second order OK).
+
+    The primal runs the kernel; tangents use the primal's mean/var and the
+    ReLU mask from the primal output.
+    """
+    x, gamma, beta = primals
+    dx, dgamma, dbeta = tangents
+    y, mean, var = fused_bn_relu(x, gamma, beta, eps, interpret)
+
+    axes = tuple(range(x.ndim - 1))
+    xf = x.astype(jnp.float32)
+    dxf = dx.astype(jnp.float32)
+    inv = jax.lax.rsqrt(var + eps)
+    dmean = jnp.mean(dxf, axis=axes)
+    # d var = E[2 x dx] − 2 E[x] dmean  (biased, matching E[x²]−E[x]²)
+    dvar = jnp.mean(2.0 * xf * dxf, axis=axes) - 2.0 * mean * dmean
+    dinv = -0.5 * inv * inv * inv * dvar
+    scale = inv * gamma
+    dscale = dinv * gamma + inv * dgamma
+    dshift = dbeta - dmean * scale - mean * dscale
+    dy_pre = dxf * scale + xf * dscale + dshift
+    mask = (y > 0).astype(jnp.float32)
+    dy = (dy_pre * mask).astype(y.dtype)
+    return (y, mean, var), (dy, dmean, dvar)

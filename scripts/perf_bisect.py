@@ -65,8 +65,12 @@ def main():
 
     def run_first(x, k, y):
         def step(carry, _):
+            # Feed a hair of the carry into the conv input so the conv is
+            # loop-VARIANT — otherwise XLA hoists it out of the while loop
+            # and dt/R measures only the carry mul-add.
+            xi = x + carry[..., :3] * jnp.bfloat16(1e-8)
             out = jax.lax.conv_general_dilated(
-                x, k, (1, 1), "SAME",
+                xi, k, (1, 1), "SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
             return out * jnp.bfloat16(0.01) + carry * jnp.bfloat16(0.5), ()
         out, _ = jax.lax.scan(step, y, None, length=R)
@@ -106,20 +110,7 @@ def main():
 
     timed(f"bn+relu bf16-norm 84x84x48 B={B}", run_bn_bf16, x, gamma, beta)
 
-    # --- max pool 2x2 ----------------------------------------------------
-    def run_pool(x):
-        def step(carry, _):
-            y = jax.lax.reduce_window(
-                carry, -jnp.inf, jax.lax.max,
-                (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
-            y4 = jnp.concatenate([y, y, y, y], axis=1)
-            return jnp.concatenate([y4, y4[:, :0]], axis=2).reshape(
-                carry.shape) * jnp.bfloat16(0.5) + carry * jnp.bfloat16(0.5), ()
-        out, _ = jax.lax.scan(step, x, None, length=R)
-        return out
-
-    # simpler: just time pool without carry-shape tricks (carry = input,
-    # output added via broadcast into a slice)
+    # --- max pool 2x2 (carry = input, pooled output added into a slice) --
     def run_pool2(x):
         def step(carry, _):
             y = jax.lax.reduce_window(

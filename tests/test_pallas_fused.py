@@ -1,0 +1,131 @@
+"""Pallas fused BN+ReLU kernel (ops/pallas_fused.py), interpret mode.
+
+The kernel's contract: identical numerics to the ``bn_fast_math`` composite
+(f32 stats via E[x²]−E[x]², normalize in x.dtype, fused ReLU) and full
+differentiability through ``jax.custom_jvp`` — including second order,
+which the MAML++ meta-gradient requires.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.models import layers, make_model
+from howtotrainyourmamlpytorch_tpu.ops.pallas_fused import (
+    _bn_relu_reference, fused_bn_relu, supported)
+
+
+@pytest.fixture(scope="module")
+def data():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (4, 4, 8, 48), jnp.float32) * 2 + 0.3
+    gamma = jnp.linspace(0.5, 1.5, 48)
+    beta = jnp.linspace(-0.2, 0.2, 48)
+    return x, gamma, beta
+
+
+def test_supported_shapes():
+    assert supported(4 * 4 * 8, 48)      # 128 rows x 48 folds into 384
+    assert not supported(5, 48)          # 240 flat elements % 384 != 0
+    assert supported(2, 128)             # c multiple of lanes: always
+
+
+def test_kernel_matches_composite(data):
+    x, gamma, beta = data
+    y_k, m_k, v_k = fused_bn_relu(x, gamma, beta, 1e-5, True)
+    y_r, m_r, v_r = _bn_relu_reference(x, gamma, beta, 1e-5)
+    np.testing.assert_allclose(np.asarray(y_k), np.asarray(y_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(m_k), np.asarray(m_r), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v_k), np.asarray(v_r), atol=1e-4)
+
+
+def test_kernel_gradients_match_composite(data):
+    x, gamma, beta = data
+
+    def loss_k(x, g, b):
+        return jnp.sum(fused_bn_relu(x, g, b, 1e-5, True)[0] ** 2)
+
+    def loss_r(x, g, b):
+        return jnp.sum(_bn_relu_reference(x, g, b, 1e-5)[0] ** 2)
+
+    gk = jax.grad(loss_k, argnums=(0, 1, 2))(x, gamma, beta)
+    gr = jax.grad(loss_r, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_kernel_second_order_matches_composite(data):
+    """grad-of-grad — what differentiating through the inner loop does."""
+    x, gamma, beta = data
+
+    def gn(loss):
+        return jax.grad(
+            lambda x: jnp.sum(jax.grad(loss)(x, gamma, beta) ** 2))(x)
+
+    h_k = gn(lambda x, g, b: jnp.sum(fused_bn_relu(x, g, b, 1e-5, True)[0]
+                                     ** 2))
+    h_r = gn(lambda x, g, b: jnp.sum(_bn_relu_reference(x, g, b, 1e-5)[0]
+                                     ** 2))
+    np.testing.assert_allclose(np.asarray(h_k), np.asarray(h_r),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_layer_level_matches_fast_math_plus_relu(data):
+    x, _, _ = data
+    params, state = layers.batch_norm_init(48, 3)
+    y_ref, st_ref = layers.batch_norm_apply(params, state, x, jnp.int32(1),
+                                            training=True, fast_math=True)
+    y_ref = jax.nn.relu(y_ref)
+    y_f, st_f = layers.fused_batch_norm_relu_apply(
+        params, state, x, jnp.int32(1), training=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(y_f), np.asarray(y_ref),
+                               atol=1e-5)
+    for k in ("mean", "var"):
+        np.testing.assert_allclose(np.asarray(st_f[k]),
+                                   np.asarray(st_ref[k]), atol=1e-4)
+
+
+def test_vgg_with_pallas_backend_runs_and_matches():
+    """Full model forward with bn_backend='pallas' stays close to the
+    fast_math composite model (same math, kernel execution)."""
+    cfg = MAMLConfig(image_height=16, image_width=16, image_channels=1,
+                     num_classes_per_set=3, num_samples_per_class=1,
+                     num_target_samples=1, cnn_num_filters=16, num_stages=2,
+                     compute_dtype="float32", bn_fast_math=True)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 1))
+
+    init, apply = make_model(cfg)
+    params, state = init(jax.random.PRNGKey(0))
+    logits_ref, _ = apply(params, state, x, jnp.int32(0), True)
+
+    cfg_p = cfg.replace(bn_backend="pallas")
+    _, apply_p = make_model(cfg_p)
+    logits_p, _ = apply_p(params, state, x, jnp.int32(0), True)
+    np.testing.assert_allclose(np.asarray(logits_p), np.asarray(logits_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_layer_level_matches_fast_math_bf16():
+    """The backend-equivalence contract in the dtype the flagship runs:
+    bf16 inputs, scale/shift rounded to bf16, normalize in bf16."""
+    key = jax.random.PRNGKey(5)
+    x = (jax.random.normal(key, (8, 4, 4, 48)) * 2).astype(jnp.bfloat16)
+    params, state = layers.batch_norm_init(48, 2)
+    y_ref, _ = layers.batch_norm_apply(params, state, x, jnp.int32(0),
+                                       training=True, fast_math=True)
+    y_ref = jax.nn.relu(y_ref)
+    y_f, _ = layers.fused_batch_norm_relu_apply(
+        params, state, x, jnp.int32(0), training=True, interpret=True)
+    assert y_f.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(y_f, np.float32),
+                                  np.asarray(y_ref, np.float32))
+
+
+def test_resnet12_rejects_pallas_backend():
+    cfg = MAMLConfig(backbone="resnet12", bn_backend="pallas",
+                     image_height=32, image_width=32, image_channels=3)
+    with pytest.raises(ValueError, match="resnet12"):
+        make_model(cfg)
