@@ -127,6 +127,10 @@ class MAMLConfig:
                                            # or 1; higher = more fusion across
                                            # inner steps, longer compiles)
     prefetch_batches: int = 2              # host->device prefetch depth
+    dispatch_sync_every: int = 50          # train iters between host->device
+                                           # syncs (bounds async run-ahead so
+                                           # SIGTERM preemption lands
+                                           # promptly; 0 = never)
     experiment_root: str = "experiments"
     profile_dir: Optional[str] = None      # jax.profiler trace output dir
     profile_epoch: int = 0                 # epoch whose first steps to trace
@@ -141,6 +145,11 @@ class MAMLConfig:
             raise ValueError(f"unknown norm_layer {self.norm_layer!r}")
         if self.bn_backend not in ("composite", "pallas"):
             raise ValueError(f"unknown bn_backend {self.bn_backend!r}")
+        if self.bn_backend == "pallas" and self.norm_layer != "batch_norm":
+            raise ValueError(
+                "bn_backend='pallas' requires norm_layer='batch_norm' "
+                "(the fused kernel IS a batch-norm; silently running the "
+                "layer-norm composite would measure nothing)")
         if self.backbone not in ("vgg", "resnet12"):
             raise ValueError(f"unknown backbone {self.backbone!r}")
         if self.num_classes_per_set < 2:

@@ -19,6 +19,7 @@ TPU-first notes:
 
 from __future__ import annotations
 
+import signal
 import time
 import warnings
 from typing import Any, Dict, Iterable, List, Optional, Tuple
@@ -89,6 +90,11 @@ class ExperimentBuilder:
         self.state = init_train_state(cfg, self.model_init,
                                       jax.random.PRNGKey(cfg.seed))
         self.current_iter = 0
+        # Preemption flag: set by the signal handler (installed around the
+        # training loop), checked once per train iteration. Multi-host,
+        # the stop decision is agreed across processes at sync boundaries.
+        self._preempted = False
+        self._multihost = jax.process_count() > 1
         if cfg.continue_from_epoch != "from_scratch":
             self._resume(cfg.continue_from_epoch)
         self.state = jax.device_put(self.state,
@@ -113,9 +119,14 @@ class ExperimentBuilder:
     def epoch(self) -> int:
         return self.current_iter // self.cfg.total_iter_per_epoch
 
-    def _train_epoch(self) -> Dict[str, float]:
+    def _train_epoch(self) -> Optional[Dict[str, float]]:
+        """Train to the next epoch boundary (a resumed run mid-epoch does
+        only the remainder — the reference's ``continue_from_iter``
+        contract). Returns None if preempted before the boundary."""
         cfg = self.cfg
         epoch = self.epoch
+        iters_left = (cfg.total_iter_per_epoch
+                      - self.current_iter % cfg.total_iter_per_epoch)
         step_fn = self.plan.train_steps[(cfg.use_second_order(epoch),
                                          cfg.use_msl(epoch))]
         metrics_acc = []
@@ -130,7 +141,7 @@ class ExperimentBuilder:
             prof.__enter__()
         try:
             for i, batch in enumerate(self.data.get_train_batches(
-                    self.current_iter, cfg.total_iter_per_epoch)):
+                    self.current_iter, iters_left)):
                 if prof is not None and i == cfg.profile_num_steps:
                     jax.block_until_ready(self.state.params)
                     prof.__exit__(None, None, None)
@@ -142,18 +153,46 @@ class ExperimentBuilder:
                 timer.tick()  # dispatch-interval under async execution;
                               # the epoch-end sync folds device time into
                               # the tail
+                if (cfg.dispatch_sync_every
+                        and (i + 1) % cfg.dispatch_sync_every == 0):
+                    # Bound async run-ahead: a scalar fetch fences the
+                    # dispatch queue so a SIGTERM can take effect within
+                    # ~dispatch_sync_every iterations instead of after the
+                    # whole epoch's queued work drains. Multi-host: the
+                    # stop decision is OR-agreed here so every process
+                    # breaks at the SAME iteration (a lone host breaking
+                    # early would strand the others' collectives).
+                    float(jax.device_get(metrics.loss))
+                    if self._multihost:
+                        from howtotrainyourmamlpytorch_tpu.parallel import (
+                            any_process_true)
+                        self._preempted = any_process_true(self._preempted)
+                    if self._preempted:
+                        break
+                elif self._preempted and not self._multihost:
+                    break
         finally:
             if prof is not None:
                 jax.block_until_ready(self.state.params)
                 prof.__exit__(None, None, None)
         jax.block_until_ready(self.state.params)
+        if self._preempted:
+            # Mid-epoch snapshot to 'latest' only; resume continues at
+            # exactly this iteration with the same deterministic batch
+            # stream (the loader indexes episodes by global iteration).
+            self.ckpt.save_latest(self.state, self.current_iter,
+                                  write=self.is_main_process)
+            self.jsonl.log("preempt_checkpoint", iter=self.current_iter)
+            print(f"preempted: saved latest checkpoint at iter "
+                  f"{self.current_iter}")
+            return None
         dt = time.time() - t0
         # jnp.stack keeps the stack on device so the device_get below is one
         # batched transfer per leaf (np.stack would pull each per-iteration
         # scalar across individually).
         stacked = jax.device_get(
             jax.tree.map(lambda *xs: jnp.stack(xs), *metrics_acc))
-        tasks = cfg.total_iter_per_epoch * cfg.batch_size
+        tasks = len(metrics_acc) * cfg.batch_size
         stats = {
             "train_loss": float(np.mean(stacked.loss)),
             "train_accuracy": float(np.mean(stacked.accuracy)),
@@ -204,38 +243,63 @@ class ExperimentBuilder:
 
         total_iters = cfg.total_epochs * cfg.total_iter_per_epoch
         epochs_this_session = 0
-        while (self.current_iter < total_iters
-               and epochs_this_session < cfg.total_epochs_before_pause):
-            epoch = self.epoch
-            train_stats = self._train_epoch()
-            val_stats = self._evaluate(self.data.get_val_batches(),
-                                       self.state)
-            epochs_this_session += 1
-
-            row = {"epoch": epoch, **train_stats,
-                   "val_loss": val_stats["loss"],
-                   "val_accuracy": val_stats["accuracy"]}
-            if self.is_main_process:
-                save_statistics(self.paths["logs"], row)
-            self.jsonl.log("validation", epoch=epoch,
-                           val_loss=val_stats["loss"],
-                           val_accuracy=val_stats["accuracy"])
-            self.ckpt.save(self.state, epoch, self.current_iter,
-                           val_stats["accuracy"],
-                           write=self.is_main_process)
-            self.jsonl.log("checkpoint", epoch=epoch,
-                           iter=self.current_iter)
-            print(f"epoch {epoch}: "
-                  f"train loss {train_stats['train_loss']:.4f} "
-                  f"acc {train_stats['train_accuracy']:.4f} | "
-                  f"val loss {val_stats['loss']:.4f} "
-                  f"acc {val_stats['accuracy']:.4f} | "
-                  f"{train_stats['meta_tasks_per_sec']:.1f} tasks/s | "
-                  f"lr {train_stats['meta_lr']:.2e}")
+        # Save-on-signal: SIGTERM (cluster preemption notice) checkpoints
+        # 'latest' at the current iteration and exits the loop cleanly;
+        # resume with continue_from_epoch='latest' loses zero iterations.
+        try:
+            prev_handler = signal.signal(
+                signal.SIGTERM, lambda *_: setattr(self, "_preempted", True))
+        except ValueError:       # not the main thread: no handler, the
+            prev_handler = None  # _preempted flag can still be set directly
+        try:
+            while (self.current_iter < total_iters
+                   and epochs_this_session < cfg.total_epochs_before_pause
+                   and not self._preempted):
+                epoch = self.epoch
+                train_stats = self._train_epoch()
+                if train_stats is None:  # preempted mid-epoch, state saved
+                    return {"preempted_at_iter": self.current_iter}
+                val_stats = self._evaluate(self.data.get_val_batches(),
+                                           self.state)
+                epochs_this_session += 1
+                self._finish_epoch(epoch, train_stats, val_stats)
+                if self._multihost:
+                    # Agree on the epoch-boundary stop decision too — a
+                    # host exiting while others start the next epoch would
+                    # hang their first psum.
+                    from howtotrainyourmamlpytorch_tpu.parallel import (
+                        any_process_true)
+                    self._preempted = any_process_true(self._preempted)
+        finally:
+            if prev_handler is not None:
+                signal.signal(signal.SIGTERM, prev_handler)
 
         if self.current_iter >= total_iters:
             return self.run_test_protocol()
         return {"paused_at_iter": self.current_iter}
+
+    def _finish_epoch(self, epoch: int, train_stats: Dict[str, float],
+                      val_stats: Dict[str, Any]) -> None:
+        row = {"epoch": epoch, **train_stats,
+               "val_loss": val_stats["loss"],
+               "val_accuracy": val_stats["accuracy"]}
+        if self.is_main_process:
+            save_statistics(self.paths["logs"], row)
+        self.jsonl.log("validation", epoch=epoch,
+                       val_loss=val_stats["loss"],
+                       val_accuracy=val_stats["accuracy"])
+        self.ckpt.save(self.state, epoch, self.current_iter,
+                       val_stats["accuracy"],
+                       write=self.is_main_process)
+        self.jsonl.log("checkpoint", epoch=epoch,
+                       iter=self.current_iter)
+        print(f"epoch {epoch}: "
+              f"train loss {train_stats['train_loss']:.4f} "
+              f"acc {train_stats['train_accuracy']:.4f} | "
+              f"val loss {val_stats['loss']:.4f} "
+              f"acc {val_stats['accuracy']:.4f} | "
+              f"{train_stats['meta_tasks_per_sec']:.1f} tasks/s | "
+              f"lr {train_stats['meta_lr']:.2e}")
 
     # ------------------------------------------------------------------
     def run_test_protocol(self) -> Dict[str, Any]:

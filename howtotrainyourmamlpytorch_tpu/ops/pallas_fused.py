@@ -224,8 +224,14 @@ def _fused_bn_relu_jvp(eps, interpret, primals, tangents):
     dxf = dx.astype(jnp.float32)
     inv = jax.lax.rsqrt(var + eps)
     dmean = jnp.mean(dxf, axis=axes)
-    # d var = E[2 x dx] − 2 E[x] dmean  (biased, matching E[x²]−E[x]²)
-    dvar = jnp.mean(2.0 * xf * dxf, axis=axes) - 2.0 * mean * dmean
+    # d var = E[2 x dx] − 2 E[x] dmean  (biased, matching E[x²]−E[x]²),
+    # gated by the primal's max(·, 0) clamp: where the raw variance
+    # rounded ≤ 0 the composite's jnp.maximum propagates zero, and the
+    # unclamped tangent would blow up through inv³ = eps^(-3/2).
+    dvar = jnp.where(
+        var > 0.0,
+        jnp.mean(2.0 * xf * dxf, axis=axes) - 2.0 * mean * dmean,
+        0.0)
     dinv = -0.5 * inv * inv * inv * dvar
     scale = inv * gamma
     dscale = dinv * gamma + inv * dgamma

@@ -86,6 +86,22 @@ def initialize_distributed() -> bool:
     return False
 
 
+def any_process_true(flag: bool) -> bool:
+    """OR-reduce a host-level boolean across processes (no-op
+    single-process). Used to AGREE on control decisions that would
+    otherwise desynchronize SPMD programs — e.g. the preemption stop:
+    if hosts broke out of the train loop at different iterations, the
+    stragglers' collectives would wait forever for departed partners.
+    """
+    if jax.process_count() <= 1:
+        return bool(flag)
+    import numpy as np
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([bool(flag)], dtype=np.bool_))
+    return bool(np.any(flags))
+
+
 def barrier(tag: str) -> None:
     """Cross-process barrier (no-op single-process).
 

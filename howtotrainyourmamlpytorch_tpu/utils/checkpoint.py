@@ -90,6 +90,23 @@ class CheckpointManager:
             self._prune()
             save_to_json(self._meta_path, self.meta)
 
+    def save_latest(self, state, current_iter: int,
+                    write: bool = True) -> None:
+        """Write ONLY ``train_model_latest`` + iteration bookkeeping — the
+        preemption path (save-on-signal mid-epoch). No epoch entry is
+        registered: a mid-epoch snapshot must not enter the top-k-by-val
+        ensemble set. Resume via ``continue_from_epoch='latest'`` picks up
+        at exactly this iteration."""
+        self.meta["current_iter"] = int(current_iter)
+        if not write:
+            return
+        data = serialization.to_bytes(jax.device_get(state))
+        tmp = self._ckpt_path(LATEST) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._ckpt_path(LATEST))
+        save_to_json(self._meta_path, self.meta)
+
     def _prune(self) -> None:
         keep = {int(e) for e in self.top_epochs(self.max_to_keep)}
         for name in os.listdir(self.directory):

@@ -124,3 +124,50 @@ def test_resume_from_specific_epoch_retrains(tmp_path):
     assert builder.current_iter == cfg.total_iter_per_epoch  # epoch 0 end
     result = builder.run_experiment()                # retrains epoch 1
     assert result["num_models"] == 2
+
+
+def test_preemption_saves_latest_and_resume_is_exact(tmp_path):
+    """Save-on-signal: preempt mid-epoch, resume from 'latest', and the
+    final params must equal an uninterrupted run bit-for-bit (same
+    deterministic episode stream, same iteration count)."""
+    import jax
+
+    cfg_a = _cfg(tmp_path / "a")
+    builder_a = ExperimentBuilder(cfg_a)
+    builder_a.run_experiment()
+
+    cfg_b = _cfg(tmp_path / "b")
+    builder_b = ExperimentBuilder(cfg_b)
+    # Preempt after 3 of 5 iterations of epoch 0: flip the flag via the
+    # same path the SIGTERM handler uses, from a step-counting hook.
+    orig = builder_b.plan.train_steps
+    count = {"n": 0}
+
+    class CountingSteps(dict):
+        def __getitem__(self, key):
+            fn = orig[key]
+            def wrapped(*a, **k):
+                count["n"] += 1
+                if count["n"] == 3:
+                    builder_b._preempted = True
+                return fn(*a, **k)
+            return wrapped
+
+    builder_b.plan = builder_b.plan._replace(train_steps=CountingSteps())
+    result = builder_b.run_experiment()
+    assert result == {"preempted_at_iter": 3}
+    assert builder_b.ckpt.has_checkpoint("latest")
+
+    # Resume: must do the REMAINDER of epoch 0 (2 iters), then epoch 1.
+    cfg_b2 = _cfg(tmp_path / "b", continue_from_epoch="latest")
+    builder_b2 = ExperimentBuilder(cfg_b2)
+    assert builder_b2.current_iter == 3
+    builder_b2.run_experiment()
+
+    for a, b in zip(jax.tree.leaves(builder_a.state.params),
+                    jax.tree.leaves(builder_b2.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    # The mid-epoch snapshot must not have entered the ensemble set.
+    stats = load_statistics(builder_b2.paths["logs"])
+    assert stats["epoch"] == ["0", "1"]

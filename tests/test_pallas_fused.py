@@ -129,3 +129,29 @@ def test_resnet12_rejects_pallas_backend():
                      image_height=32, image_width=32, image_channels=3)
     with pytest.raises(ValueError, match="resnet12"):
         make_model(cfg)
+
+
+def test_jvp_gated_by_variance_clamp():
+    """Constant channels round E[x²]−E[x]² to ≤0; the primal clamps var to
+    0 and the tangent rule must propagate zero there (not blow up through
+    rsqrt(eps)³), matching the composite's jnp.maximum gradient."""
+    x = jnp.ones((8, 4, 4, 48), jnp.float32) * 3.0  # zero variance
+    gamma = jnp.ones((48,))
+    beta = jnp.zeros((48,))
+
+    def loss_k(x):
+        return jnp.sum(fused_bn_relu(x, gamma, beta, 1e-5, True)[0])
+
+    def loss_r(x):
+        return jnp.sum(_bn_relu_reference(x, gamma, beta, 1e-5)[0])
+
+    g_k = jax.grad(loss_k)(x)
+    g_r = jax.grad(loss_r)(x)
+    assert np.isfinite(np.asarray(g_k)).all()
+    np.testing.assert_allclose(np.asarray(g_k), np.asarray(g_r),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_config_rejects_pallas_with_layer_norm():
+    with pytest.raises(ValueError, match="pallas"):
+        MAMLConfig(bn_backend="pallas", norm_layer="layer_norm")
