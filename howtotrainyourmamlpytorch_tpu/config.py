@@ -127,6 +127,9 @@ class MAMLConfig:
                                            # or 1; higher = more fusion across
                                            # inner steps, longer compiles)
     prefetch_batches: int = 2              # host->device prefetch depth
+    transfer_images_uint8: bool = True     # ship raw uint8 pixels, normalize
+                                           # on device (bit-identical, 4x
+                                           # fewer host->device bytes)
     dispatch_sync_every: int = 50          # train iters between host->device
                                            # syncs (bounds async run-ahead so
                                            # SIGTERM preemption lands

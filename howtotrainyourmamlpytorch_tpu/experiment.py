@@ -35,6 +35,8 @@ from howtotrainyourmamlpytorch_tpu.meta.outer import (
 from howtotrainyourmamlpytorch_tpu.models import make_model
 from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
     make_mesh, make_sharded_steps, replicated_sharding)
+from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
+    any_process_true, barrier)
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
     LATEST, CheckpointManager)
 from howtotrainyourmamlpytorch_tpu.utils.storage import (
@@ -164,8 +166,6 @@ class ExperimentBuilder:
                     # early would strand the others' collectives).
                     float(jax.device_get(metrics.loss))
                     if self._multihost:
-                        from howtotrainyourmamlpytorch_tpu.parallel import (
-                            any_process_true)
                         self._preempted = any_process_true(self._preempted)
                     if self._preempted:
                         break
@@ -267,8 +267,6 @@ class ExperimentBuilder:
                     # Agree on the epoch-boundary stop decision too — a
                     # host exiting while others start the next epoch would
                     # hang their first psum.
-                    from howtotrainyourmamlpytorch_tpu.parallel import (
-                        any_process_true)
                     self._preempted = any_process_true(self._preempted)
         finally:
             if prev_handler is not None:
@@ -307,7 +305,6 @@ class ExperimentBuilder:
         accuracy over the fixed test episodes; majority vote by summed
         per-sample probabilities; report mean ± std of per-episode
         accuracy; write ``test_summary.csv``."""
-        from howtotrainyourmamlpytorch_tpu.parallel import barrier
         cfg = self.cfg
         # Order process 0's checkpoint writes before everyone's reads.
         barrier("checkpoints_written")

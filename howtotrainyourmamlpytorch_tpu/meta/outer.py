@@ -28,6 +28,7 @@ from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.meta.inner import (
     Episode, TaskResult, lslr_init, per_step_loss_importance,
     split_fast_slow, task_forward)
+from howtotrainyourmamlpytorch_tpu.ops.episode import normalize_episode
 
 Params = Dict[str, Any]
 State = Dict[str, Any]
@@ -98,6 +99,7 @@ def make_train_step(cfg: MAMLConfig, apply_fn) -> Callable[..., Any]:
     def train_step(state: MetaTrainState, batch: Episode, epoch: jax.Array,
                    *, second_order: bool,
                    use_msl: bool) -> Tuple[MetaTrainState, StepMetrics]:
+        batch = normalize_episode(cfg, batch)  # uint8 wire format -> f32
         msl_w = per_step_loss_importance(cfg, epoch) if use_msl else None
 
         def batch_loss(trainable, bn_state):
@@ -166,6 +168,8 @@ def make_eval_step(cfg: MAMLConfig, apply_fn) -> Callable[..., EvalResult]:
     num_steps = cfg.number_of_evaluation_steps_per_iter
 
     def eval_step(state: MetaTrainState, batch: Episode) -> EvalResult:
+        batch = normalize_episode(cfg, batch)  # uint8 wire format -> f32
+
         def one_task(ep: Episode) -> TaskResult:
             return task_forward(
                 cfg, apply_fn, state.params, state.lslr, state.bn_state, ep,

@@ -1,0 +1,6 @@
+from howtotrainyourmamlpytorch_tpu.ops.episode import normalize_episode
+from howtotrainyourmamlpytorch_tpu.ops.losses import accuracy, cross_entropy
+from howtotrainyourmamlpytorch_tpu.ops.pallas_fused import fused_bn_relu
+
+__all__ = ["accuracy", "cross_entropy", "fused_bn_relu",
+           "normalize_episode"]

@@ -1,0 +1,37 @@
+"""Device-side episode normalization (the uint8 wire-format decoder).
+
+The sampler ships raw uint8 pixels (4x fewer host->device bytes than f32 —
+on a tunneled device that transfer dominates real training time) and this
+traced function applies the same math as the sampler's host path
+(data/sampler.py § _normalize): /255 to [0,1]; RGB datasets additionally
+2x−1 and optional channel reversal. Equal to the host path to ~1 ulp (XLA
+rewrites /255 as a reciprocal multiply and fuses the affine), bit-exact in
+episode composition and labels. Running it inside the jitted train/eval
+step lets XLA fuse the normalization into the first conv's input chain.
+Float episodes pass through untouched.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+
+
+def normalize_episode(cfg: MAMLConfig, ep):
+    # Lazy import: meta.inner itself imports ops.losses, so a module-level
+    # import here would be circular through ops/__init__.
+    from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+
+    def norm(x):
+        if x.dtype != jnp.uint8:
+            return x  # host-normalized f32 path
+        xf = x.astype(jnp.float32) / 255.0
+        if cfg.image_channels > 1:
+            xf = 2.0 * xf - 1.0
+            if cfg.reverse_channels:
+                xf = xf[..., ::-1]
+        return xf
+
+    return Episode(norm(ep.support_x), ep.support_y,
+                   norm(ep.target_x), ep.target_y)

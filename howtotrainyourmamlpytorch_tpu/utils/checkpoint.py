@@ -46,6 +46,13 @@ class CheckpointManager:
     def _ckpt_path(self, tag) -> str:
         return os.path.join(self.directory, f"train_model_{tag}.ckpt")
 
+    @staticmethod
+    def _atomic_write(path: str, data: bytes) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
     # -- save -----------------------------------------------------------
     def save(self, state, epoch: int, current_iter: int,
              val_acc: float, write: bool = True) -> None:
@@ -58,13 +65,9 @@ class CheckpointManager:
         filesystem.
         """
         if write:
-            state = jax.device_get(state)
-            data = serialization.to_bytes(state)
+            data = serialization.to_bytes(jax.device_get(state))
             epoch_path = self._ckpt_path(epoch)
-            tmp = epoch_path + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(data)
-            os.replace(tmp, epoch_path)
+            self._atomic_write(epoch_path, data)
             # 'latest' is a hard link to the epoch file (atomic via tmp
             # link + rename) — one full write per save instead of two.
             # Filesystems without hard links (gcsfuse, some NFS/overlay
@@ -100,11 +103,8 @@ class CheckpointManager:
         self.meta["current_iter"] = int(current_iter)
         if not write:
             return
-        data = serialization.to_bytes(jax.device_get(state))
-        tmp = self._ckpt_path(LATEST) + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, self._ckpt_path(LATEST))
+        self._atomic_write(self._ckpt_path(LATEST),
+                           serialization.to_bytes(jax.device_get(state)))
         save_to_json(self._meta_path, self.meta)
 
     def _prune(self) -> None:

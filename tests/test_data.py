@@ -52,16 +52,50 @@ def test_episode_shapes_and_labels():
                                   np.repeat(np.arange(5), 2))
     np.testing.assert_array_equal(ep.target_y,
                                   np.repeat(np.arange(5), 3))
+    # Default wire format: raw uint8 (device normalizes — see
+    # test_uint8_wire_format_matches_host_normalization).
+    assert ep.support_x.dtype == np.uint8
+
+
+def test_host_f32_path_shapes_and_range():
+    ep = _sampler(cfg=CFG.replace(transfer_images_uint8=False)).sample(0)
     assert ep.support_x.dtype == np.float32
     assert 0.0 <= ep.support_x.min() and ep.support_x.max() <= 1.0
 
 
 def test_rgb_normalization_range():
-    cfg = CFG.replace(image_channels=3)
+    cfg = CFG.replace(image_channels=3, transfer_images_uint8=False)
     src = SyntheticSource(20, 10, cfg.image_shape, seed=7)
     ep = EpisodeSampler(src, cfg, 0).sample(0)
     assert ep.support_x.min() < -0.2 and ep.support_x.max() > 0.2
     assert -1.0 <= ep.support_x.min() and ep.support_x.max() <= 1.0
+
+
+@pytest.mark.parametrize("channels,reverse", [(1, False), (3, False),
+                                              (3, True)])
+def test_uint8_wire_format_matches_host_normalization(channels, reverse):
+    """uint8 episode + device normalize == f32 host path, bit-exact."""
+    from howtotrainyourmamlpytorch_tpu.ops.episode import normalize_episode
+
+    cfg = CFG.replace(image_channels=channels, reverse_channels=reverse)
+    src = SyntheticSource(20, 10, cfg.image_shape, seed=7)
+    ep_u8 = EpisodeSampler(src, cfg, 0).sample(3)
+    assert ep_u8.support_x.dtype == np.uint8
+    cfg_f = cfg.replace(transfer_images_uint8=False)
+    ep_f32 = EpisodeSampler(src, cfg_f, 0).sample(3)
+
+    import jax
+    norm = jax.jit(lambda e: normalize_episode(cfg, e))
+    ep_dev = norm(jax.tree.map(lambda x: x, ep_u8))
+    # Equal to ~1 ulp, not bitwise: XLA rewrites /255 as a reciprocal
+    # multiply and fuses 2·(x/255)−1 into one multiply — different
+    # rounding than numpy's step-by-step host path.
+    np.testing.assert_allclose(np.asarray(ep_dev.support_x),
+                               ep_f32.support_x, atol=2e-7)
+    np.testing.assert_allclose(np.asarray(ep_dev.target_x),
+                               ep_f32.target_x, atol=2e-7)
+    # Labels and episode composition identical across wire formats.
+    np.testing.assert_array_equal(ep_u8.support_y, ep_f32.support_y)
 
 
 def test_rotation_augmentation_classes():
