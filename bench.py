@@ -69,13 +69,18 @@ def flagship_config(batch_size: int, n_devices: int) -> MAMLConfig:
 
 def synthetic_batch(cfg: MAMLConfig, seed: int) -> Episode:
     """Device-shaped episode batch from host RNG (content irrelevant to
-    throughput; shapes/dtypes match the real pipeline's output)."""
+    throughput; shapes/dtypes match the real pipeline's wire format —
+    raw uint8 pixels by default, normalized inside the jitted step)."""
     rng = np.random.RandomState(seed)
     n, k, t, b = (cfg.num_classes_per_set, cfg.num_samples_per_class,
                   cfg.num_target_samples, cfg.batch_size)
     h, w, c = cfg.image_shape
-    sx = rng.randn(b, n * k, h, w, c).astype(np.float32)
-    tx = rng.randn(b, n * t, h, w, c).astype(np.float32)
+    if cfg.transfer_images_uint8:
+        sx = rng.randint(0, 256, (b, n * k, h, w, c)).astype(np.uint8)
+        tx = rng.randint(0, 256, (b, n * t, h, w, c)).astype(np.uint8)
+    else:
+        sx = rng.randn(b, n * k, h, w, c).astype(np.float32)
+        tx = rng.randn(b, n * t, h, w, c).astype(np.float32)
     sy = np.tile(np.repeat(np.arange(n), k)[None], (b, 1)).astype(np.int32)
     ty = np.tile(np.repeat(np.arange(n), t)[None], (b, 1)).astype(np.int32)
     return Episode(sx, sy, tx, ty)

@@ -128,8 +128,12 @@ class MAMLConfig:
                                            # inner steps, longer compiles)
     prefetch_batches: int = 2              # host->device prefetch depth
     transfer_images_uint8: bool = True     # ship raw uint8 pixels, normalize
-                                           # on device (bit-identical, 4x
-                                           # fewer host->device bytes)
+                                           # on device (same math to ~1 ulp,
+                                           # 4x fewer host->device bytes)
+    cache_eval_episodes: bool = True       # keep the fixed val/test episode
+                                           # batches device-resident across
+                                           # epochs (they are deterministic;
+                                           # re-transfer is pure waste)
     dispatch_sync_every: int = 50          # train iters between host->device
                                            # syncs (bounds async run-ahead so
                                            # SIGTERM preemption lands

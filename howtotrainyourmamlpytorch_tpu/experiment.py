@@ -97,6 +97,9 @@ class ExperimentBuilder:
         # the stop decision is agreed across processes at sync boundaries.
         self._preempted = False
         self._multihost = jax.process_count() > 1
+        # Device-resident cache of the fixed (deterministic) val/test
+        # batches: transferred once, reused every validation sweep.
+        self._eval_cache: Dict[str, List[Any]] = {}
         if cfg.continue_from_epoch != "from_scratch":
             self._resume(cfg.continue_from_epoch)
         self.state = jax.device_put(self.state,
@@ -211,6 +214,18 @@ class ExperimentBuilder:
                                         self.mesh.size).items()})
         return stats
 
+    def _eval_batches(self, split: str) -> Iterable:
+        """The split's fixed evaluation batches, device-cached after the
+        first sweep (they are a pure function of the fixed eval seeds)."""
+        if not self.cfg.cache_eval_episodes:
+            return (self.data.get_val_batches() if split == "val"
+                    else self.data.get_test_batches())
+        if split not in self._eval_cache:
+            src = (self.data.get_val_batches() if split == "val"
+                   else self.data.get_test_batches())
+            self._eval_cache[split] = list(src)
+        return self._eval_cache[split]
+
     def _evaluate(self, batches: Iterable, state: MetaTrainState,
                   collect_logits: bool = False) -> Dict[str, Any]:
         """Run eval batches, truncated to exactly num_evaluation_tasks
@@ -259,7 +274,7 @@ class ExperimentBuilder:
                 train_stats = self._train_epoch()
                 if train_stats is None:  # preempted mid-epoch, state saved
                     return {"preempted_at_iter": self.current_iter}
-                val_stats = self._evaluate(self.data.get_val_batches(),
+                val_stats = self._evaluate(self._eval_batches("val"),
                                            self.state)
                 epochs_this_session += 1
                 self._finish_epoch(epoch, train_stats, val_stats)
@@ -312,14 +327,14 @@ class ExperimentBuilder:
         per_model_logits, per_model_acc = [], {}
         if not top:
             warnings.warn("no checkpoints recorded; testing current state")
-            res = self._evaluate(self.data.get_test_batches(), self.state,
+            res = self._evaluate(self._eval_batches("test"), self.state,
                                  collect_logits=True)
             per_model_logits.append(res["logits"])
             per_model_acc["current"] = res["accuracy"]
         for epoch in top:
             state, _ = self.ckpt.load(self.state, epoch)
             state = jax.device_put(state, replicated_sharding(self.mesh))
-            res = self._evaluate(self.data.get_test_batches(), state,
+            res = self._evaluate(self._eval_batches("test"), state,
                                  collect_logits=True)
             per_model_logits.append(res["logits"])
             per_model_acc[f"epoch_{epoch}"] = res["accuracy"]

@@ -19,10 +19,6 @@ from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 
 
 def normalize_episode(cfg: MAMLConfig, ep):
-    # Lazy import: meta.inner itself imports ops.losses, so a module-level
-    # import here would be circular through ops/__init__.
-    from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
-
     def norm(x):
         if x.dtype != jnp.uint8:
             return x  # host-normalized f32 path
@@ -33,5 +29,7 @@ def normalize_episode(cfg: MAMLConfig, ep):
                 xf = xf[..., ::-1]
         return xf
 
-    return Episode(norm(ep.support_x), ep.support_y,
-                   norm(ep.target_x), ep.target_y)
+    # Episode is a NamedTuple; _replace keeps the pytree type without
+    # importing meta.inner (which imports from ops).
+    return ep._replace(support_x=norm(ep.support_x),
+                       target_x=norm(ep.target_x))

@@ -86,7 +86,7 @@ def test_uint8_wire_format_matches_host_normalization(channels, reverse):
 
     import jax
     norm = jax.jit(lambda e: normalize_episode(cfg, e))
-    ep_dev = norm(jax.tree.map(lambda x: x, ep_u8))
+    ep_dev = norm(ep_u8)
     # Equal to ~1 ulp, not bitwise: XLA rewrites /255 as a reciprocal
     # multiply and fuses 2·(x/255)−1 into one multiply — different
     # rounding than numpy's step-by-step host path.
@@ -96,6 +96,7 @@ def test_uint8_wire_format_matches_host_normalization(channels, reverse):
                                ep_f32.target_x, atol=2e-7)
     # Labels and episode composition identical across wire formats.
     np.testing.assert_array_equal(ep_u8.support_y, ep_f32.support_y)
+    np.testing.assert_array_equal(ep_u8.target_y, ep_f32.target_y)
 
 
 def test_rotation_augmentation_classes():
