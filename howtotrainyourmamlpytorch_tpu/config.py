@@ -130,6 +130,11 @@ class MAMLConfig:
     transfer_images_uint8: bool = True     # ship raw uint8 pixels, normalize
                                            # on device (same math to ~1 ulp,
                                            # 4x fewer host->device bytes)
+    task_microbatches: int = 1             # grad-accumulate the meta-batch
+                                           # in this many sequential chunks
+                                           # (lax.scan) — the memory lever
+                                           # for pod-scale meta-batches;
+                                           # must divide batch_size
     cache_eval_episodes: bool = True       # keep the fixed val/test episode
                                            # batches device-resident across
                                            # epochs (they are deterministic;

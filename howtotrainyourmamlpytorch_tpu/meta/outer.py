@@ -96,23 +96,28 @@ def make_train_step(cfg: MAMLConfig, apply_fn) -> Callable[..., Any]:
     num_steps = cfg.number_of_training_steps_per_iter
     learnable_lslr = cfg.learnable_per_layer_per_step_inner_loop_learning_rate
 
+    num_micro = cfg.task_microbatches
+    if cfg.batch_size % max(num_micro, 1) != 0:
+        raise ValueError(f"task_microbatches {num_micro} must divide "
+                         f"batch_size {cfg.batch_size}")
+
     def train_step(state: MetaTrainState, batch: Episode, epoch: jax.Array,
                    *, second_order: bool,
                    use_msl: bool) -> Tuple[MetaTrainState, StepMetrics]:
         batch = normalize_episode(cfg, batch)  # uint8 wire format -> f32
         msl_w = per_step_loss_importance(cfg, epoch) if use_msl else None
 
-        def batch_loss(trainable, bn_state):
+        def batch_loss(trainable, bn_state, chunk):
             def one_task(ep: Episode) -> TaskResult:
                 return task_forward(
                     cfg, apply_fn, trainable["params"], trainable["lslr"],
                     bn_state, ep, num_steps=num_steps,
                     second_order=second_order, use_msl=use_msl,
                     msl_weights=msl_w)
-            res = jax.vmap(one_task)(batch)
+            res = jax.vmap(one_task)(chunk)
             # Mean over the task shard; under a mesh XLA turns these means
             # into psums over the tasks axis — the single collective per
-            # outer step.
+            # outer step (per micro-chunk when accumulating).
             loss = jnp.mean(res.loss)
             new_bn = jax.tree.map(lambda a: jnp.mean(a, axis=0),
                                   res.bn_state)
@@ -121,8 +126,38 @@ def make_train_step(cfg: MAMLConfig, apply_fn) -> Callable[..., Any]:
             return loss, aux
 
         trainable = {"params": state.params, "lslr": state.lslr}
-        (loss, (acc, s_loss, new_bn)), grads = jax.value_and_grad(
-            batch_loss, has_aux=True)(trainable, state.bn_state)
+        if num_micro <= 1:
+            (loss, (acc, s_loss, new_bn)), grads = jax.value_and_grad(
+                batch_loss, has_aux=True)(trainable, state.bn_state, batch)
+        else:
+            # Gradient accumulation over task micro-batches: the memory
+            # lever for pod-scale meta-batches (SURVEY.md §2.2). The mean
+            # over the full batch equals the mean of equal-size chunk
+            # means, so accumulating chunk grads/aux and dividing by the
+            # chunk count reproduces the single-shot math exactly.
+            chunked = jax.tree.map(
+                lambda x: x.reshape((num_micro, x.shape[0] // num_micro)
+                                    + x.shape[1:]),
+                batch)
+
+            def one_chunk(carry, chunk):
+                (loss_c, aux_c), grads_c = jax.value_and_grad(
+                    batch_loss, has_aux=True)(trainable, state.bn_state,
+                                              chunk)
+                carry = jax.tree.map(jnp.add, carry,
+                                     ((loss_c, aux_c), grads_c))
+                return carry, None
+
+            zero = jax.tree.map(
+                jnp.zeros_like,
+                jax.eval_shape(
+                    lambda t, b: jax.value_and_grad(
+                        batch_loss, has_aux=True)(t, b, jax.tree.map(
+                            lambda x: x[0], chunked)),
+                    trainable, state.bn_state))
+            acc_out, _ = jax.lax.scan(one_chunk, zero, chunked)
+            ((loss, (acc, s_loss, new_bn)), grads) = jax.tree.map(
+                lambda a: a / num_micro, acc_out)
 
         if not learnable_lslr:
             grads["lslr"] = jax.tree.map(jnp.zeros_like, grads["lslr"])

@@ -116,3 +116,42 @@ def test_graft_entry_compiles():
     fn, args = g.entry()
     out = jax.jit(fn)(*args)
     assert out.shape == (25, 5)
+
+
+def test_sharded_microbatch_accumulation():
+    """task_microbatches composes with the (dcn, tasks) mesh: the reshape
+    to (M, B/M) chunks re-annotates sharding without host round-trips and
+    the step still produces finite, matching results."""
+    devices = jax.devices()[:8]
+    cfg = CFG.replace(mesh_shape=(2, 4), task_microbatches=2)
+    init, apply = make_model(cfg)
+    mesh = make_mesh(cfg, devices)
+    plan = make_sharded_steps(cfg, apply, mesh)
+    repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    def fresh_state():
+        # The train step donates its state argument, and device_put with
+        # an identical sharding aliases rather than copies — build an
+        # independent state per call.
+        return jax.device_put(
+            init_train_state(cfg, init, jax.random.PRNGKey(0)), repl)
+
+    batch = shard_batch(_batch(jax.random.PRNGKey(1), cfg), mesh)
+    new_state, metrics = plan.train_steps[(True, True)](
+        fresh_state(), batch, jnp.float32(0))
+    assert np.isfinite(float(metrics.loss))
+
+    # Single-shot on the same mesh gives the same loss and gradients
+    # (first-moment check, linear in grads).
+    cfg1 = CFG.replace(mesh_shape=(2, 4))
+    _, apply1 = make_model(cfg1)
+    plan1 = make_sharded_steps(cfg1, apply1, mesh)
+    s1, m1 = plan1.train_steps[(True, True)](
+        fresh_state(), batch, jnp.float32(0))
+    np.testing.assert_allclose(float(m1.loss), float(metrics.loss),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(s1.opt_state),
+                    jax.tree.leaves(new_state.opt_state)):
+        np.testing.assert_allclose(np.asarray(a, np.float64),
+                                   np.asarray(b, np.float64),
+                                   rtol=2e-4, atol=1e-7)
