@@ -166,6 +166,10 @@ class MAMLConfig:
             raise ValueError(f"unknown backbone {self.backbone!r}")
         if self.num_classes_per_set < 2:
             raise ValueError("num_classes_per_set must be >= 2")
+        if self.task_microbatches < 1:
+            raise ValueError(
+                f"task_microbatches must be >= 1, got "
+                f"{self.task_microbatches}")
         if self.number_of_training_steps_per_iter < 1:
             raise ValueError("need at least one inner step")
 

@@ -96,8 +96,8 @@ def make_train_step(cfg: MAMLConfig, apply_fn) -> Callable[..., Any]:
     num_steps = cfg.number_of_training_steps_per_iter
     learnable_lslr = cfg.learnable_per_layer_per_step_inner_loop_learning_rate
 
-    num_micro = cfg.task_microbatches
-    if cfg.batch_size % max(num_micro, 1) != 0:
+    num_micro = cfg.task_microbatches  # >= 1, validated by the config
+    if cfg.batch_size % num_micro != 0:
         raise ValueError(f"task_microbatches {num_micro} must divide "
                          f"batch_size {cfg.batch_size}")
 

@@ -217,10 +217,12 @@ def layer_norm_apply(params: Params, state: State, x: jax.Array,
 def fused_batch_norm_relu_apply(
         params: Params, state: State, x: jax.Array, step: jax.Array, *,
         training: bool, momentum: float = 0.1, eps: float = 1e-5,
+        negative_slope: float = 0.0,
         interpret: bool = False) -> Tuple[jax.Array, State]:
-    """Per-step BN + ReLU through the Pallas fused kernel
-    (ops/pallas_fused.py) — numerics of the ``fast_math`` path, ReLU
-    included (callers must NOT apply their own ReLU after this).
+    """Per-step BN + activation through the Pallas fused kernel
+    (ops/pallas_fused.py) — numerics of the ``fast_math`` path, activation
+    included (callers must NOT apply their own): ``negative_slope`` 0 =
+    relu, 0.1 = leaky (resnet12), 1.0 = none.
 
     Opt-in via config ``bn_backend='pallas'``. Measured on v5e: slower
     than XLA's composite for C=48 (the lane repack is a real relayout of
@@ -235,7 +237,8 @@ def fused_batch_norm_relu_apply(
     gamma = jnp.take(params["gamma"], idx, axis=0)
     beta = jnp.take(params["beta"], idx, axis=0)
 
-    y, mean, var = fused_bn_relu(x, gamma, beta, eps, interpret)
+    y, mean, var = fused_bn_relu(x, gamma, beta, eps, interpret,
+                                 negative_slope)
 
     axes = tuple(range(x.ndim - 1))
     n = 1
@@ -248,6 +251,30 @@ def fused_batch_norm_relu_apply(
         "var": state["var"].at[idx].set(
             (1.0 - momentum) * state["var"][idx] + momentum * unbiased),
     }
+    return y, new_state
+
+
+def batch_norm_act_apply(cfg, params: Params, state: State, x: jax.Array,
+                         step: jax.Array, *, training: bool,
+                         negative_slope: float = 0.0
+                         ) -> Tuple[jax.Array, State]:
+    """Per-step BN + activation with backend dispatch — the single place
+    both backbones select between the XLA composite path and the fused
+    Pallas kernel (config ``bn_backend``). ``negative_slope``: 0 = relu,
+    0.1 = leaky (resnet12), 1.0 = no activation."""
+    if cfg.bn_backend == "pallas":
+        return fused_batch_norm_relu_apply(
+            params, state, x, step, training=training,
+            momentum=cfg.batch_norm_momentum, eps=cfg.batch_norm_eps,
+            negative_slope=negative_slope)
+    y, new_state = batch_norm_apply(
+        params, state, x, step, training=training,
+        momentum=cfg.batch_norm_momentum, eps=cfg.batch_norm_eps,
+        fast_math=cfg.bn_fast_math)
+    if negative_slope == 0.0:
+        y = jax.nn.relu(y)
+    elif negative_slope != 1.0:
+        y = jax.nn.leaky_relu(y, negative_slope)
     return y, new_state
 
 

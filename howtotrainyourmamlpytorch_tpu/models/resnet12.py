@@ -37,11 +37,6 @@ def _block_widths(cfg: MAMLConfig) -> Tuple[int, ...]:
     return tuple(int(cfg.cnn_num_filters * m) for m in _WIDTH_MULTS)
 
 
-def _norm_kwargs(cfg: MAMLConfig) -> Dict[str, Any]:
-    return dict(momentum=cfg.batch_norm_momentum, eps=cfg.batch_norm_eps,
-                fast_math=cfg.bn_fast_math)
-
-
 def _apply_block(cfg: MAMLConfig, params: Params, state: State,
                  x: jax.Array, block: int, step: jax.Array,
                  training: bool) -> Tuple[jax.Array, State]:
@@ -52,18 +47,19 @@ def _apply_block(cfg: MAMLConfig, params: Params, state: State,
         name = f"block{block}_conv{j}"
         x = layers.conv2d_apply(params[name], x, compute_dtype=compute_dtype)
         nname = f"block{block}_norm{j}"
-        x, new_state[nname] = layers.batch_norm_apply(
-            params[nname], state[nname], x, step, training=training,
-            **_norm_kwargs(cfg))
-        if j < _CONVS_PER_BLOCK - 1:
-            x = jax.nn.leaky_relu(x, 0.1)
+        # Last conv's norm has no activation (it precedes the residual
+        # add); earlier ones are leaky-relu(0.1).
+        slope = 0.1 if j < _CONVS_PER_BLOCK - 1 else 1.0
+        x, new_state[nname] = layers.batch_norm_act_apply(
+            cfg, params[nname], state[nname], x, step, training=training,
+            negative_slope=slope)
     sname = f"block{block}_skip_conv"
     residual = layers.conv2d_apply(params[sname], residual,
                                    compute_dtype=compute_dtype)
     snname = f"block{block}_skip_norm"
-    residual, new_state[snname] = layers.batch_norm_apply(
-        params[snname], state[snname], residual, step, training=training,
-        **_norm_kwargs(cfg))
+    residual, new_state[snname] = layers.batch_norm_act_apply(
+        cfg, params[snname], state[snname], residual, step,
+        training=training, negative_slope=1.0)
     x = jax.nn.leaky_relu(x + residual, 0.1)
     x = layers.max_pool2d(x)
     # Remat tag consumed by the 'block_outs' checkpoint policy (the
@@ -77,13 +73,6 @@ def make_resnet12(cfg: MAMLConfig):
     """Build (init, apply) for ResNet-12 described by ``cfg``."""
     if cfg.norm_layer != "batch_norm":
         raise ValueError("resnet12 backbone supports norm_layer='batch_norm'")
-    if cfg.bn_backend != "composite":
-        # The fused Pallas kernel bakes in plain ReLU; this backbone's
-        # norms are followed by leaky-relu (or nothing, on the skip
-        # branch), so silently accepting the flag would measure nothing.
-        raise ValueError("bn_backend='pallas' is not supported by the "
-                         "resnet12 backbone (leaky-relu activations); "
-                         "use the default composite backend")
     h, w, c = cfg.image_shape
     widths = _block_widths(cfg)
     num_steps = cfg.bn_num_steps

@@ -44,27 +44,20 @@ def _features_apply(cfg: MAMLConfig, params: Params, state: State,
     new_state: State = {}
     stride = 1 if cfg.max_pooling else 2
     padding = "SAME" if cfg.conv_padding else "VALID"
-    use_pallas_bn = (cfg.norm_layer == "batch_norm"
-                     and cfg.bn_backend == "pallas")
     for i in range(cfg.num_stages):
         x = layers.conv2d_apply(params[f"conv{i}"], x, stride=stride,
                                 padding=padding,
                                 compute_dtype=compute_dtype)
-        if use_pallas_bn:
-            # Kernel fuses the ReLU; do not reapply it.
-            x, new_state[f"norm{i}"] = layers.fused_batch_norm_relu_apply(
-                params[f"norm{i}"], state[f"norm{i}"], x, step,
-                training=training, momentum=cfg.batch_norm_momentum,
-                eps=cfg.batch_norm_eps)
+        if cfg.norm_layer == "batch_norm":
+            # Backend dispatch (composite vs fused Pallas) + ReLU live in
+            # the shared helper.
+            x, new_state[f"norm{i}"] = layers.batch_norm_act_apply(
+                cfg, params[f"norm{i}"], state[f"norm{i}"], x, step,
+                training=training, negative_slope=0.0)
         else:
-            norm_kwargs = {}
-            if cfg.norm_layer == "batch_norm":
-                norm_kwargs = dict(momentum=cfg.batch_norm_momentum,
-                                   eps=cfg.batch_norm_eps,
-                                   fast_math=cfg.bn_fast_math)
             x, new_state[f"norm{i}"] = norm_apply(
                 params[f"norm{i}"], state[f"norm{i}"], x, step,
-                training=training, **norm_kwargs)
+                training=training)
             x = jax.nn.relu(x)
         if cfg.max_pooling:
             x = layers.max_pool2d(x)

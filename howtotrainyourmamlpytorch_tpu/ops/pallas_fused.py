@@ -31,11 +31,14 @@ via E[x²]−E[x]², clamped; scale/shift rounded to and applied in x.dtype —
 including on bfloat16 inputs), NOT the bit-exact f32 reference path — both
 are opt-in performance modes (config ``bn_backend``).
 
-Measured (v5e, 400×84×84×48 bf16): the kernel runs ~2x slower than XLA's
-fused composite for C=48 because the lane repack to width 384 is a real
-relayout of (8,128)-tiled memory. It is shipped as an opt-in backend; the
-repack is free when C % 128 == 0 (wider backbones), where the full-lane
-normalize pays off.
+Measured (v5e): for C=48 (400×84×84×48 bf16) the kernel runs ~2x slower
+than XLA's fused composite — the lane repack to width 384 is a real
+relayout of (8,128)-tiled memory. For C % 128 == 0 (resnet12's wider
+stages: 42²×128, 21²×256, 11²×512 at batch 200) the repack is a free
+reshape and kernel and composite measure at parity within noise, XLA
+marginally ahead. Shipped as an opt-in backend (``bn_backend='pallas'``),
+supporting relu / leaky-relu / identity activations so both backbones can
+use it.
 """
 
 from __future__ import annotations
@@ -61,8 +64,8 @@ def supported(x_rows: int, c: int) -> bool:
     return (x_rows * c) % _packed_width(c) == 0
 
 
-def _kernel(c: int, eps: float, x_ref, gamma_ref, beta_ref, count_ref,
-            y_ref, stats_ref, acc_ref, coef_ref):
+def _kernel(c: int, eps: float, negative_slope: float, x_ref, gamma_ref,
+            beta_ref, count_ref, y_ref, stats_ref, acc_ref, coef_ref):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -108,13 +111,24 @@ def _kernel(c: int, eps: float, x_ref, gamma_ref, beta_ref, count_ref,
     def _():
         # Normalize in x's own dtype (scale/shift rounded to it first) —
         # bit-matching the bn_fast_math composite path on bf16 inputs.
+        # Activation: leaky-relu with static slope (0 = relu, 1 = none).
         dt = x_ref.dtype
         y = x_ref[:] * coef_ref[0:1].astype(dt) + coef_ref[1:2].astype(dt)
-        y_ref[:] = jnp.maximum(y, jnp.zeros((), dt))
+        if negative_slope == 1.0:
+            y_ref[:] = y
+        else:
+            # Compare-free leaky-relu (Mosaic lacks bf16 vector compares
+            # on some targets): max(y,0) + slope*min(y,0) == where(y>0,
+            # y, slope*y) exactly.
+            zero = jnp.zeros((), dt)
+            y_ref[:] = (jnp.maximum(y, zero)
+                        + jnp.minimum(y, zero)
+                        * jnp.asarray(negative_slope, dt))
 
 
 def _fused_call(x2: jax.Array, gamma_p: jax.Array, beta_p: jax.Array,
                 count: jax.Array, c: int, eps: float,
+                negative_slope: float,
                 interpret: bool) -> Tuple[jax.Array, jax.Array]:
     """Invoke the kernel on the packed (rows, p) view. Returns (y2, stats)."""
     import jax.experimental.pallas as pl
@@ -133,7 +147,7 @@ def _fused_call(x2: jax.Array, gamma_p: jax.Array, beta_p: jax.Array,
 
     grid = (2, nb)
     y2, stats = pl.pallas_call(
-        functools.partial(_kernel, c, eps),
+        functools.partial(_kernel, c, eps, negative_slope),
         out_shape=(
             jax.ShapeDtypeStruct(x2.shape, x2.dtype),
             jax.ShapeDtypeStruct((2, p), jnp.float32),
@@ -168,10 +182,11 @@ def _fused_call(x2: jax.Array, gamma_p: jax.Array, beta_p: jax.Array,
     return y2, stats
 
 
-def _bn_relu_reference(x, gamma, beta, eps):
+def _bn_relu_reference(x, gamma, beta, eps, negative_slope=0.0):
     """jnp composite with identical numerics (fallback + tangent basis):
     f32 statistics, scale/shift rounded to and applied in x.dtype — the
-    ``bn_fast_math`` recipe (models/layers.py § batch_norm_apply)."""
+    ``bn_fast_math`` recipe (models/layers.py § batch_norm_apply) — then
+    leaky-relu with static slope (0 = relu, 1 = no activation)."""
     axes = tuple(range(x.ndim - 1))
     mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
     mean_sq = jnp.mean(jax.lax.square(x.astype(jnp.float32)), axis=axes)
@@ -179,16 +194,20 @@ def _bn_relu_reference(x, gamma, beta, eps):
     inv = jax.lax.rsqrt(var + eps)
     scale = (inv * gamma).astype(x.dtype)
     shift = (beta - mean * inv * gamma).astype(x.dtype)
-    y = jnp.maximum(x * scale + shift, jnp.zeros((), x.dtype))
+    y = x * scale + shift
+    if negative_slope != 1.0:
+        y = jnp.where(y > 0, y, y * jnp.asarray(negative_slope, y.dtype))
     return y, mean, var
 
 
-@functools.partial(jax.custom_jvp, nondiff_argnums=(3, 4))
+@functools.partial(jax.custom_jvp, nondiff_argnums=(3, 4, 5))
 def fused_bn_relu(x, gamma, beta, eps: float = 1e-5,
-                  interpret: bool = False):
-    """``relu(batch_norm(x)·gamma + beta)`` with batch statistics.
+                  interpret: bool = False, negative_slope: float = 0.0):
+    """``leaky_relu(batch_norm(x)·gamma + beta)`` with batch statistics.
 
-    x: (..., C) — statistics over all leading axes. Returns
+    x: (..., C) — statistics over all leading axes. ``negative_slope``:
+    0.0 = relu (VGG), 0.1 = resnet12's leaky-relu, 1.0 = no activation
+    (resnet12's pre-residual and skip-branch norms). Returns
     ``(y, mean, var)`` with mean/var f32 (biased var, as normalization
     uses). Uses the Pallas kernel when the shape folds evenly into the
     packed lane width; jnp composite otherwise.
@@ -196,7 +215,7 @@ def fused_bn_relu(x, gamma, beta, eps: float = 1e-5,
     c = x.shape[-1]
     rows = math.prod(x.shape[:-1])
     if not supported(rows, c):
-        return _bn_relu_reference(x, gamma, beta, eps)
+        return _bn_relu_reference(x, gamma, beta, eps, negative_slope)
     p = _packed_width(c)
     folds = p // c
     x2 = x.reshape(rows * c // p, p)
@@ -204,20 +223,23 @@ def fused_bn_relu(x, gamma, beta, eps: float = 1e-5,
     beta_p = jnp.tile(beta.astype(jnp.float32), folds)[None, :]
     # Per-channel element count, (1,1) f32 for SMEM.
     count = jnp.full((1, 1), rows, jnp.float32)
-    y2, stats = _fused_call(x2, gamma_p, beta_p, count, c, eps, interpret)
+    y2, stats = _fused_call(x2, gamma_p, beta_p, count, c, eps,
+                            negative_slope, interpret)
     return (y2.reshape(x.shape), stats[0, :c], stats[1, :c])
 
 
 @fused_bn_relu.defjvp
-def _fused_bn_relu_jvp(eps, interpret, primals, tangents):
+def _fused_bn_relu_jvp(eps, interpret, negative_slope, primals, tangents):
     """Tangent rule in plain jnp (differentiable again → second order OK).
 
     The primal runs the kernel; tangents use the primal's mean/var and the
-    ReLU mask from the primal output.
+    activation mask from the primal output (for 0 <= slope < 1 the sign of
+    y equals the sign of the pre-activation, so ``y > 0`` is the mask).
     """
     x, gamma, beta = primals
     dx, dgamma, dbeta = tangents
-    y, mean, var = fused_bn_relu(x, gamma, beta, eps, interpret)
+    y, mean, var = fused_bn_relu(x, gamma, beta, eps, interpret,
+                                 negative_slope)
 
     axes = tuple(range(x.ndim - 1))
     xf = x.astype(jnp.float32)
@@ -237,6 +259,9 @@ def _fused_bn_relu_jvp(eps, interpret, primals, tangents):
     dscale = dinv * gamma + inv * dgamma
     dshift = dbeta - dmean * scale - mean * dscale
     dy_pre = dxf * scale + xf * dscale + dshift
-    mask = (y > 0).astype(jnp.float32)
-    dy = (dy_pre * mask).astype(y.dtype)
+    if negative_slope == 1.0:
+        dy = dy_pre.astype(y.dtype)
+    else:
+        factor = jnp.where(y > 0, 1.0, negative_slope)
+        dy = (dy_pre * factor).astype(y.dtype)
     return (y, mean, var), (dy, dmean, dvar)

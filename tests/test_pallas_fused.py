@@ -124,11 +124,45 @@ def test_layer_level_matches_fast_math_bf16():
                                   np.asarray(y_ref, np.float32))
 
 
-def test_resnet12_rejects_pallas_backend():
-    cfg = MAMLConfig(backbone="resnet12", bn_backend="pallas",
-                     image_height=32, image_width=32, image_channels=3)
-    with pytest.raises(ValueError, match="resnet12"):
-        make_model(cfg)
+@pytest.mark.parametrize("slope", [0.1, 1.0])
+def test_kernel_leaky_and_identity_slopes(data, slope):
+    """negative_slope generalization: 0.1 = resnet12's leaky-relu, 1.0 =
+    no activation (pre-residual / skip-branch norms)."""
+    x, gamma, beta = data
+    y_k, m_k, v_k = fused_bn_relu(x, gamma, beta, 1e-5, True, slope)
+    y_r, m_r, v_r = _bn_relu_reference(x, gamma, beta, 1e-5, slope)
+    np.testing.assert_allclose(np.asarray(y_k), np.asarray(y_r), atol=1e-5)
+    if slope == 1.0:
+        assert float(jnp.min(y_k)) < 0  # activation really absent
+
+    def gn(loss):
+        return jax.grad(
+            lambda x: jnp.sum(jax.grad(loss)(x) ** 2))(x)
+
+    h_k = gn(lambda x: jnp.sum(
+        fused_bn_relu(x, gamma, beta, 1e-5, True, slope)[0] ** 2))
+    h_r = gn(lambda x: jnp.sum(
+        _bn_relu_reference(x, gamma, beta, 1e-5, slope)[0] ** 2))
+    np.testing.assert_allclose(np.asarray(h_k), np.asarray(h_r),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_resnet12_pallas_backend_matches_composite():
+    """resnet12 with bn_backend='pallas' (fused leaky/identity norms) must
+    match the fast_math composite model."""
+    cfg = MAMLConfig(backbone="resnet12", image_height=16, image_width=16,
+                     image_channels=3, num_classes_per_set=3,
+                     cnn_num_filters=8, compute_dtype="float32",
+                     bn_fast_math=True)
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, 16, 16, 3))
+    init, apply = make_model(cfg)
+    params, state = init(jax.random.PRNGKey(0))
+    logits_ref, _ = apply(params, state, x, jnp.int32(0), True)
+
+    _, apply_p = make_model(cfg.replace(bn_backend="pallas"))
+    logits_p, _ = apply_p(params, state, x, jnp.int32(0), True)
+    np.testing.assert_allclose(np.asarray(logits_p), np.asarray(logits_ref),
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_jvp_gated_by_variance_clamp():
