@@ -2,9 +2,16 @@
 
 Flagship workload (BASELINE.json config #4): Mini-ImageNet 5-way 5-shot,
 4-conv VGG backbone (48 filters), K=5 inner steps, SECOND-ORDER meta
-gradients, multi-step loss, learnable per-layer-per-step inner LRs, per-step
-batch-norm — i.e. the full MAML++ hot path (SURVEY.md §3.2), jitted as one
-XLA program with remat over inner steps.
+gradients, learnable per-layer-per-step inner LRs, per-step batch-norm —
+the MAML++ hot path (SURVEY.md §3.2), jitted as one XLA program with remat
+over inner steps. The executable is selected per epoch exactly as
+``ExperimentBuilder`` does; we bench the STEADY-STATE epoch (20): past the
+multi-step-loss annealing window (``multi_step_loss_num_epochs=15``) the
+step computes the target loss at the final inner step only, matching what
+real training runs for epochs 15..100 (85% of the schedule). The
+MSL-window step (epochs 0..14, 4 extra per-step target forwards) measures
+~18% slower (docs/PERF.md); run-weighted over the full schedule the
+throughput is ~3% below the number printed here.
 
 Metric: meta-tasks processed per second per chip (tasks = episodes through
 the complete inner-loop adaptation + meta-gradient).
@@ -91,14 +98,16 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=20,
                     help="timed outer steps")
     ap.add_argument("--batch", type=int, default=0,
-                    help="meta-batch size (0 = auto: 16 per device)")
+                    help="meta-batch size (0 = auto: 12 per device)")
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes for CI/CPU sanity (not a real bench)")
     args = ap.parse_args()
 
     devices = jax.devices()
     n_dev = len(devices)
-    batch = args.batch or 16 * n_dev
+    # 12/chip: best measured operating point on v5e (sweep in docs/PERF.md;
+    # the curve is non-monotonic — 12 beats both 8..10 and 14..28).
+    batch = args.batch or 12 * n_dev
     cfg = flagship_config(batch, n_dev)
     if args.quick:
         cfg = cfg.replace(
@@ -110,13 +119,19 @@ def main() -> int:
     init, apply = make_model(cfg)
     mesh = make_mesh(cfg, devices)
     plan = make_sharded_steps(cfg, apply, mesh)
-    train = plan.train_steps[(True, True)]  # second-order + MSL: full MAML++
+    # Steady-state epoch: past the DA boundary (second order ON) and the
+    # MSL annealing window (target loss at the final step only) — the
+    # executable real training runs for epochs 15..100, selected exactly
+    # as ExperimentBuilder does per epoch.
+    bench_epoch = 20
+    train = plan.train_steps[(cfg.use_second_order(bench_epoch),
+                              cfg.use_msl(bench_epoch))]
 
     state = init_train_state(cfg, init, jax.random.PRNGKey(0))
     state = jax.device_put(
         state, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
     batch_ep = shard_batch(synthetic_batch(cfg, 0), mesh)
-    epoch = jnp.float32(20.0)  # past the MSL/DA annealing boundaries
+    epoch = jnp.float32(bench_epoch)
 
     # Warmup: compile + 2 steady-state steps, with a host fetch as the
     # fence (on the tunneled 'axon' TPU backend ``block_until_ready`` has
