@@ -280,7 +280,16 @@ def batch_norm_act_apply(cfg, params: Params, state: State, x: jax.Array,
 
 def max_pool2d(x: jax.Array, window: int = 2, stride: int = 2) -> jax.Array:
     """2x2 max pool, NHWC, VALID padding (torch F.max_pool2d default:
-    floor)."""
+    floor).
+
+    Deliberately ``lax.reduce_window`` + XLA's select-and-scatter VJP:
+    although profiling shows the pool VJP at ~10% of the flagship step,
+    both "cheaper" formulations of the non-overlapping case (pairwise
+    strided ``maximum``s; reshape-then-max) measure ~2.2x SLOWER
+    fwd+bwd on the real stage-0 shape — their slices/reshapes force
+    relayouts of the (8,128)-tiled buffers that dwarf the
+    select-and-scatter they remove (docs/PERF.md, rejected variants).
+    """
     return jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max,
         window_dimensions=(1, window, window, 1),
