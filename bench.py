@@ -97,7 +97,8 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30,
                     help="timed outer steps, rounded DOWN to a multiple of "
-                         "3 (split into 3 median windows, >=1 step each)")
+                         "3 (split into 3 median windows; values <3 still "
+                         "run 3 steps, one per window)")
     ap.add_argument("--batch", type=int, default=0,
                     help="meta-batch size (0 = auto: 12 per device)")
     ap.add_argument("--quick", action="store_true",
