@@ -290,6 +290,15 @@ def max_pool2d(x: jax.Array, window: int = 2, stride: int = 2) -> jax.Array:
     relayouts of the (8,128)-tiled buffers that dwarf the
     select-and-scatter they remove (docs/PERF.md, rejected variants).
     """
+    out_h = (x.shape[-3] - window) // stride + 1
+    out_w = (x.shape[-2] - window) // stride + 1
+    if out_h <= 0 or out_w <= 0:
+        # Without this, downstream reductions over the empty spatial dims
+        # quietly produce NaN losses (torch's max_pool2d raises here too).
+        raise ValueError(
+            f"max_pool2d: input spatial dims {x.shape[-3]}x{x.shape[-2]} "
+            f"too small for a {window}x{window}/stride-{stride} pool — the "
+            f"network has more pooling stages than the image size supports")
     return jax.lax.reduce_window(
         x, -jnp.inf, jax.lax.max,
         window_dimensions=(1, window, window, 1),

@@ -70,6 +70,16 @@ def test_max_pool_matches_torch():
                                atol=1e-6)
 
 
+def test_max_pool_rejects_empty_output():
+    # A 1x1 input pooled 2x2/VALID would be spatially empty; downstream
+    # reductions would then turn it into NaN (or, worse, a flatten into
+    # an all-zero feature vector with a finite loss). Torch raises; so
+    # do we.
+    x = _rand(jax.random.PRNGKey(7), (2, 1, 1, 3))
+    with pytest.raises(ValueError, match="too small"):
+        layers.max_pool2d(x)
+
+
 def test_batch_norm_matches_torch_training_mode():
     """Normalization = batch stats; running stats updated with torch's
     momentum convention (biased var to normalize, unbiased in the running
