@@ -36,7 +36,7 @@ from howtotrainyourmamlpytorch_tpu.models import make_model
 from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
     make_mesh, make_sharded_steps, replicated_sharding)
 from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
-    any_process_true, barrier)
+    agree_int_from_main, any_process_true, barrier)
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
     LATEST, CheckpointManager)
 from howtotrainyourmamlpytorch_tpu.utils.storage import (
@@ -107,11 +107,79 @@ class ExperimentBuilder:
 
     # ------------------------------------------------------------------
     def _resume(self, tag) -> None:
-        if tag == LATEST and not self.ckpt.has_checkpoint(LATEST):
-            return  # fresh run with continue_from_epoch='latest' (reference
-                    # default for restartable jobs): nothing to resume yet
-        self.state, meta = self.ckpt.load(self.state, tag)
-        self.current_iter = int(meta["current_iter"])
+        # Fresh-run vs resume, WHICH checkpoint, and WHICH iteration are
+        # filesystem-dependent decisions: every process must make the same
+        # ones (hosts entering the loop at different iterations deadlock
+        # in their first mismatched collective), so process 0's resolution
+        # is adopted everywhere. ``tag`` itself is config (identical on
+        # all hosts), so both branches run the same collective sequence;
+        # a host that cannot comply aborts EVERY host via any_process_true
+        # rather than stranding peers mid-collective.
+        _IS_LATEST = -1
+        from_latest = tag == LATEST
+
+        def abort_all_if_any(err: Optional[BaseException],
+                             peer_msg: str) -> None:
+            """Raise on EVERY host when any host captured an error (the
+            failing host re-raises its own; peers get ``peer_msg``), so
+            no host is left stranded inside a later collective."""
+            if any_process_true(err is not None):
+                raise err if err is not None else RuntimeError(
+                    peer_msg + "; aborting resume on all hosts")
+
+        # OR-reduce, not process-0 broadcast: if ANY host sees checkpoint
+        # files, this is not a fresh run — a stale-empty view on process 0
+        # must end in a loud load failure below, never a silent restart
+        # that overwrites the existing run.
+        if from_latest and not any_process_true(
+                self.ckpt.has_any_checkpoint()):
+            return  # fresh run with continue_from_epoch='latest'
+                    # (reference default for restartable jobs)
+        err: Optional[BaseException] = None
+        meta: Dict[str, Any] = {}
+        try:
+            if from_latest:
+                # Falls back to the newest readable epoch checkpoint if
+                # the latest file is missing/damaged (then behaves like
+                # an int-tag resume).
+                self.state, meta, tag = self.ckpt.load_latest_or_fallback(
+                    self.state)
+            else:
+                self.state, meta = self.ckpt.load(self.state, tag)
+        except Exception as e:
+            err = e
+        abort_all_if_any(err, "a peer process has no readable checkpoint")
+        if from_latest:
+            # The fallback resolution is per-host; adopt process 0's.
+            local = _IS_LATEST if tag == LATEST else int(tag)
+            agreed = agree_int_from_main(local)
+            if agreed != local:
+                # This process saw different (stale/damaged) bytes than
+                # process 0 — reload process 0's choice.
+                tag = LATEST if agreed == _IS_LATEST else int(agreed)
+                try:
+                    self.state, meta = self.ckpt.load(self.state, tag)
+                except Exception as e:
+                    err = e
+            abort_all_if_any(
+                err, f"a peer process could not load the agreed "
+                     f"checkpoint {tag!r}")
+        # Same tag can still mean different bytes (stale NFS cache serving
+        # a previous 'latest' or state.json): the iteration must agree too.
+        local_iter = int(meta["current_iter"])
+        agreed_iter = agree_int_from_main(local_iter)
+        if any_process_true(agreed_iter != local_iter):
+            detail = (
+                f"THIS host diverges: local iter {local_iter} vs process "
+                f"0's {agreed_iter} — stale filesystem cache?"
+                if agreed_iter != local_iter else
+                f"a peer host's iteration differs from process 0's "
+                f"{agreed_iter} (this host agrees)")
+            raise RuntimeError(
+                "hosts disagree on the resume iteration; aborting all "
+                "hosts instead of deadlocking in the first mismatched "
+                "collective. " + detail)
+        self.current_iter = local_iter
         if tag != LATEST:
             # Rewind: epochs after the resume point are abandoned; their
             # checkpoints must not feed the top-k ensemble.

@@ -7,6 +7,7 @@ from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
     shard_batch,
 )
 from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
+    agree_int_from_main,
     any_process_true,
     assemble_global_batch,
     barrier,
@@ -17,6 +18,6 @@ from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
 __all__ = [
     "MeshPlan", "batch_sharding", "make_mesh", "make_sharded_steps",
     "replicated_sharding", "shard_batch",
-    "any_process_true", "assemble_global_batch", "barrier",
+    "agree_int_from_main", "any_process_true", "assemble_global_batch", "barrier",
     "initialize_distributed", "local_batch_positions",
 ]

@@ -102,6 +102,22 @@ def any_process_true(flag: bool) -> bool:
     return bool(np.any(flags))
 
 
+def agree_int_from_main(value: int) -> int:
+    """Adopt process 0's value of a host-level int (no-op single-process).
+
+    Used where every process makes a filesystem-dependent decision that
+    MUST come out identical (e.g. which checkpoint tag to resume from —
+    a stale NFS cache could make hosts resolve different fallbacks, and
+    hosts entering the train loop at different iterations deadlock in
+    their first mismatched collective).
+    """
+    if jax.process_count() <= 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+    return int(multihost_utils.broadcast_one_to_all(
+        np.asarray([int(value)]))[0])
+
+
 def barrier(tag: str) -> None:
     """Cross-process barrier (no-op single-process).
 

@@ -15,6 +15,7 @@ sidecar JSON, human-readable for debugging and resume.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -34,7 +35,12 @@ class CheckpointManager:
         self.max_to_keep = max_to_keep
         os.makedirs(directory, exist_ok=True)
         self._meta_path = os.path.join(directory, "state.json")
-        if os.path.isfile(self._meta_path):
+        # Whether bookkeeping came from disk: a checkpoint FILE without
+        # state.json (partial copy) must not be silently resumed with
+        # default meta — that restarts iteration/schedules/ensemble
+        # bookkeeping at 0 under trained weights.
+        self.meta_from_disk = os.path.isfile(self._meta_path)
+        if self.meta_from_disk:
             self.meta: Dict[str, Any] = load_from_json(self._meta_path)
             self.meta.setdefault("iter_at_epoch", {})
         else:
@@ -109,10 +115,7 @@ class CheckpointManager:
 
     def _prune(self) -> None:
         keep = {int(e) for e in self.top_epochs(self.max_to_keep)}
-        for name in os.listdir(self.directory):
-            if not (name.startswith("train_model_")
-                    and name.endswith(".ckpt")):
-                continue
+        for name in self._ckpt_files_on_disk():
             tag = name[len("train_model_"):-len(".ckpt")]
             if tag == LATEST or not tag.isdigit():
                 continue
@@ -141,6 +144,68 @@ class CheckpointManager:
                 meta["current_iter"] = epoch_iter
                 meta["current_epoch"] = int(tag)
         return state, meta
+
+    def load_latest_or_fallback(self, template_state):
+        """Restore ``latest``; on a corrupt file, fall back to the newest
+        readable epoch checkpoint instead of dying.
+
+        Our own writes are atomic (``os.replace``), so this guards against
+        external damage — disk faults, a partially-copied experiment dir,
+        NFS truncation. Falling back loses at most the iterations since
+        the last epoch boundary; silently restarting from scratch (the
+        alternative) would lose the whole run, so if nothing is readable
+        we raise rather than guess.
+
+        Returns ``(state, meta, tag)`` where ``tag`` is ``'latest'`` or
+        the epoch actually loaded.
+        """
+        def brief(e: Exception) -> str:
+            # msgpack's ExtraData repr embeds the remaining (multi-MB)
+            # buffer — keep messages human-sized.
+            return f"{type(e).__name__}: {str(e)[:160]}"
+
+        failures = []
+        if not self.meta_from_disk:
+            # Weights without bookkeeping are not resumable: meta would
+            # say iter 0 and the run would silently restart its
+            # iteration counter and schedules under trained weights.
+            failures.append((LATEST, "state.json missing — resume "
+                                     "iteration unknown"))
+        else:
+            try:
+                state, meta = self.load(template_state, LATEST)
+                return state, meta, LATEST
+            except Exception as e:  # missing file or corrupt bytes (the
+                # msgpack/flax error types vary) — both are
+                # external-damage modes, e.g. a partial rsync
+                failures.append((LATEST, brief(e)))
+        epochs = sorted(
+            (int(e) for e in self.meta["iter_at_epoch"]
+             if self.has_checkpoint(int(e))),
+            key=lambda e: self.meta["iter_at_epoch"][str(e)], reverse=True)
+        for epoch in epochs:
+            try:
+                state, meta = self.load(template_state, epoch)
+            except Exception as e:
+                failures.append((epoch, brief(e)))
+                continue
+            warnings.warn(
+                f"checkpoint 'latest' unreadable "
+                f"({failures[0][1]}); resuming from epoch {epoch} "
+                f"checkpoint instead", stacklevel=2)
+            return state, meta, epoch
+        # Epoch files without bookkeeping (state.json missing/damaged)
+        # cannot be resumed from — the iteration they represent is
+        # unknown — but they prove this is NOT a fresh run, so say so.
+        bookkept = {f"train_model_{int(e)}.ckpt"
+                    for e in self.meta["iter_at_epoch"]}
+        bookkept.add(f"train_model_{LATEST}.ckpt")
+        for name in sorted(set(self._ckpt_files_on_disk()) - bookkept):
+            failures.append((name, "no iteration bookkeeping for this "
+                                   "file (state.json missing or damaged)"))
+        raise RuntimeError(
+            "no readable checkpoint: " + "; ".join(
+                f"{tag}: {err}" for tag, err in failures))
 
     def rewind_to(self, epoch: int, write: bool = True) -> None:
         """Discard bookkeeping newer than ``epoch`` (for
@@ -176,3 +241,19 @@ class CheckpointManager:
 
     def has_checkpoint(self, tag=LATEST) -> bool:
         return os.path.isfile(self._ckpt_path(tag))
+
+    def _ckpt_files_on_disk(self) -> List[str]:
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        return [n for n in names
+                if n.startswith("train_model_") and n.endswith(".ckpt")]
+
+    def has_any_checkpoint(self) -> bool:
+        """Any checkpoint FILE at all — a disk scan, deliberately not the
+        state.json bookkeeping, which can itself be part of the damage
+        (partial copy that missed state.json). Distinguishes a genuinely
+        fresh run from a damaged one; the latter must resume via fallback
+        or raise, never silently restart."""
+        return bool(self._ckpt_files_on_disk())

@@ -126,6 +126,82 @@ def test_resume_from_specific_epoch_retrains(tmp_path):
     assert result["num_models"] == 2
 
 
+def test_corrupt_latest_falls_back_to_epoch_checkpoint(tmp_path):
+    """External damage to train_model_latest.ckpt (our own writes are
+    atomic) must not kill the run: resume falls back to the newest
+    readable epoch checkpoint and retrains from its boundary."""
+    import os
+    import warnings
+
+    cfg = _cfg(tmp_path)
+    ExperimentBuilder(cfg).run_experiment()          # epochs 0,1 complete
+    latest = os.path.join(tmp_path, "smoke", "saved_models",
+                          "train_model_latest.ckpt")
+
+    # Damage mode 1: the file is REPLACED (unlink + new inode — e.g. a
+    # partial rsync). The hard-linked epoch-1 checkpoint is untouched, so
+    # fallback resumes from epoch 1's boundary.
+    os.remove(latest)
+    with open(latest, "wb") as f:
+        f.write(b"truncated garbage")
+    cfg2 = _cfg(tmp_path, continue_from_epoch="latest", total_epochs=2)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        builder = ExperimentBuilder(cfg2)
+    assert any("unreadable" in str(r.message) for r in rec)
+    assert builder.current_iter == 2 * cfg.total_iter_per_epoch
+
+    # Damage mode 1b: 'latest' deleted outright (partial copy that missed
+    # it). Must still fall back — the pre-fix behavior silently restarted
+    # from scratch because the has_checkpoint('latest') guard hit first.
+    os.remove(latest)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        builder = ExperimentBuilder(cfg2)
+    assert any("unreadable" in str(r.message) for r in rec)
+    assert builder.current_iter == 2 * cfg.total_iter_per_epoch
+
+    # Damage mode 2: in-place bit-rot. 'latest' is a hard link to the
+    # newest epoch checkpoint (one write per save), so the shared inode
+    # takes out BOTH and fallback must reach back to epoch 0. (Mode 1b
+    # left no 'latest'; recreate the production hard-link layout first.)
+    os.link(os.path.join(tmp_path, "smoke", "saved_models",
+                         "train_model_1.ckpt"), latest)
+    with open(latest, "r+b") as f:
+        f.write(b"bit rot")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        builder = ExperimentBuilder(cfg2)
+    assert any("unreadable" in str(r.message) for r in rec)
+    assert builder.current_iter == 1 * cfg.total_iter_per_epoch
+
+    # Damage mode 3: partial copy that dropped state.json but kept a
+    # READABLE latest. Loading it would silently restart the iteration
+    # counter and schedules at 0 under trained weights — must raise.
+    models_dir = os.path.join(tmp_path, "smoke", "saved_models")
+    os.remove(latest)
+    os.link(os.path.join(models_dir, "train_model_0.ckpt"), latest)
+    os.remove(os.path.join(models_dir, "state.json"))
+    with pytest.raises(RuntimeError, match="state.json missing"):
+        ExperimentBuilder(_cfg(tmp_path, continue_from_epoch="latest"))
+
+    # Damage mode 3b: no state.json and no latest, epoch files only. The
+    # iteration they represent is unknowable, so this must fail loudly
+    # (naming the unbookkept files) — not silently restart a run whose
+    # checkpoints are sitting right there.
+    os.remove(latest)
+    with pytest.raises(RuntimeError, match="no iteration bookkeeping"):
+        ExperimentBuilder(_cfg(tmp_path, continue_from_epoch="latest"))
+
+    # With EVERY checkpoint damaged too, resuming must also fail loudly.
+    for name in os.listdir(models_dir):
+        if name.endswith(".ckpt"):
+            with open(os.path.join(models_dir, name), "wb") as f:
+                f.write(b"x")
+    with pytest.raises(RuntimeError, match="no readable checkpoint"):
+        ExperimentBuilder(_cfg(tmp_path, continue_from_epoch="latest"))
+
+
 def test_preemption_saves_latest_and_resume_is_exact(tmp_path):
     """Save-on-signal: preempt mid-epoch, resume from 'latest', and the
     final params must equal an uninterrupted run bit-for-bit (same

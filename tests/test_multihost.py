@@ -24,7 +24,11 @@ def cfg():
     return MAMLConfig(
         dataset_name="synthetic", image_height=8, image_width=8,
         image_channels=1, num_classes_per_set=3, num_samples_per_class=2,
-        num_target_samples=2, batch_size=16, mesh_shape=(2, 4))
+        num_target_samples=2, batch_size=16, mesh_shape=(2, 4),
+        # 8px supports two pooling stages (8->4->2); with the default
+        # four, max_pool2d now (correctly) rejects the empty 4th pool —
+        # before that guard this config silently ran on empty features.
+        num_stages=2)
 
 
 @pytest.fixture(scope="module")
@@ -85,3 +89,12 @@ def test_assembled_batch_feeds_sharded_step(cfg, mesh):
         small.batch_size, batch_sharding(mesh))
     res = plan.eval_step(state, batch)
     assert np.isfinite(np.asarray(jax.device_get(res.loss))).all()
+
+
+def test_agreement_helpers_single_process_noop():
+    from howtotrainyourmamlpytorch_tpu.parallel import (
+        agree_int_from_main, any_process_true)
+    assert agree_int_from_main(7) == 7
+    assert agree_int_from_main(-1) == -1
+    assert any_process_true(True) is True
+    assert any_process_true(False) is False
