@@ -5,10 +5,11 @@ Flagship workload (BASELINE.json config #4): Mini-ImageNet 5-way 5-shot,
 gradients, learnable per-layer-per-step inner LRs, per-step batch-norm —
 the MAML++ hot path (SURVEY.md §3.2), jitted as one XLA program with remat
 over inner steps. The executable is selected per epoch exactly as
-``ExperimentBuilder`` does; we bench the STEADY-STATE epoch (20): past the
-multi-step-loss annealing window (``multi_step_loss_num_epochs=15``) the
-step computes the target loss at the final inner step only, matching what
-real training runs for epochs 15..100 (85% of the schedule). The
+``ExperimentBuilder`` does; we bench the STEADY-STATE epoch (the schedule's
+last): past the multi-step-loss annealing window
+(``multi_step_loss_num_epochs=15``) the step computes the target loss at
+the final inner step only, matching what real training runs for epochs
+15..100 (85% of the flagship schedule). The
 MSL-window step (epochs 0..14, 4 extra per-step target forwards) measures
 ~18% slower (docs/PERF.md); run-weighted over the full schedule the
 throughput is ~3% below the number printed here.
@@ -25,7 +26,12 @@ We round UP to 8.0 tasks/s to bias the comparison against ourselves.
 BASELINE.json's north-star target is 4x single-A100, i.e. vs_baseline >= 4.
 
 Usage: python bench.py [--steps N] [--batch B] [--quick]
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+                       [--config experiment_config/<cfg>.json]
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}. With
+--config, any shipped workload is benched instead of the flagship (batch
+and mesh re-shaped to the local device count, everything else as
+shipped); "vs_baseline" is then null — the baseline estimate is for the
+flagship workload only — and a "workload" key names the config.
 """
 
 from __future__ import annotations
@@ -103,14 +109,32 @@ def main() -> int:
                     help="meta-batch size (0 = auto: 12 per device)")
     ap.add_argument("--quick", action="store_true",
                     help="tiny shapes for CI/CPU sanity (not a real bench)")
+    ap.add_argument("--config", default=None, metavar="JSON",
+                    help="bench an experiment_config/*.json workload "
+                         "instead of the flagship (way/shot/backbone/"
+                         "steps/toggles from the file; batch and mesh "
+                         "from --batch / the local device count)")
     args = ap.parse_args()
 
     devices = jax.devices()
     n_dev = len(devices)
-    # 12/chip: best measured operating point on v5e (sweep in docs/PERF.md;
-    # the curve is non-monotonic — 12 beats both 8..10 and 14..28).
-    batch = args.batch or 12 * n_dev
-    cfg = flagship_config(batch, n_dev)
+    if args.config:
+        base = MAMLConfig.from_json_file(args.config)
+        # Default per-chip batch = what real training would run per chip
+        # (the file's global batch over the file's mesh size); only batch
+        # and mesh are re-shaped to the local device count — every
+        # execution knob (microbatching, remat, bn_fast_math, toggles)
+        # stays as shipped so the timed step IS the training step.
+        per_chip = max(
+            base.batch_size // max(int(np.prod(base.mesh_shape)), 1), 1)
+        batch = args.batch or per_chip * n_dev
+        cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev))
+    else:
+        # 12/chip: best measured operating point on v5e (sweep in
+        # docs/PERF.md; the curve is non-monotonic — 12 beats both
+        # 8..10 and 14..28).
+        batch = args.batch or 12 * n_dev
+        cfg = flagship_config(batch, n_dev)
     if args.quick:
         cfg = cfg.replace(
             image_height=16, image_width=16,
@@ -121,11 +145,14 @@ def main() -> int:
     init, apply = make_model(cfg)
     mesh = make_mesh(cfg, devices)
     plan = make_sharded_steps(cfg, apply, mesh)
-    # Steady-state epoch: past the DA boundary (second order ON) and the
-    # MSL annealing window (target loss at the final step only) — the
-    # executable real training runs for epochs 15..100, selected exactly
-    # as ExperimentBuilder does per epoch.
-    bench_epoch = 20
+    # Steady-state epoch = the LAST training epoch: by definition an
+    # executable real training runs, and past every annealing boundary
+    # that is ever crossed (DA's switch to second order, MSL's window),
+    # whatever the config's schedule looks like. Selected exactly as
+    # ExperimentBuilder does per epoch. For the flagship (total_epochs
+    # 100, DA boundary -1, MSL window 15) this is the second-order,
+    # final-step-loss executable of epochs 15..99.
+    bench_epoch = max(cfg.total_epochs - 1, 0)
     train = plan.train_steps[(cfg.use_second_order(bench_epoch),
                               cfg.use_msl(bench_epoch))]
 
@@ -165,12 +192,18 @@ def main() -> int:
         rates.append(cfg.batch_size * per_window / dt)
 
     per_chip = float(np.median(rates)) / n_dev
-    print(json.dumps({
+    out = {
         "metric": "meta_tasks_per_sec_per_chip",
         "value": round(per_chip, 3),
         "unit": "tasks/s/chip",
-        "vs_baseline": round(per_chip / BASELINE_TASKS_PER_SEC, 3),
-    }))
+        # The baseline estimate is for the FLAGSHIP workload; a ratio
+        # against it means nothing for an arbitrary --config.
+        "vs_baseline": (None if args.config
+                        else round(per_chip / BASELINE_TASKS_PER_SEC, 3)),
+    }
+    if args.config:
+        out["workload"] = cfg.experiment_name
+    print(json.dumps(out))
     return 0
 
 
