@@ -145,6 +145,10 @@ class MAMLConfig:
                                            # promptly; 0 = never)
     experiment_root: str = "experiments"
     profile_dir: Optional[str] = None      # jax.profiler trace output dir
+    # Persistent XLA compilation cache (jax_compilation_cache_dir): first
+    # TPU compiles cost tens of seconds; with a cache dir, restarts and
+    # preemption-resumes reload compiled executables instead. None = off.
+    compilation_cache_dir: Optional[str] = None
     profile_epoch: int = 0                 # epoch whose first steps to trace
     profile_num_steps: int = 5             # steps to trace at that epoch
 

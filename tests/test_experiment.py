@@ -247,3 +247,35 @@ def test_preemption_saves_latest_and_resume_is_exact(tmp_path):
     # The mid-epoch snapshot must not have entered the ensemble set.
     stats = load_statistics(builder_b2.paths["logs"])
     assert stats["epoch"] == ["0", "1"]
+
+
+def test_compilation_cache_dir_populated(tmp_path):
+    """compilation_cache_dir wires up JAX's persistent executable cache so
+    restarts skip recompilation."""
+    import json
+    import os
+
+    import train_maml_system
+
+    import jax
+
+    cache = tmp_path / "xla_cache"
+    cfg = _cfg(tmp_path, total_epochs=1, total_iter_per_epoch=2,
+               num_evaluation_tasks=4)
+    cfg_path = tmp_path / "cfg.json"
+    payload = {k: v for k, v in cfg.to_dict().items() if v is not None}
+    cfg_path.write_text(json.dumps(payload))
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        train_maml_system.main([
+            "--name_of_args_json_file", str(cfg_path),
+            "--compilation_cache_dir", str(cache)])
+        assert cache.is_dir() and os.listdir(cache), (
+            "no compiled executables were persisted")
+    finally:
+        # main() mutates global jax.config; don't leak a tmp cache dir
+        # into every later test in this process.
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)

@@ -90,6 +90,16 @@ def main(argv=None) -> int:
           f"{cfg.dataset_name} | {cfg.num_classes_per_set}-way "
           f"{cfg.num_samples_per_class}-shot | mesh {cfg.mesh_shape}"
           + (f" | multihost: {multihost}" if multihost else ""))
+    if cfg.compilation_cache_dir:
+        # Persistent executable cache: a resumed/restarted run reloads
+        # its compiled train/eval steps instead of paying the multi-10s
+        # TPU compiles again. Safe to share across hosts (content-keyed).
+        import jax as _jax
+        _jax.config.update("jax_compilation_cache_dir",
+                           cfg.compilation_cache_dir)
+        # Cache EVERY executable (default threshold skips sub-second
+        # compiles — but a restart replays dozens of those too).
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     # Dataset provisioning: single extractor (process 0), everyone waits —
     # concurrent unzip into a shared dataset dir would corrupt it. The
     # barrier sits in a finally so a provisioning failure on process 0
