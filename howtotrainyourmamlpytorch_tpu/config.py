@@ -149,6 +149,10 @@ class MAMLConfig:
     # TPU compiles cost tens of seconds; with a cache dir, restarts and
     # preemption-resumes reload compiled executables instead. None = off.
     compilation_cache_dir: Optional[str] = None
+    # TensorBoard scalar logging (beyond-reference observability; the
+    # reference logs CSVs only, which we also keep). Events are written
+    # under <experiment>/logs/tensorboard/ when enabled.
+    use_tensorboard: bool = False
     profile_epoch: int = 0                 # epoch whose first steps to trace
     profile_num_steps: int = 5             # steps to trace at that epoch
 

@@ -89,6 +89,16 @@ class ExperimentBuilder:
 
         self.jsonl = JsonlLogger(f"{self.paths['logs']}/events.jsonl",
                                  enabled=self.is_main_process)
+        self._tb = None
+        if cfg.use_tensorboard and self.is_main_process:
+            try:
+                from tensorboardX import SummaryWriter
+                self._tb = SummaryWriter(
+                    f"{self.paths['logs']}/tensorboard")
+            except ImportError:
+                warnings.warn("use_tensorboard=True but tensorboardX is "
+                              "not installed; falling back to CSV/JSONL "
+                              "only", stacklevel=2)
         self.state = init_train_state(cfg, self.model_init,
                                       jax.random.PRNGKey(cfg.seed))
         self.current_iter = 0
@@ -320,6 +330,15 @@ class ExperimentBuilder:
 
     # ------------------------------------------------------------------
     def run_experiment(self) -> Dict[str, Any]:
+        try:
+            return self._run_experiment()
+        finally:
+            if self._tb is not None:
+                # Release the async writer thread + event-file handle (a
+                # sweep driver may build many ExperimentBuilders).
+                self._tb.close()
+
+    def _run_experiment(self) -> Dict[str, Any]:
         cfg = self.cfg
         if cfg.evaluate_on_test_set_only:
             return self.run_test_protocol()
@@ -369,6 +388,11 @@ class ExperimentBuilder:
         self.jsonl.log("validation", epoch=epoch,
                        val_loss=val_stats["loss"],
                        val_accuracy=val_stats["accuracy"])
+        if self._tb is not None:
+            for key, value in row.items():
+                if key != "epoch":
+                    self._tb.add_scalar(key, float(value), epoch)
+            self._tb.flush()
         self.ckpt.save(self.state, epoch, self.current_iter,
                        val_stats["accuracy"],
                        write=self.is_main_process)

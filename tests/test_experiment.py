@@ -279,3 +279,16 @@ def test_compilation_cache_dir_populated(tmp_path):
         jax.config.update("jax_compilation_cache_dir", prev_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           prev_min)
+
+
+def test_tensorboard_scalars_written(tmp_path):
+    """use_tensorboard adds event files without disturbing the CSV path."""
+    pytest.importorskip("tensorboardX")
+    cfg = _cfg(tmp_path, use_tensorboard=True, total_epochs=1,
+               total_iter_per_epoch=2, num_evaluation_tasks=4)
+    builder = ExperimentBuilder(cfg)
+    builder.run_experiment()
+    tb_dir = os.path.join(builder.paths["logs"], "tensorboard")
+    assert os.path.isdir(tb_dir) and os.listdir(tb_dir)
+    stats = load_statistics(builder.paths["logs"])  # CSV still written
+    assert stats["epoch"] == ["0"]
