@@ -89,16 +89,8 @@ class ExperimentBuilder:
 
         self.jsonl = JsonlLogger(f"{self.paths['logs']}/events.jsonl",
                                  enabled=self.is_main_process)
-        self._tb = None
-        if cfg.use_tensorboard and self.is_main_process:
-            try:
-                from tensorboardX import SummaryWriter
-                self._tb = SummaryWriter(
-                    f"{self.paths['logs']}/tensorboard")
-            except ImportError:
-                warnings.warn("use_tensorboard=True but tensorboardX is "
-                              "not installed; falling back to CSV/JSONL "
-                              "only", stacklevel=2)
+        self._tb = None             # lazy SummaryWriter (_finish_epoch)
+        self._tb_disabled = False   # set if tensorboardX import fails
         self.state = init_train_state(cfg, self.model_init,
                                       jax.random.PRNGKey(cfg.seed))
         self.current_iter = 0
@@ -388,11 +380,28 @@ class ExperimentBuilder:
         self.jsonl.log("validation", epoch=epoch,
                        val_loss=val_stats["loss"],
                        val_accuracy=val_stats["accuracy"])
-        if self._tb is not None:
-            for key, value in row.items():
-                if key != "epoch":
-                    self._tb.add_scalar(key, float(value), epoch)
-            self._tb.flush()
+        if (self.cfg.use_tensorboard and self.is_main_process
+                and not self._tb_disabled):
+            # Created lazily at first scalar write: an __init__-time
+            # writer would leak its async thread whenever a builder is
+            # constructed but never run (and would scaffold an empty
+            # tensorboard dir on evaluate-only runs).
+            if self._tb is None:
+                try:
+                    from tensorboardX import SummaryWriter
+                    self._tb = SummaryWriter(
+                        f"{self.paths['logs']}/tensorboard")
+                except ImportError:
+                    warnings.warn(
+                        "use_tensorboard=True but tensorboardX is not "
+                        "installed; falling back to CSV/JSONL only",
+                        stacklevel=2)
+                    self._tb_disabled = True
+            if self._tb is not None:
+                for key, value in row.items():
+                    if key != "epoch":
+                        self._tb.add_scalar(key, float(value), epoch)
+                self._tb.flush()
         self.ckpt.save(self.state, epoch, self.current_iter,
                        val_stats["accuracy"],
                        write=self.is_main_process)

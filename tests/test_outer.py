@@ -260,3 +260,56 @@ def test_task_microbatches_must_divide_batch():
     init, apply = make_model(CFG.replace(task_microbatches=3))
     with pytest.raises(ValueError, match="divide"):
         make_train_step(CFG.replace(task_microbatches=3), apply)
+
+
+def test_eval_adaptation_gain_on_permuted_tasks():
+    """The few-shot mechanism itself: with a random per-episode class-label
+    permutation the initialization alone cannot classify (the mapping
+    changes every episode) — accuracy must come from inner-loop adaptation
+    on the support set, and must increase with more adaptation steps.
+    Deterministic (fixed seeds, CPU), so the inequalities are exact
+    regression checks, not statistical ones."""
+    cfg = CFG.replace(number_of_training_steps_per_iter=3,
+                      number_of_evaluation_steps_per_iter=3)
+
+    def permuted_batch(key, batch_size):
+        n, k, t = (cfg.num_classes_per_set, cfg.num_samples_per_class,
+                   cfg.num_target_samples)
+        h, w, c = cfg.image_shape
+        ks = jax.random.split(key, 3)
+        perms = jnp.stack([jax.random.permutation(kk, n)
+                           for kk in jax.random.split(ks[0], batch_size)])
+
+        def gen(key, per):
+            noise = jax.random.normal(
+                key, (batch_size, n, per, h, w, c)) * 0.3
+            means = perms[:, :, None, None, None, None].astype(jnp.float32)
+            x = (noise + means).reshape(batch_size, n * per, h, w, c)
+            y = jnp.tile(jnp.repeat(jnp.arange(n), per)[None],
+                         (batch_size, 1)).astype(jnp.int32)
+            return x, y
+
+        sx, sy = gen(ks[1], k)
+        tx, ty = gen(ks[2], t)
+        return Episode(sx, sy, tx, ty)
+
+    init, apply = make_model(cfg)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    step = jax.jit(functools.partial(make_train_step(cfg, apply),
+                                     second_order=True, use_msl=False))
+    for i in range(60):
+        state, metrics = step(state, permuted_batch(
+            jax.random.PRNGKey(1000 + i), 8), jnp.float32(20))
+    assert float(metrics.accuracy) > 0.95
+
+    def eval_acc(num_steps):
+        ecfg = cfg.replace(number_of_evaluation_steps_per_iter=num_steps)
+        ev = jax.jit(make_eval_step(ecfg, apply))
+        accs = [np.asarray(ev(state, permuted_batch(
+            jax.random.PRNGKey(5000 + j), 8)).accuracy).mean()
+            for j in range(4)]
+        return float(np.mean(accs))
+
+    acc1, acc3 = eval_acc(1), eval_acc(3)
+    assert acc3 > acc1, (acc1, acc3)      # more adaptation -> better
+    assert acc3 > 0.99, acc3              # full adaptation solves the task
