@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 import os
 import warnings
 from typing import Any, Dict, List, Optional, Tuple, Union
@@ -70,10 +71,27 @@ class MAMLConfig:
     num_samples_per_class: int = 1    # K-shot (support)
     num_target_samples: int = 1       # target (query) samples per class
     batch_size: int = 16              # meta-batch: tasks per outer step
+    # Pre-split layout (<dataset>/{train,val,test}/<class>/…) vs one flat
+    # class pool split by ``train_val_test_split`` fractions (reference
+    # ``data.py § load_dataset`` branches on this flag).
     sets_are_pre_split: bool = True
-    load_into_memory: bool = False
-    labels_as_int: bool = False
+    # Class-ordered fractions used when ``sets_are_pre_split`` is False.
+    # ASSUMPTION (reference mount empty — see MOUNT-AUDIT.md): classes are
+    # ordered deterministically (sorted) and split contiguously.
+    train_val_test_split: Tuple[float, float, float] = (0.64, 0.16, 0.20)
+    load_into_memory: bool = False    # eagerly decode the whole split
+    labels_as_int: bool = False       # class folder names sort numerically
+    # Which path components of an image file form its class identity
+    # (reference: omniglot's nested alphabet/character layout uses
+    # (-3, -2)). Components outside the dataset root are ignored, so the
+    # default also handles flat <root>/<class>/<img> layouts.
     indexes_of_folders_indicating_class: Tuple[int, ...] = (-3, -2)
+    # Per-channel normalization constants applied to [0,1] pixels as
+    # (x - mean) / std (after optional channel reversal). None = the
+    # documented per-dataset assumption: grayscale identity; RGB
+    # mean=std=0.5 (i.e. x -> 2x-1). See MOUNT-AUDIT.md.
+    image_norm_mean: Optional[Tuple[float, ...]] = None
+    image_norm_std: Optional[Tuple[float, ...]] = None
 
     # ---- backbone ------------------------------------------------------
     num_stages: int = 4
@@ -139,6 +157,16 @@ class MAMLConfig:
                                            # batches device-resident across
                                            # epochs (they are deterministic;
                                            # re-transfer is pure waste)
+    eval_batch_size: int = 0               # meta-batch for val/test sweeps
+                                           # (no outer-grad memory pressure,
+                                           # so much larger than the train
+                                           # batch fits; 0 = auto: 8x train
+                                           # batch, capped at the padded
+                                           # evaluation episode count)
+    live_progress: bool = True             # in-epoch running loss/acc line
+                                           # at each dispatch sync (the
+                                           # reference's tqdm equivalent);
+                                           # process 0 only
     dispatch_sync_every: int = 50          # train iters between host->device
                                            # syncs (bounds async run-ahead so
                                            # SIGTERM preemption lands
@@ -180,6 +208,22 @@ class MAMLConfig:
                 f"{self.task_microbatches}")
         if self.number_of_training_steps_per_iter < 1:
             raise ValueError("need at least one inner step")
+        if self.eval_batch_size < 0:
+            raise ValueError("eval_batch_size must be >= 0 (0 = auto)")
+        if (len(self.train_val_test_split) != 3
+                or any(f < 0 for f in self.train_val_test_split)):
+            raise ValueError(
+                f"train_val_test_split must be three non-negative "
+                f"fractions, got {self.train_val_test_split}")
+        for field in ("image_norm_mean", "image_norm_std"):
+            v = getattr(self, field)
+            if v is not None and len(v) not in (1, self.image_channels):
+                raise ValueError(
+                    f"{field} must have 1 or image_channels="
+                    f"{self.image_channels} entries, got {len(v)}")
+        if (self.image_norm_std is not None
+                and any(s == 0 for s in self.image_norm_std)):
+            raise ValueError("image_norm_std entries must be non-zero")
 
     # ---- derived values -------------------------------------------------
     @property
@@ -234,12 +278,70 @@ class MAMLConfig:
 
     @property
     def lslr_num_steps(self) -> int:
-        """Rows per LSLR learning-rate vector: one per possible inner step,
-        covering eval step counts that exceed the training count (those
-        extra rows simply keep their ``task_learning_rate`` init since no
-        gradient ever reaches them)."""
+        """Rows per LSLR learning-rate vector.
+
+        Reference sizing is ``num_inner_steps + 1`` (``inner_loop_optimizers
+        .py § LSLRGradientDescentLearningRule.initialise`` allocates
+        ``(K+1,)`` vectors; ``update_params`` only ever indexes rows
+        ``0..K-1``, so the final row keeps its init). We reproduce the
+        ``+1`` for audit parity and additionally cover eval step counts
+        that exceed the training count (those extra rows also keep their
+        ``task_learning_rate`` init since no gradient ever reaches them)."""
         return max(self.number_of_training_steps_per_iter,
-                   self.number_of_evaluation_steps_per_iter)
+                   self.number_of_evaluation_steps_per_iter) + 1
+
+    @property
+    def image_norm_constants(self) -> Tuple[Tuple[float, ...],
+                                            Tuple[float, ...]]:
+        """Resolved per-channel (mean, std), applied to [0,1] pixels as
+        ``(x - mean) / std`` after any channel reversal.
+
+        Defaults encode the documented assumption (reference mount empty,
+        MOUNT-AUDIT.md): grayscale datasets stay in [0,1] (identity);
+        RGB datasets use mean=std=0.5 per channel, i.e. ``x -> 2x - 1``.
+        """
+        c = self.image_channels
+        mean = self.image_norm_mean
+        std = self.image_norm_std
+        if mean is None:
+            mean = (0.0,) if c == 1 else (0.5,) * c
+        if std is None:
+            std = (1.0,) if c == 1 else (0.5,) * c
+        if len(mean) == 1:
+            mean = mean * c
+        if len(std) == 1:
+            std = std * c
+        return tuple(float(m) for m in mean), tuple(float(s) for s in std)
+
+    @property
+    def image_norm_resolved(self) -> Tuple[Tuple[float, ...],
+                                           Tuple[float, ...], bool]:
+        """``(mean, inv_std, identity)`` — the single resolution point
+        both the host (data/sampler.py) and device (ops/episode.py)
+        normalization paths consume, so the two cannot drift."""
+        mean, std = self.image_norm_constants
+        inv_std = tuple(1.0 / s for s in std)
+        identity = (all(m == 0.0 for m in mean)
+                    and all(s == 1.0 for s in std))
+        return mean, inv_std, identity
+
+    @property
+    def effective_eval_batch_size(self) -> int:
+        """Meta-batch used for val/test sweeps.
+
+        Evaluation has no outer-gradient memory pressure (no second-order
+        graph, no optimizer update), so a much larger meta-batch fits and
+        cuts per-epoch validation wall-clock. Auto (``eval_batch_size=0``):
+        8x the train batch, capped at the evaluation episode count padded
+        up to a multiple of the mesh size. Episode composition and results
+        are batch-size-invariant (tasks are vmapped independently), so
+        this changes wall-clock only, never accuracy.
+        """
+        if self.eval_batch_size > 0:
+            return self.eval_batch_size
+        mesh_n = max(int(math.prod(self.mesh_shape)), 1)
+        cap = -(-self.num_evaluation_tasks // mesh_n) * mesh_n
+        return max(min(8 * self.batch_size, cap), self.batch_size)
 
     def use_second_order(self, epoch: int) -> bool:
         """Derivative-order annealing (reference:
@@ -282,7 +384,9 @@ class MAMLConfig:
             kwargs["clamp_meta_grad_value"] = 10.0
         # JSON has no tuples; normalize list-valued fields.
         for tup_field in ("mesh_shape", "mesh_axis_names",
-                          "indexes_of_folders_indicating_class"):
+                          "indexes_of_folders_indicating_class",
+                          "train_val_test_split",
+                          "image_norm_mean", "image_norm_std"):
             if tup_field in kwargs and isinstance(kwargs[tup_field], list):
                 kwargs[tup_field] = tuple(kwargs[tup_field])
         kwargs["ignored_keys"] = tuple(sorted(ignored))

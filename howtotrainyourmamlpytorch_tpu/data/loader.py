@@ -138,7 +138,10 @@ class MetaLearningDataLoader:
 
     def _eval_batches(self, split: str) -> Iterator[Episode]:
         cfg = self.cfg
-        b = cfg.batch_size
+        # Eval has no outer-grad memory pressure, so it runs a (usually
+        # much) larger meta-batch than training — same fixed episodes,
+        # fewer dispatches per sweep (cfg.effective_eval_batch_size).
+        b = cfg.effective_eval_batch_size
         # Pad the fixed episode count up to a full final batch; the caller
         # truncates to num_evaluation_tasks (episodes are deterministic, so
         # the padding episodes are well-defined, just extra).

@@ -42,6 +42,11 @@ class EpisodeSampler:
         # falls back to the host-f32 path otherwise.
         self.emit_uint8 = (cfg.transfer_images_uint8
                            and hasattr(source, "get_images_raw"))
+        # Per-dataset normalization constants, config-resolved (defaults
+        # documented at MAMLConfig.image_norm_constants / MOUNT-AUDIT.md).
+        mean, inv_std, self._norm_identity = cfg.image_norm_resolved
+        self._norm_mean = np.asarray(mean, np.float32)
+        self._norm_inv_std = np.asarray(inv_std, np.float32)
         base = list(source.class_names)
         if self.augment:
             # Virtual class = (physical class, rotation quarter-turns).
@@ -57,18 +62,18 @@ class EpisodeSampler:
 
     # -- normalization ---------------------------------------------------
     def _normalize(self, x: np.ndarray) -> np.ndarray:
-        """Per-dataset affine normalization on [0,1] inputs.
-
-        Assumption (reference mount empty — re-verify if it appears):
-        Omniglot-style grayscale stays in [0, 1]; RGB datasets are
-        standardized to zero-mean/unit-ish range via 2x-0.5 scaling.
-        """
-        if self.cfg.image_channels == 1:
-            return x
-        x = 2.0 * x - 1.0
+        """Per-dataset affine normalization on [0,1] inputs: optional
+        channel reversal, then ``(x - mean) * (1/std)`` with the
+        config-resolved constants (``cfg.image_norm_constants`` — defaults
+        keep grayscale in [0,1] and map RGB to [-1,1]; the exact reference
+        constants are unverifiable against the empty mount, see
+        MOUNT-AUDIT.md). Must stay in lockstep with the device path
+        (ops/episode.normalize_episode)."""
         if self.cfg.reverse_channels:
             x = x[..., ::-1]
-        return x
+        if self._norm_identity:
+            return x
+        return (x - self._norm_mean) * self._norm_inv_std
 
     # -- episode sampling ------------------------------------------------
     def sample(self, idx: int) -> Episode:

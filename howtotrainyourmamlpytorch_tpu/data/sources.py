@@ -60,34 +60,77 @@ class ArraySource:
 
 
 class DiskImageSource:
-    """Lazy class→file-path index over the reference's directory layout.
+    """Lazy class→file-path index over the reference's directory layouts.
 
-    ``root/<class>/<image files>``; images are decoded with PIL and resized
-    to ``image_size`` on access. Decoded classes are memoized (the episodic
-    benchmarks revisit classes constantly and fit in RAM).
+    Flat ``root/<class>/<image files>`` and nested layouts (e.g. Omniglot's
+    ``root/<alphabet>/<character>/<images>``) are both indexed; the class
+    identity of an image is formed from the path components selected by
+    ``class_key_indexes`` (reference ``indexes_of_folders_indicating_class``
+    — negative indexes counted from the file name; components that fall
+    outside the dataset root are ignored, so the reference default
+    ``(-3, -2)`` resolves to ``alphabet/character`` in the nested layout and
+    to ``<class>`` in the flat one). ``None`` uses the full relative
+    directory path.
+
+    Images are decoded with PIL and resized to ``image_size`` on access;
+    decoded classes are memoized (the episodic benchmarks revisit classes
+    constantly and fit in RAM). ``preload`` (reference ``load_into_memory``)
+    decodes every class eagerly at construction. ``numeric_sort`` (reference
+    ``labels_as_int``) orders integer-named classes numerically.
     """
 
     IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
 
-    def __init__(self, root: str, image_size: Tuple[int, int, int]):
+    def __init__(self, root: str, image_size: Tuple[int, int, int],
+                 preload: bool = False, numeric_sort: bool = False,
+                 class_key_indexes: Optional[Sequence[int]] = None):
         self.root = root
         self.image_size = image_size
+        self.numeric_sort = numeric_sort
         self._index: Dict[str, List[str]] = {}
         self._cache: Dict[str, np.ndarray] = {}
-        for cls in sorted(os.listdir(root)):
-            cdir = os.path.join(root, cls)
-            if not os.path.isdir(cdir):
-                continue
+        root_norm = root.rstrip("/\\") or root
+        for dirpath, dirnames, filenames in os.walk(root_norm):
+            dirnames.sort()
             files = sorted(
-                os.path.join(cdir, f) for f in os.listdir(cdir)
+                os.path.join(dirpath, f) for f in filenames
                 if f.lower().endswith(self.IMAGE_EXTS))
-            if files:
-                self._index[cls] = files
+            if not files:
+                continue
+            rel = os.path.relpath(dirpath, root_norm)
+            if rel == ".":
+                continue  # images directly under root carry no class
+            relparts = rel.split(os.sep)
+            key = self._class_key(relparts, class_key_indexes)
+            self._index.setdefault(key, []).extend(files)
         if not self._index:
             raise ValueError(f"no image classes found under {root}")
+        if preload:
+            for name in self._index:
+                self._load_class(name)
+
+    @staticmethod
+    def _class_key(relparts: List[str],
+                   indexes: Optional[Sequence[int]]) -> str:
+        if indexes is None:
+            return "/".join(relparts)
+        # Index into the file's path components, file name at -1 (never a
+        # class component) — i.e. -2 is the containing directory. Indexes
+        # reaching above the dataset root are dropped.
+        parts = relparts + [None]  # type: ignore[list-item]
+        picked = [parts[i] for i in indexes
+                  if -len(parts) <= i < 0 and parts[i] is not None]
+        return "/".join(picked) if picked else "/".join(relparts)
 
     @property
     def class_names(self) -> List[str]:
+        if self.numeric_sort:
+            def key(name: str):
+                try:
+                    return (0, int(name), name)
+                except ValueError:
+                    return (1, 0, name)
+            return sorted(self._index, key=key)
         return sorted(self._index)
 
     def num_images(self, class_name: str) -> int:
@@ -120,6 +163,60 @@ class DiskImageSource:
         return self._load_class(class_name)[indices]
 
 
+class SubsetSource:
+    """Restrict a source to a subset of its classes, preserving order —
+    the split view over one flat class pool (``sets_are_pre_split=False``).
+    """
+
+    def __init__(self, source, names: Sequence[str]):
+        missing = set(names) - set(source.class_names)
+        if missing:
+            raise ValueError(f"classes not in source: {sorted(missing)}")
+        if not names:
+            raise ValueError("SubsetSource needs at least one class")
+        self._source = source
+        self._names = list(names)
+
+    @property
+    def class_names(self) -> List[str]:
+        return self._names
+
+    def num_images(self, class_name: str) -> int:
+        return self._source.num_images(class_name)
+
+    def get_images(self, class_name: str,
+                   indices: np.ndarray) -> np.ndarray:
+        return self._source.get_images(class_name, indices)
+
+    def get_images_raw(self, class_name: str,
+                       indices: np.ndarray) -> np.ndarray:
+        return self._source.get_images_raw(class_name, indices)
+
+
+def split_class_names(names: Sequence[str],
+                      fractions: Sequence[float],
+                      split: str) -> List[str]:
+    """Deterministic contiguous class split of one flat pool by
+    (train, val, test) fractions — reference ``data.py § load_dataset``
+    when ``sets_are_pre_split`` is False. ASSUMPTION (mount empty, see
+    MOUNT-AUDIT.md): classes are taken in the source's deterministic order
+    and split contiguously; fractions are normalized by their sum."""
+    if split not in SPLITS:
+        raise ValueError(f"unknown split {split!r}")
+    total = float(sum(fractions))
+    if total <= 0:
+        raise ValueError(f"train_val_test_split sums to {total}")
+    n = len(names)
+    # Cumulative rounding so per-split rounding errors can't leak classes
+    # into a split whose fraction says it should be empty (independent
+    # round(f*n) per split would: e.g. (0.5, 0.5, 0) over 5 classes).
+    c1 = int(round(fractions[0] / total * n))
+    c2 = int(round((fractions[0] + fractions[1]) / total * n))
+    bounds = {"train": (0, c1), "val": (c1, c2), "test": (c2, n)}
+    lo, hi = bounds[split]
+    return list(names[lo:hi])
+
+
 class SyntheticSource(ArraySource):
     """Deterministic procedurally-generated classes (tests / benchmarks).
 
@@ -146,18 +243,34 @@ _SPLIT_SEEDS = {"train": 0, "val": 1, "test": 2}
 def build_source(cfg, split: str):
     """Resolve a split's image source from the config.
 
-    Disk layout ``<cfg.dataset_dir>/<split>/<class>/…`` when present —
-    where ``dataset_dir`` is ``dataset_path/dataset_name`` (the reference's
+    ``sets_are_pre_split=True`` (default): disk layout
+    ``<cfg.dataset_dir>/<split>/<class>/…`` when present — where
+    ``dataset_dir`` is ``dataset_path/dataset_name`` (the reference's
     contract) or ``dataset_path`` itself if it already holds the split
-    dirs. Otherwise a synthetic fallback (with a warning unless the
-    dataset name says 'synthetic') so the framework runs end-to-end with
-    no datasets installed.
+    dirs. ``sets_are_pre_split=False``: one flat class pool under
+    ``dataset_dir``, partitioned into class-disjoint splits by
+    ``cfg.train_val_test_split``. Either way ``load_into_memory``,
+    ``labels_as_int`` and ``indexes_of_folders_indicating_class`` shape
+    the disk index (see :class:`DiskImageSource`). Otherwise a synthetic
+    fallback (with a warning unless the dataset name says 'synthetic') so
+    the framework runs end-to-end with no datasets installed.
     """
     if split not in SPLITS:
         raise ValueError(f"unknown split {split!r}")
-    root = os.path.join(cfg.dataset_dir, split)
-    if os.path.isdir(root):
-        return DiskImageSource(root, cfg.image_shape)
+    disk_kwargs = dict(
+        preload=cfg.load_into_memory,
+        numeric_sort=cfg.labels_as_int,
+        class_key_indexes=cfg.indexes_of_folders_indicating_class)
+    if cfg.sets_are_pre_split:
+        root = os.path.join(cfg.dataset_dir, split)
+        if os.path.isdir(root):
+            return DiskImageSource(root, cfg.image_shape, **disk_kwargs)
+    else:
+        root = cfg.dataset_dir
+        if os.path.isdir(root):
+            pool = DiskImageSource(root, cfg.image_shape, **disk_kwargs)
+            return SubsetSource(pool, split_class_names(
+                pool.class_names, cfg.train_val_test_split, split))
     if "synthetic" not in cfg.dataset_name:
         warnings.warn(
             f"dataset split directory {root!r} not found; using a "

@@ -20,6 +20,7 @@ TPU-first notes:
 from __future__ import annotations
 
 import signal
+import sys
 import time
 import warnings
 from typing import Any, Dict, Iterable, List, Optional, Tuple
@@ -31,7 +32,7 @@ import numpy as np
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
 from howtotrainyourmamlpytorch_tpu.meta.outer import (
-    MetaTrainState, init_train_state)
+    MetaTrainState, init_train_state, migrate_lslr_rows)
 from howtotrainyourmamlpytorch_tpu.models import make_model
 from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
     make_mesh, make_sharded_steps, replicated_sharding)
@@ -130,11 +131,16 @@ class ExperimentBuilder:
                     peer_msg + "; aborting resume on all hosts")
 
         # OR-reduce, not process-0 broadcast: if ANY host sees checkpoint
-        # files, this is not a fresh run — a stale-empty view on process 0
-        # must end in a loud load failure below, never a silent restart
-        # that overwrites the existing run.
+        # files OR on-disk bookkeeping, this is not a fresh run — a
+        # stale-empty view on process 0 must end in a loud load failure
+        # below, never a silent restart that overwrites the existing run.
+        # meta_from_disk matters on its own: a damaged dir that lost every
+        # .ckpt but kept state.json would otherwise "restart fresh" while
+        # CheckpointManager keeps stale top-epoch bookkeeping pointing at
+        # files that no longer exist.
         if from_latest and not any_process_true(
-                self.ckpt.has_any_checkpoint()):
+                self.ckpt.has_any_checkpoint()
+                or self.ckpt.meta_from_disk):
             return  # fresh run with continue_from_epoch='latest'
                     # (reference default for restartable jobs)
         err: Optional[BaseException] = None
@@ -182,10 +188,24 @@ class ExperimentBuilder:
                 "hosts instead of deadlocking in the first mismatched "
                 "collective. " + detail)
         self.current_iter = local_iter
+        if self._multihost:
+            # Same tag AND iteration can still mean different weight BYTES
+            # (a stale cache serving an old ckpt file under a fresh
+            # state.json): agree on a cheap content fingerprint of the
+            # loaded file too.
+            local_fp = self.ckpt.fingerprint(tag)
+            if any_process_true(
+                    agree_int_from_main(local_fp) != local_fp):
+                raise RuntimeError(
+                    "hosts disagree on the resume checkpoint's content "
+                    "fingerprint (same tag, different bytes — stale "
+                    "filesystem cache?); aborting all hosts")
         if tag != LATEST:
             # Rewind: epochs after the resume point are abandoned; their
             # checkpoints must not feed the top-k ensemble.
             self.ckpt.rewind_to(int(tag), write=self.is_main_process)
+        # Pre-(K+1) LSLR checkpoint format: pad in place of failing.
+        self.state = migrate_lslr_rows(self.cfg, self.state)
         print(f"resumed from checkpoint {tag!r} at iter "
               f"{self.current_iter}")
 
@@ -204,6 +224,14 @@ class ExperimentBuilder:
                       - self.current_iter % cfg.total_iter_per_epoch)
         step_fn = self.plan.train_steps[(cfg.use_second_order(epoch),
                                          cfg.use_msl(epoch))]
+        # Live in-epoch progress (the reference's tqdm running loss/acc
+        # line) rides the dispatch-sync fetches — the loss scalar is being
+        # pulled there anyway, so the line costs one extra scalar transfer
+        # per sync, zero extra device syncs. Process 0 only.
+        live = (cfg.live_progress and self.is_main_process
+                and cfg.dispatch_sync_every > 0)
+        live_tty = live and getattr(sys.stdout, "isatty", lambda: False)()
+        live_samples: List[Tuple[float, float]] = []
         metrics_acc = []
         timer = StepTimer()
         t0 = time.time()
@@ -237,7 +265,21 @@ class ExperimentBuilder:
                     # stop decision is OR-agreed here so every process
                     # breaks at the SAME iteration (a lone host breaking
                     # early would strand the others' collectives).
-                    float(jax.device_get(metrics.loss))
+                    loss_now = float(jax.device_get(metrics.loss))
+                    if live:
+                        live_samples.append(
+                            (loss_now,
+                             float(jax.device_get(metrics.accuracy))))
+                        means = np.mean(live_samples, axis=0)
+                        done = ((self.current_iter - 1)
+                                % cfg.total_iter_per_epoch + 1)
+                        line = (f"epoch {epoch}: iter {done}"
+                                f"/{cfg.total_iter_per_epoch} "
+                                f"loss {means[0]:.4f} acc {means[1]:.4f}")
+                        if live_tty:
+                            print(f"\r{line}", end="", flush=True)
+                        else:
+                            print(line, flush=True)
                     if self._multihost:
                         self._preempted = any_process_true(self._preempted)
                     if self._preempted:
@@ -249,6 +291,8 @@ class ExperimentBuilder:
                 jax.block_until_ready(self.state.params)
                 prof.__exit__(None, None, None)
         jax.block_until_ready(self.state.params)
+        if live_tty and live_samples:
+            print("\r\x1b[K", end="")  # clear the in-place progress line
         if self._preempted:
             # Mid-epoch snapshot to 'latest' only; resume continues at
             # exactly this iteration with the same deterministic batch
@@ -391,11 +435,14 @@ class ExperimentBuilder:
                     from tensorboardX import SummaryWriter
                     self._tb = SummaryWriter(
                         f"{self.paths['logs']}/tensorboard")
-                except ImportError:
+                except Exception as e:
+                    # Any constructor failure (missing package, unwritable
+                    # logs dir, broken install) must not kill training for
+                    # an optional observability feature.
                     warnings.warn(
-                        "use_tensorboard=True but tensorboardX is not "
-                        "installed; falling back to CSV/JSONL only",
-                        stacklevel=2)
+                        f"use_tensorboard=True but the SummaryWriter "
+                        f"could not be created ({type(e).__name__}: {e}); "
+                        f"falling back to CSV/JSONL only", stacklevel=2)
                     self._tb_disabled = True
             if self._tb is not None:
                 for key, value in row.items():
@@ -434,6 +481,7 @@ class ExperimentBuilder:
             per_model_acc["current"] = res["accuracy"]
         for epoch in top:
             state, _ = self.ckpt.load(self.state, epoch)
+            state = migrate_lslr_rows(cfg, state)
             state = jax.device_put(state, replicated_sharding(self.mesh))
             res = self._evaluate(self._eval_batches("test"), state,
                                  collect_logits=True)

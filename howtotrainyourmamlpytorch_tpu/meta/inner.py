@@ -34,6 +34,7 @@ from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.ops.losses import accuracy, cross_entropy
@@ -83,10 +84,12 @@ def merge_fast_slow(fast: Params, slow: Params) -> Params:
 def lslr_init(cfg: MAMLConfig, fast_params: Params) -> Params:
     """One per-step LR vector per fast leaf, initialized to
     ``task_learning_rate`` (reference § LSLRGradientDescentLearningRule.
-    initialise). Sized ``max(train_steps, eval_steps)`` so longer eval
-    adaptation indexes real rows (untrained rows keep their init). When
-    LSLR is not learnable these stay constant and the behavior is
-    plain-MAML ``GradientDescentLearningRule``."""
+    initialise, which allocates ``(K+1,)`` vectors). Sized
+    ``max(train_steps, eval_steps) + 1`` (``cfg.lslr_num_steps``) — the
+    reference's ``+1`` row plus coverage for longer eval adaptation;
+    rows beyond the training step count keep their init since no gradient
+    reaches them. When LSLR is not learnable these stay constant and the
+    behavior is plain-MAML ``GradientDescentLearningRule``."""
     k = cfg.lslr_num_steps
     return jax.tree.map(
         lambda leaf: jnp.full((k,), cfg.task_learning_rate, jnp.float32),
@@ -159,6 +162,28 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
     """
     fast0, slow = split_fast_slow(cfg, params)
 
+    # MSL execution strategy: with per-step BN the K target forwards are
+    # independent of each other AND off the serial support-adaptation chain
+    # (target forward s touches only BN row s, which no later support step
+    # reads), so they can be pulled OUT of the scan and batched into ONE
+    # vmapped forward over the stacked per-step fast weights — K small
+    # forwards become one K-wide batched op (better MXU tiling, and the
+    # rematted scan body gets cheaper). Exactly equivalent by construction:
+    # same logits, same per-row BN stat blending (pinned by
+    # tests/test_inner.py § test_msl_batched_target_path_equals_serial).
+    # Shared-row BN (per_step_bn_statistics=False, one row blended serially
+    # by every forward in order) keeps the reference's in-scan serial order.
+    # Sharded meshes also keep the serial path: the step-vmap composed with
+    # the task-vmap lowers convs to DOUBLY-grouped form
+    # (feature_group_count = tasks·steps), which the SPMD partitioner
+    # mis-partitions (kernel split by the full group count while the
+    # operand splits by tasks only — INVALID_ARGUMENT at compile; verified
+    # on CPU meshes, and the single-task-grouped form is the only one
+    # proven on real hardware).
+    batched_msl = (use_msl and cfg.per_step_bn_statistics
+                   and cfg.norm_layer == "batch_norm"
+                   and int(np.prod(cfg.mesh_shape)) == 1)
+
     def inner_step(carry, step):
         fast, bn = carry
 
@@ -175,6 +200,10 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
             grads = jax.lax.stop_gradient(grads)
         fast = _lslr_update(fast, grads, lslr, step)
 
+        if batched_msl:
+            # Post-update fast weights are stacked by the scan; the target
+            # forwards happen batched, outside.
+            return (fast, bn), (s_loss, fast)
         if use_msl:
             # Reference MSL: target forward *after* the update, at the same
             # per-step BN index as the step just taken.
@@ -191,19 +220,45 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
     if cfg.remat_inner_steps:
         inner_step = jax.checkpoint(inner_step, policy=_remat_policy(cfg))
 
-    (fast, bn), (s_losses, t_losses, t_logits_steps) = jax.lax.scan(
-        inner_step, (fast0, bn_state), jnp.arange(num_steps),
-        unroll=cfg.inner_unroll)
-
-    if use_msl:
+    if batched_msl:
         assert msl_weights is not None
+        (fast, bn), (s_losses, fast_steps) = jax.lax.scan(
+            inner_step, (fast0, bn_state), jnp.arange(num_steps),
+            unroll=cfg.inner_unroll)
+        steps = jnp.arange(num_steps)
+
+        def target_fwd(fast_s, step):
+            logits, bn_s = apply_fn(merge_fast_slow(fast_s, slow), bn,
+                                    episode.target_x, step, True)
+            return logits, cross_entropy(logits, episode.target_y), bn_s
+
+        t_logits_steps, t_losses, bn_steps = jax.vmap(target_fwd)(
+            fast_steps, steps)
+
+        def merge_rows(carry_leaf, vleaf):
+            # Instance s changed only row s of its state copy; fold those
+            # rows back into the carried state. (K <= num rows whenever
+            # per-step BN is on, so the rows are distinct.)
+            rows = jnp.clip(steps, 0, carry_leaf.shape[0] - 1)
+            return carry_leaf.at[rows].set(vleaf[steps, rows])
+
+        bn = jax.tree.map(merge_rows, bn, bn_steps)
         loss = jnp.sum(msl_weights[:num_steps] * t_losses)
         final_logits = t_logits_steps[-1]
     else:
-        final_logits, bn = apply_fn(merge_fast_slow(fast, slow), bn,
-                                    episode.target_x,
-                                    jnp.int32(num_steps - 1), True)
-        loss = cross_entropy(final_logits, episode.target_y)
+        (fast, bn), (s_losses, t_losses, t_logits_steps) = jax.lax.scan(
+            inner_step, (fast0, bn_state), jnp.arange(num_steps),
+            unroll=cfg.inner_unroll)
+
+        if use_msl:
+            assert msl_weights is not None
+            loss = jnp.sum(msl_weights[:num_steps] * t_losses)
+            final_logits = t_logits_steps[-1]
+        else:
+            final_logits, bn = apply_fn(merge_fast_slow(fast, slow), bn,
+                                        episode.target_x,
+                                        jnp.int32(num_steps - 1), True)
+            loss = cross_entropy(final_logits, episode.target_y)
 
     return TaskResult(
         loss=loss,

@@ -76,6 +76,48 @@ def init_train_state(cfg: MAMLConfig, model_init,
                           opt_state=opt_state, step=jnp.int32(0))
 
 
+def migrate_lslr_rows(cfg: MAMLConfig,
+                      state: MetaTrainState) -> MetaTrainState:
+    """Forward-compat shim for checkpoints written before the LSLR
+    vectors adopted the reference's ``(K+1,)`` sizing (they held
+    ``max(train, eval)`` rows). Pads each loaded vector with the untrained
+    init row (``task_learning_rate``) and its Adam moments with zeros —
+    numerically identical to what a fresh ``(K+1,)`` run would hold there,
+    since no gradient ever reaches the final row. A restartable job can
+    therefore resume straight across the format change."""
+    k = cfg.lslr_num_steps
+    leaves = jax.tree.leaves(state.lslr)
+    if not leaves or all(leaf.shape[0] == k for leaf in leaves):
+        return state
+    if any(leaf.shape[0] != k - 1 for leaf in leaves):
+        raise ValueError(
+            f"checkpoint LSLR rows {sorted({l.shape[0] for l in leaves})} "
+            f"match neither the current sizing ({k}) nor the pre-(K+1) "
+            f"sizing ({k - 1}); refusing to guess a migration")
+
+    def pad_with(value):
+        def pad(leaf):
+            fill = jnp.full((1,), value, leaf.dtype)
+            return jnp.concatenate([jnp.asarray(leaf), fill])
+        return pad
+
+    new_lslr = jax.tree.map(pad_with(cfg.task_learning_rate), state.lslr)
+
+    def fix_entry(entry):
+        mu = getattr(entry, "mu", None)
+        nu = getattr(entry, "nu", None)
+        if isinstance(mu, dict) and "lslr" in mu:
+            return entry._replace(
+                mu={**mu, "lslr": jax.tree.map(pad_with(0.0), mu["lslr"])},
+                nu={**nu, "lslr": jax.tree.map(pad_with(0.0), nu["lslr"])})
+        return entry
+
+    opt = state.opt_state
+    if isinstance(opt, tuple):
+        opt = tuple(fix_entry(e) for e in opt)
+    return state.replace(lslr=new_lslr, opt_state=opt)
+
+
 class StepMetrics(NamedTuple):
     loss: jax.Array
     accuracy: jax.Array

@@ -71,12 +71,24 @@ def conv2d_apply(params: Params, x: jax.Array, *, stride: int = 1,
     re-centers in f32.
     """
     w = params["w"].astype(compute_dtype)
-    y = jax.lax.conv_general_dilated(
-        x.astype(compute_dtype), w,
-        window_strides=(stride, stride),
-        padding=padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-    )
+    if w.shape[0] == w.shape[1] == 1 and stride == 1:
+        # A 1x1/stride-1 conv IS a per-pixel matmul; expressing it as a
+        # dot (a) feeds the MXU directly and (b) keeps it partitionable:
+        # under the task-vmap the fast kernels are per-task, and a
+        # vmapped 1x1 conv lowers to a feature-grouped conv that the
+        # SPMD partitioner mis-partitions (kernel split by the group
+        # count while the operand isn't -> INVALID_ARGUMENT at compile
+        # on any >1-chip mesh; resnet12's skip projections hit this).
+        # The vmapped dot lowers to a batched matmul, which partitions
+        # fine. Regression: tests/test_sharding.py (resnet12 mesh step).
+        y = jnp.dot(x.astype(compute_dtype), w[0, 0])
+    else:
+        y = jax.lax.conv_general_dilated(
+            x.astype(compute_dtype), w,
+            window_strides=(stride, stride),
+            padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
     # Tag for the 'conv_outs' remat policy (meta/inner.py § _remat_policy):
     # saving these lets the outer backward skip re-running convs.
     y = checkpoint_name(y, "conv_out")

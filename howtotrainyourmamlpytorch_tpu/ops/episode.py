@@ -19,14 +19,18 @@ from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 
 
 def normalize_episode(cfg: MAMLConfig, ep):
+    mean, inv_std, identity = cfg.image_norm_resolved
+    mean_arr = jnp.asarray(mean, jnp.float32)
+    inv_std_arr = jnp.asarray(inv_std, jnp.float32)
+
     def norm(x):
         if x.dtype != jnp.uint8:
             return x  # host-normalized f32 path
         xf = x.astype(jnp.float32) / 255.0
-        if cfg.image_channels > 1:
-            xf = 2.0 * xf - 1.0
-            if cfg.reverse_channels:
-                xf = xf[..., ::-1]
+        if cfg.reverse_channels:
+            xf = xf[..., ::-1]
+        if not identity:
+            xf = (xf - mean_arr) * inv_std_arr
         return xf
 
     # Episode is a NamedTuple; _replace keeps the pytree type without

@@ -91,6 +91,10 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
         raise ValueError(
             f"batch_size {cfg.batch_size} not divisible by mesh size "
             f"{mesh.size}")
+    if cfg.effective_eval_batch_size % mesh.size != 0:
+        raise ValueError(
+            f"eval batch size {cfg.effective_eval_batch_size} not "
+            f"divisible by mesh size {mesh.size}")
     repl = replicated_sharding(mesh)
     bsh = batch_sharding(mesh)
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import warnings
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -249,6 +250,26 @@ class CheckpointManager:
             return []
         return [n for n in names
                 if n.startswith("train_model_") and n.endswith(".ckpt")]
+
+    def fingerprint(self, tag=LATEST) -> int:
+        """Cheap content fingerprint of a checkpoint file (crc32 over size
+        + head/tail bytes), for cross-host resume agreement: the same tag
+        and iteration can still mean different weight bytes when a stale
+        filesystem cache serves an old ckpt file under a fresh state.json.
+        Not a full hash — a deliberate cost/coverage trade (multi-MB reads
+        per host per resume vs 128 bytes); size+boundary bytes catch
+        truncation and version skew, not a midfile bitflip. -1 = unreadable.
+        """
+        path = self._ckpt_path(tag)
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                head = f.read(64)
+                f.seek(max(size - 64, 0))
+                tail = f.read(64)
+        except OSError:
+            return -1
+        return zlib.crc32(size.to_bytes(8, "little") + head + tail)
 
     def has_any_checkpoint(self) -> bool:
         """Any checkpoint FILE at all — a disk scan, deliberately not the

@@ -9,14 +9,14 @@ def test_defaults_valid():
     cfg = MAMLConfig()
     assert cfg.num_support_per_task == 5
     assert cfg.bn_num_steps == 5  # max(train=5, eval=5)
-    assert cfg.lslr_num_steps == 5
+    assert cfg.lslr_num_steps == 6  # reference K+1 sizing
 
 
 def test_eval_longer_than_train_sizes_per_step_rows():
     cfg = MAMLConfig(number_of_training_steps_per_iter=3,
                      number_of_evaluation_steps_per_iter=7)
     assert cfg.bn_num_steps == 7
-    assert cfg.lslr_num_steps == 7
+    assert cfg.lslr_num_steps == 8
 
 
 def test_unknown_key_warns():

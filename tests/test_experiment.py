@@ -292,3 +292,42 @@ def test_tensorboard_scalars_written(tmp_path):
     assert os.path.isdir(tb_dir) and os.listdir(tb_dir)
     stats = load_statistics(builder.paths["logs"])  # CSV still written
     assert stats["epoch"] == ["0"]
+
+
+def test_state_json_only_remnant_aborts_loudly(tmp_path):
+    """Damage mode 4 (ADVICE r1): every .ckpt file removed but state.json
+    survives. Pre-fix this was treated as a fresh run while the manager
+    kept stale top-epoch bookkeeping (the final test protocol would later
+    die on nonexistent checkpoint files); it must abort loudly instead."""
+    import os
+
+    cfg = _cfg(tmp_path)
+    ExperimentBuilder(cfg).run_experiment()
+    models_dir = os.path.join(tmp_path, "smoke", "saved_models")
+    for name in os.listdir(models_dir):
+        if name.endswith(".ckpt"):
+            os.remove(os.path.join(models_dir, name))
+    assert os.path.isfile(os.path.join(models_dir, "state.json"))
+    with pytest.raises(RuntimeError, match="no readable checkpoint"):
+        ExperimentBuilder(_cfg(tmp_path, continue_from_epoch="latest"))
+
+
+def test_checkpoint_fingerprint_changes_with_content(tmp_path):
+    """Cheap content fingerprint used for cross-host resume agreement."""
+    import os
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        CheckpointManager)
+
+    cfg = _cfg(tmp_path)
+    builder = ExperimentBuilder(cfg)
+    builder.run_experiment()
+    mgr = CheckpointManager(os.path.join(tmp_path, "smoke", "saved_models"))
+    fp = mgr.fingerprint("latest")
+    assert fp >= 0
+    assert fp == mgr.fingerprint("latest")          # stable
+    path = os.path.join(tmp_path, "smoke", "saved_models",
+                        "train_model_latest.ckpt")
+    with open(path, "r+b") as f:
+        f.write(b"\xff\xff\xff\xff")                # different head bytes
+    assert mgr.fingerprint("latest") != fp
+    assert mgr.fingerprint("nonexistent") == -1

@@ -59,13 +59,13 @@ def test_lslr_init_shapes():
                      number_of_evaluation_steps_per_iter=3,
                      task_learning_rate=0.4)
     lslr = inner.lslr_init(cfg, {"conv0": {"w": jnp.zeros((2, 2))}})
-    assert lslr["conv0"]["w"].shape == (3,)
+    assert lslr["conv0"]["w"].shape == (4,)  # reference K+1 sizing
     np.testing.assert_allclose(float(lslr["conv0"]["w"][0]), 0.4, rtol=1e-6)
     # Longer eval adaptation gets real (untrained) rows.
     cfg2 = MAMLConfig(number_of_training_steps_per_iter=3,
                       number_of_evaluation_steps_per_iter=8)
     lslr2 = inner.lslr_init(cfg2, {"conv0": {"w": jnp.zeros((2, 2))}})
-    assert lslr2["conv0"]["w"].shape == (8,)
+    assert lslr2["conv0"]["w"].shape == (9,)
 
 
 # ---------------------------------------------------------------------------
@@ -208,3 +208,60 @@ def test_msl_loss_is_weighted_sum_of_per_step_losses():
                              msl_weights=w)
     expect = float(jnp.sum(w[:3] * res.per_step_target_losses))
     np.testing.assert_allclose(float(res.loss), expect, rtol=1e-6)
+
+
+def test_msl_batched_target_path_equals_serial():
+    """The batched-MSL execution strategy (target forwards pulled out of
+    the scan and vmapped over steps; active on unsharded meshes) must be
+    exactly equivalent to the serial in-scan path — same loss, same
+    per-step losses, same meta-gradients, same BN running stats. The
+    strategy is selected by cfg.mesh_shape, which does not enter the math."""
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+
+    base = MAMLConfig(
+        dataset_name="synthetic_eq", image_height=10, image_width=10,
+        image_channels=1, num_classes_per_set=3, num_samples_per_class=2,
+        num_target_samples=2, cnn_num_filters=4, num_stages=2,
+        number_of_training_steps_per_iter=3,
+        number_of_evaluation_steps_per_iter=3,
+        per_step_bn_statistics=True, second_order=True,
+        # f32 so the only difference between the two paths would be a real
+        # semantic one (bf16 would add grouped-vs-plain conv accumulation
+        # ordering noise at ~1e-3).
+        compute_dtype="float32")
+    rng = np.random.default_rng(0)
+    ep = Episode(
+        jnp.asarray(rng.normal(size=(6, 10, 10, 1)), jnp.float32),
+        jnp.asarray(np.repeat(np.arange(3), 2), jnp.int32),
+        jnp.asarray(rng.normal(size=(6, 10, 10, 1)), jnp.float32),
+        jnp.asarray(np.repeat(np.arange(3), 2), jnp.int32))
+
+    results = {}
+    for name, mesh_shape in (("batched", (1, 1)), ("serial", (2, 1))):
+        cfg = base.replace(mesh_shape=mesh_shape)
+        init, apply = make_model(cfg)
+        params, bn_state = init(jax.random.PRNGKey(0))
+        fast0, _ = inner.split_fast_slow(cfg, params)
+        lslr = inner.lslr_init(cfg, fast0)
+        w = inner.per_step_loss_importance(cfg, 2)
+
+        def loss_fn(p, cfg=cfg, apply=apply):
+            res = inner.task_forward(
+                cfg, apply, p, lslr, bn_state, ep, num_steps=3,
+                second_order=True, use_msl=True, msl_weights=w)
+            return res.loss, res
+        (loss, res), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        results[name] = (float(loss), res, grads)
+
+    lb, res_b, gb = results["batched"]
+    ls, res_s, gs = results["serial"]
+    np.testing.assert_allclose(lb, ls, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(res_b.per_step_target_losses),
+                               np.asarray(res_s.per_step_target_losses),
+                               rtol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        res_b.bn_state, res_s.bn_state)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6), gb, gs)

@@ -152,7 +152,7 @@ def test_eval_steps_exceed_train_steps():
     res = jax.jit(make_eval_step(cfg, apply))(
         state, _synthetic_batch(jax.random.PRNGKey(6), cfg, 4))
     assert np.isfinite(np.asarray(res.loss)).all()
-    assert state.lslr["conv0"]["w"].shape == (4,)
+    assert state.lslr["conv0"]["w"].shape == (5,)  # max(train,eval)+1
 
 
 def test_cosine_schedule_endpoints():
@@ -313,3 +313,44 @@ def test_eval_adaptation_gain_on_permuted_tasks():
     acc1, acc3 = eval_acc(1), eval_acc(3)
     assert acc3 > acc1, (acc1, acc3)      # more adaptation -> better
     assert acc3 > 0.99, acc3              # full adaptation solves the task
+
+
+def test_pre_k_plus_1_lslr_checkpoint_migrates():
+    """A checkpoint holding the pre-r2 (K,)-row LSLR format must resume:
+    migrate_lslr_rows pads the init row + zero Adam moments, and the
+    result trains (meta/outer.py § migrate_lslr_rows)."""
+    from flax import serialization
+    from howtotrainyourmamlpytorch_tpu.meta.outer import migrate_lslr_rows
+
+    init, apply = make_model(CFG)
+    state = init_train_state(CFG, init, jax.random.PRNGKey(0))
+    chop = lambda leaf: leaf[:-1]
+
+    def chop_entry(entry):
+        mu = getattr(entry, "mu", None)
+        if isinstance(mu, dict) and "lslr" in mu:
+            return entry._replace(
+                mu={**mu, "lslr": jax.tree.map(chop, mu["lslr"])},
+                nu={**entry.nu, "lslr": jax.tree.map(chop, entry.nu["lslr"])})
+        return entry
+
+    old_state = state.replace(
+        lslr=jax.tree.map(chop, state.lslr),
+        opt_state=tuple(chop_entry(e) for e in state.opt_state))
+    # Round-trip through the serialized wire format like a real resume.
+    restored = serialization.from_bytes(
+        state, serialization.to_bytes(jax.device_get(old_state)))
+    migrated = migrate_lslr_rows(CFG, restored)
+    for a, b in zip(jax.tree.leaves(migrated.lslr),
+                    jax.tree.leaves(state.lslr)):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # Shapes line up with the optimizer again: one step runs.
+    train_step = jax.jit(functools.partial(make_train_step(CFG, apply),
+                                           second_order=False,
+                                           use_msl=False))
+    new_state, m = train_step(migrated, _synthetic_batch(
+        jax.random.PRNGKey(1), CFG, 4), jnp.float32(0))
+    assert np.isfinite(float(m.loss))
+    # Current-format states pass through untouched.
+    assert migrate_lslr_rows(CFG, state) is state

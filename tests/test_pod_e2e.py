@@ -1,0 +1,262 @@
+"""Pod-scale end-to-end proof (VERDICT r1 next-round #1).
+
+Runs the shipped 256-task resnet12 pod config's EXACT topology — mesh
+(dcn=4, tasks=8) = 32 devices across 4 OS processes joined by
+``jax.distributed`` — through the FULL ``ExperimentBuilder`` loop, scaled
+down only in schedule and tensor sizes (backbone family, microbatching,
+second-order+MSL executable, per-step BN all as shipped):
+
+  phase A: fresh run, train epoch 0 → val sweep → checkpoint → pause
+  phase B: resume 'latest', PREEMPT mid-epoch-1 on process 0 only (the
+           stop must propagate through the multi-host OR-agreement so all
+           hosts break at the same iteration) → mid-epoch snapshot
+  phase C: resume 'latest' again (exercises the cross-host tag/iteration/
+           fingerprint agreement), finish training, run the top-k ensemble
+           test protocol
+
+and asserts: every process sees the same resume iterations; all phases'
+metrics are bit-identical across processes (SPMD really ran one program);
+and the final parameters + ensemble test accuracy match an UNINTERRUPTED
+single-process 32-device run of the same config (resume-exactness at pod
+mesh shape, across two interruptions).
+
+Skipped when the sandbox forbids binding a localhost socket. One shared
+XLA compilation cache keeps the 4 processes from compiling 4x.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The shipped pod config, scaled down in schedule/tensor sizes only.
+_POD_OVERRIDES = dict(
+    experiment_name="pod_e2e",
+    dataset_name="synthetic_tiered_imagenet",
+    image_height=16, image_width=16, image_channels=3,
+    cnn_num_filters=4,
+    number_of_training_steps_per_iter=2,
+    number_of_evaluation_steps_per_iter=2,
+    batch_size=64,              # 2 tasks/chip; microbatch chunks = 1/chip
+    total_epochs=2, total_iter_per_epoch=3,
+    num_evaluation_tasks=32,
+    dispatch_sync_every=1,      # agree on the preemption stop every iter
+    prefetch_batches=1,
+    live_progress=False,
+)
+
+_WORKER = r"""
+import json, os, sys
+REPO, CFG_PATH, OUT_DIR = sys.argv[1], sys.argv[2], sys.argv[3]
+sys.path.insert(0, REPO)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
+initialize_distributed()
+import numpy as np
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+with open(CFG_PATH) as f:
+    cfg = MAMLConfig.from_dict(json.load(f))
+# Shared persistent XLA cache: phase A compiles each program once; the
+# rebuilt builders of phases B/C (and the solo comparison run) hit the
+# cache instead of re-compiling the pod-mesh executables.
+jax.config.update("jax_compilation_cache_dir", cfg.compilation_cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+def digest(builder):
+    import jax
+    tot = 0.0
+    for leaf in jax.tree.leaves(jax.device_get(builder.state.params)):
+        tot += float(np.abs(np.asarray(leaf, np.float64)).sum())
+    return tot
+
+out = {"pid": jax.process_index(), "nproc": jax.process_count(),
+       "ndev": len(jax.devices())}
+
+# -- phase A: fresh run, one epoch, pause --------------------------------
+a = ExperimentBuilder(cfg.replace(total_epochs_before_pause=1))
+res_a = a.run_experiment()
+out["pauseA"] = res_a.get("paused_at_iter")
+
+# -- phase B: resume + preempt mid-epoch on process 0 only ---------------
+b = ExperimentBuilder(cfg.replace(continue_from_epoch="latest"))
+out["resumeB_iter"] = b.current_iter
+if jax.process_index() == 0:
+    orig = b.plan.train_steps
+    count = {"n": 0}
+    class Preempting(dict):
+        def __getitem__(self, key):
+            fn = orig[key]
+            def wrapped(*args, **kw):
+                count["n"] += 1
+                if count["n"] == 2:
+                    b._preempted = True
+                return fn(*args, **kw)
+            return wrapped
+    b.plan = b.plan._replace(train_steps=Preempting())
+res_b = b.run_experiment()
+out["preemptB"] = res_b.get("preempted_at_iter")
+
+# -- phase C: resume again, finish, ensemble test ------------------------
+c = ExperimentBuilder(cfg.replace(continue_from_epoch="latest"))
+out["resumeC_iter"] = c.current_iter
+res_c = c.run_experiment()
+out["digest"] = digest(c)
+out["test"] = {k: v for k, v in res_c.items() if k != "per_model_accuracy"}
+with open(os.path.join(OUT_DIR, f"result{jax.process_index()}.json"),
+          "w") as f:
+    json.dump(out, f)
+"""
+
+_SOLO = r"""
+import json, os, sys
+REPO, CFG_PATH, OUT_PATH = sys.argv[1], sys.argv[2], sys.argv[3]
+sys.path.insert(0, REPO)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+with open(CFG_PATH) as f:
+    cfg = MAMLConfig.from_dict(json.load(f))
+jax.config.update("jax_compilation_cache_dir", cfg.compilation_cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+b = ExperimentBuilder(cfg)
+res = b.run_experiment()
+tot = 0.0
+for leaf in jax.tree.leaves(jax.device_get(b.state.params)):
+    tot += float(np.abs(np.asarray(leaf, np.float64)).sum())
+with open(OUT_PATH, "w") as f:
+    json.dump({"ndev": len(jax.devices()), "digest": tot,
+               "test": {k: v for k, v in res.items()
+                        if k != "per_model_accuracy"}}, f)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _pod_cfg_dict(tmp_path, experiment_root):
+    with open(os.path.join(
+            REPO, "experiment_config",
+            "tiered-imagenet_maml++_5-way_5-shot_resnet12_pod.json")) as f:
+        cfg = json.load(f)
+    cfg.update(_POD_OVERRIDES)
+    cfg["experiment_root"] = str(experiment_root)
+    cfg["compilation_cache_dir"] = str(tmp_path / "xla_cache")
+    return cfg
+
+
+def test_pod_config_full_loop_at_virtual_scale(tmp_path):
+    try:
+        port = _free_port()
+    except OSError:
+        pytest.skip("cannot bind localhost sockets in this sandbox")
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(json.dumps(_pod_cfg_dict(tmp_path,
+                                                 tmp_path / "exp")))
+
+    nproc = 4
+    procs, logs = [], []
+    for pid in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": str(nproc),
+            "JAX_PROCESS_ID": str(pid),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        })
+        log = open(tmp_path / f"log{pid}.txt", "w+")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), REPO, str(cfg_path),
+             str(tmp_path)],
+            env=env, stdout=log, stderr=log, text=True))
+
+    results = {}
+    try:
+        for pid, p in enumerate(procs):
+            try:
+                # Generous: the phase-A compile of the (4,8)-sharded
+                # second-order resnet12 step is minutes on a small shared
+                # CPU; later phases hit the persistent cache.
+                p.wait(timeout=2700)
+            except subprocess.TimeoutExpired:
+                pytest.fail(f"pod worker {pid} timed out")
+            logs[pid].seek(0)
+            tail = logs[pid].read()[-4000:]
+            assert p.returncode == 0, f"pod worker {pid} failed:\n{tail}"
+            with open(tmp_path / f"result{pid}.json") as f:
+                results[pid] = json.load(f)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for log in logs:
+            log.close()
+
+    iters = _POD_OVERRIDES["total_iter_per_epoch"]
+    for pid, r in results.items():
+        assert r["nproc"] == nproc and r["ndev"] == 32, r
+        assert r["pauseA"] == iters                 # paused after epoch 0
+        assert r["resumeB_iter"] == iters           # resumed at its end
+        assert r["preemptB"] == iters + 2           # preempted mid-epoch 1
+        assert r["resumeC_iter"] == iters + 2       # exact mid-epoch resume
+        assert r["test"]["num_models"] == 2         # both epochs ensembled
+        assert (r["test"]["num_episodes"]
+                == _POD_OVERRIDES["num_evaluation_tasks"])
+        assert np.isfinite(r["test"]["test_accuracy_mean"])
+    # SPMD agreement: every process computed the same program.
+    for pid in range(1, nproc):
+        assert results[pid]["digest"] == results[0]["digest"]
+        assert results[pid]["test"] == results[0]["test"]
+
+    # Artifacts written once (process 0) with the reference filenames.
+    logs_dir = tmp_path / "exp" / "pod_e2e" / "logs"
+    stats = (logs_dir / "summary_statistics.csv").read_text().splitlines()
+    assert len(stats) == 1 + 2                      # header + 2 epochs
+    assert (logs_dir / "test_summary.csv").exists()
+
+    # Uninterrupted single-process 32-device run: the twice-interrupted
+    # pod run must land on the SAME final parameters and test accuracy
+    # (resume-exactness at pod mesh shape).
+    solo = tmp_path / "solo.py"
+    solo.write_text(_SOLO)
+    solo_cfg = tmp_path / "solo_cfg.json"
+    solo_cfg.write_text(json.dumps(_pod_cfg_dict(tmp_path,
+                                                 tmp_path / "solo_exp")))
+    env = dict(os.environ)
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=32"})
+    out_path = tmp_path / "solo.json"
+    r = subprocess.run(
+        [sys.executable, str(solo), REPO, str(solo_cfg), str(out_path)],
+        env=env, capture_output=True, text=True, timeout=2700)
+    assert r.returncode == 0, r.stderr[-4000:]
+    with open(out_path) as f:
+        solo_res = json.load(f)
+    assert solo_res["ndev"] == 32
+    np.testing.assert_allclose(results[0]["digest"], solo_res["digest"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        results[0]["test"]["test_accuracy_mean"],
+        solo_res["test"]["test_accuracy_mean"], atol=1e-6)
