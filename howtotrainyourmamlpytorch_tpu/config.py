@@ -144,6 +144,17 @@ class MAMLConfig:
     inner_unroll: int = 1                  # lax.scan unroll factor (K-divisor
                                            # or 1; higher = more fusion across
                                            # inner steps, longer compiles)
+    msl_target_batching: str = "auto"      # MSL-window target forwards:
+                                           # 'auto'/'off' = serial in-scan
+                                           # (measured faster on v5e, and
+                                           # the only SPMD-partitionable
+                                           # form — docs/PERF.md); 'on' =
+                                           # batched out of the scan where
+                                           # exactly equivalent (per-step
+                                           # batch_norm only; single-chip
+                                           # meshes only). Numerics
+                                           # identical either way
+                                           # (tests/test_inner.py).
     prefetch_batches: int = 2              # host->device prefetch depth
     transfer_images_uint8: bool = True     # ship raw uint8 pixels, normalize
                                            # on device (same math to ~1 ulp,
@@ -210,6 +221,10 @@ class MAMLConfig:
             raise ValueError("need at least one inner step")
         if self.eval_batch_size < 0:
             raise ValueError("eval_batch_size must be >= 0 (0 = auto)")
+        if self.msl_target_batching not in ("auto", "on", "off"):
+            raise ValueError(
+                f"msl_target_batching must be 'auto'|'on'|'off', got "
+                f"{self.msl_target_batching!r}")
         if (len(self.train_val_test_split) != 3
                 or any(f < 0 for f in self.train_val_test_split)):
             raise ValueError(
@@ -330,18 +345,22 @@ class MAMLConfig:
         """Meta-batch used for val/test sweeps.
 
         Evaluation has no outer-gradient memory pressure (no second-order
-        graph, no optimizer update), so a much larger meta-batch fits and
-        cuts per-epoch validation wall-clock. Auto (``eval_batch_size=0``):
-        8x the train batch, capped at the evaluation episode count padded
-        up to a multiple of the mesh size. Episode composition and results
-        are batch-size-invariant (tasks are vmapped independently), so
-        this changes wall-clock only, never accuracy.
+        graph, no optimizer update), so a larger meta-batch cuts per-epoch
+        validation wall-clock. Auto (``eval_batch_size=0``): 2x the train
+        batch, capped at the evaluation episode count padded up to a
+        multiple of the mesh size. 2x is the measured optimum on v5e
+        (scripts/perf_eval.py, flagship 600-episode sweep: 2x -> 1.41x
+        faster; 4x/8x are SLOWER again — eval still differentiates the
+        inner loop, and past ~2x the support-activation working set
+        thrashes HBM; 10x/chip OOMs outright). Episode composition and
+        results are batch-size-invariant (tasks are vmapped
+        independently), so this changes wall-clock only, never accuracy.
         """
         if self.eval_batch_size > 0:
             return self.eval_batch_size
         mesh_n = max(int(math.prod(self.mesh_shape)), 1)
         cap = -(-self.num_evaluation_tasks // mesh_n) * mesh_n
-        return max(min(8 * self.batch_size, cap), self.batch_size)
+        return max(min(2 * self.batch_size, cap), self.batch_size)
 
     def use_second_order(self, epoch: int) -> bool:
         """Derivative-order annealing (reference:

@@ -85,6 +85,13 @@ class ExperimentBuilder:
         self.mesh = make_mesh(cfg, devices)
         self.plan = make_sharded_steps(cfg, self.model_apply, self.mesh)
         self.data = MetaLearningDataLoader(cfg, mesh=self.mesh)
+        # Order ANY previous process-0 checkpoint/state writes (epoch
+        # saves, the preemption snapshot) before THIS builder's state.json
+        # read: without it a non-main process constructing a resuming
+        # builder can read bookkeeping mid-write/pre-write and then fail
+        # the cross-host resume-iteration agreement (observed in the pod
+        # e2e test's preempt->resume phase).
+        barrier("builder_init")
         self.ckpt = CheckpointManager(self.paths["saved_models"],
                                       max_to_keep=cfg.max_models_to_save)
 

@@ -180,9 +180,25 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
     # operand splits by tasks only — INVALID_ARGUMENT at compile; verified
     # on CPU meshes, and the single-task-grouped form is the only one
     # proven on real hardware).
-    batched_msl = (use_msl and cfg.per_step_bn_statistics
-                   and cfg.norm_layer == "batch_norm"
-                   and int(np.prod(cfg.mesh_shape)) == 1)
+    if cfg.msl_target_batching == "on":
+        # Equivalence PRECONDITIONS still apply under 'on': with
+        # shared-row BN (per_step_bn_statistics=False) the target forward
+        # at step s feeds step s+1's running-stat blend serially, and
+        # layer_norm has no per-step rows at all — batching would change
+        # the stored statistics. 'on' only forces the batched form where
+        # it is exactly equivalent.
+        batched_msl = (use_msl and cfg.per_step_bn_statistics
+                       and cfg.norm_layer == "batch_norm")
+    else:
+        # 'auto' (and 'off') resolve to the serial in-scan path: measured
+        # on v5e (scripts/perf_msl.py, flagship geometry) the batched
+        # form is 1.5-3% SLOWER — the K-wide grouped convs tile the MXU
+        # worse than the serial target forwards they replace — and it
+        # cannot be SPMD-partitioned (the step-vmap grouped-conv form
+        # breaks the partitioner on >1-chip meshes). Kept behind 'on'
+        # for re-evaluation on future hardware; numerics are identical
+        # either way (tests/test_inner.py).
+        batched_msl = False
 
     def inner_step(carry, step):
         fast, bn = carry

@@ -21,9 +21,14 @@ import zipfile
 from typing import Callable, Dict, Optional
 
 # dataset_name -> URL of the packaged zip. The reference ships Google-Drive
-# file IDs for omniglot and mini_imagenet; recorded here as the documented
-# provenance for a user-provided fetcher (the IDs themselves could not be
-# read from the empty reference mount — SURVEY.md § Provenance).
+# file IDs for omniglot and mini_imagenet; the placeholders below are
+# DELIBERATE: the IDs could not be read from the empty reference mount
+# (SURVEY.md § Provenance, MOUNT-AUDIT.md #9) and this build environment
+# has zero network egress to verify a remembered one — shipping an
+# unverifiable ID would silently download the wrong bytes. Fill these from
+# the reference's utils/dataset_tools.py when the mount is populated; any
+# caller with connectivity passes ``fetcher=`` and can override the URL
+# table first.
 DATASET_URLS: Dict[str, str] = {
     "omniglot_dataset": "https://drive.google.com/open?id=<omniglot>",
     "mini_imagenet_full_size": "https://drive.google.com/open?id=<mini-imagenet>",

@@ -1,0 +1,86 @@
+"""Validation-sweep timing: decoupled (large) eval meta-batch vs the old
+train-batch-sized sweeps. VERDICT r1 next-round #5.
+
+Times a full 600-episode evaluation sweep (the per-epoch validation and
+the per-model test protocol cost) on the flagship workload at several
+eval batch sizes, including the auto default (8x train batch).
+
+Usage: python scripts/perf_eval.py [--episodes N]
+Prints one JSON line per batch size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import synthetic_batch
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    make_mesh, make_sharded_steps, replicated_sharding, shard_batch)
+
+
+def sweep_time(cfg: MAMLConfig, eval_batch: int, episodes: int,
+               repeats: int = 3) -> float:
+    cfg = cfg.replace(eval_batch_size=eval_batch)
+    init, apply = make_model(cfg)
+    mesh = make_mesh(cfg, jax.devices()[:1])
+    plan = make_sharded_steps(cfg, apply, mesh)
+    state = jax.device_put(
+        init_train_state(cfg, init, jax.random.PRNGKey(0)),
+        replicated_sharding(mesh))
+    num_batches = -(-episodes // eval_batch)
+    # Device-resident fixed episodes (cache_eval_episodes default), so the
+    # measured cost is the eval computation itself — as in training.
+    batches = [shard_batch(synthetic_batch(
+        cfg.replace(batch_size=eval_batch), s), mesh)
+        for s in range(num_batches)]
+    # Warmup/compile.
+    res = plan.eval_step(state, batches[0])
+    float(jax.device_get(res.loss).mean())
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = []
+        for b in batches:
+            out.append(plan.eval_step(state, b))
+        tot = float(np.concatenate(
+            [np.asarray(jax.device_get(r.accuracy)) for r in out]).mean())
+        times.append(time.perf_counter() - t0)
+        assert np.isfinite(tot)
+    return float(np.median(times))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=600)
+    args = ap.parse_args()
+
+    cfg = MAMLConfig.from_json_file(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "experiment_config", "mini-imagenet_maml++_5-way_5-shot_DA_b12.json"))
+    base = None
+    for eb in (12, 24, 48, 96, 120, 200):
+        t = sweep_time(cfg, eb, args.episodes)
+        if base is None:
+            base = t
+        print(json.dumps({
+            "eval_batch": eb,
+            "sweep_seconds": round(t, 3),
+            "speedup_vs_train_batch": round(base / t, 3)}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

