@@ -331,3 +331,20 @@ def test_checkpoint_fingerprint_changes_with_content(tmp_path):
         f.write(b"\xff\xff\xff\xff")                # different head bytes
     assert mgr.fingerprint("latest") != fp
     assert mgr.fingerprint("nonexistent") == -1
+
+
+def test_cli_tuple_fields_accept_multi_token_and_comma_forms():
+    """Tuple-typed config fields work in all three spellings:
+    '--mesh_shape 2 4', '--mesh_shape 2,4', '--mesh_shape [2,4]'."""
+    for argv in (["--mesh_shape", "2", "4"],
+                 ["--mesh_shape", "2,4"],
+                 ["--mesh_shape", "[2,4]"],
+                 ["--mesh_shape=[2, 4]"]):
+        cfg = train_maml_system.get_args(argv + ["--batch_size", "8"])
+        assert cfg.mesh_shape == (2, 4), argv
+        assert cfg.batch_size == 8
+    cfg = train_maml_system.get_args(
+        ["--train_val_test_split", "0.6", "0.2", "0.2",
+         "--indexes_of_folders_indicating_class", "-3", "-2"])
+    assert cfg.train_val_test_split == (0.6, 0.2, 0.2)
+    assert cfg.indexes_of_folders_indicating_class == (-3, -2)

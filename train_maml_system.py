@@ -40,6 +40,14 @@ def _coerce(parser, field, key: str, raw: str):
     try:
         return json.loads(raw)
     except json.JSONDecodeError:
+        if "Tuple" in str(field.type) or "tuple" in str(field.type):
+            # Tuple fields accept bare comma/space-separated values
+            # ('--mesh_shape 2,4' or '--mesh_shape 2 4') in addition to
+            # JSON ('--mesh_shape [2,4]').
+            try:
+                return json.loads(f"[{raw}]")
+            except json.JSONDecodeError:
+                pass
         if "str" in str(field.type):
             return raw  # bare string (e.g. --experiment_name foo)
         parser.error(f"--{key}: could not parse {raw!r} as "
@@ -71,8 +79,16 @@ def get_args(argv=None) -> MAMLConfig:
         else:
             if i + 1 >= len(overrides):
                 parser.error(f"--{key} needs a value")
-            raw = overrides[i + 1]
-            i += 2
+            # Greedily take the run of non-flag tokens so tuple fields
+            # work naturally: '--mesh_shape 2 4' == '--mesh_shape 2,4'.
+            # Negative numbers ('-1') don't start with '--' and are
+            # consumed as values.
+            j = i + 1
+            while j < len(overrides) and not overrides[j].startswith("--"):
+                j += 1
+            tokens = overrides[i + 1:j]
+            raw = tokens[0] if len(tokens) == 1 else ",".join(tokens)
+            i = j
         if key not in fields:
             parser.error(f"unknown config field --{key}")
         values[key] = _coerce(parser, fields[key], key, raw)
