@@ -198,11 +198,22 @@ def batch_norm_apply(params: Params, state: State, x: jax.Array,
 # batch_norm — provided for config parity)
 # ---------------------------------------------------------------------------
 
-def layer_norm_init(num_features: int,
+def layer_norm_init(normalized_shape,
                     dtype: jnp.dtype = jnp.float32) -> Tuple[Params, State]:
+    """Elementwise affine over the full normalized feature shape
+    (reference: ``MetaLayerNormLayer`` wraps the layer-norm semantics of
+    ``nn.LayerNorm(normalized_shape=(C, H, W))`` — one γ/β PER ELEMENT,
+    not per channel). ``normalized_shape`` is ``(H, W, C)`` in this
+    framework's NHWC layout; an int is accepted as a per-channel ``(C,)``
+    affine for backbone-agnostic callers. The leading axis of γ/β is a
+    step axis of size 1 (layer norm has no per-step variant)."""
+    if isinstance(normalized_shape, int):
+        shape = (normalized_shape,)
+    else:
+        shape = tuple(normalized_shape)
     params = {
-        "gamma": jnp.ones((1, num_features), dtype),
-        "beta": jnp.zeros((1, num_features), dtype),
+        "gamma": jnp.ones((1,) + shape, dtype),
+        "beta": jnp.zeros((1,) + shape, dtype),
     }
     return params, {}
 
@@ -210,13 +221,10 @@ def layer_norm_init(num_features: int,
 def layer_norm_apply(params: Params, state: State, x: jax.Array,
                      step: jax.Array, *, training: bool,
                      eps: float = 1e-5) -> Tuple[jax.Array, State]:
-    """Per-sample normalization over all non-batch dims, per-channel affine.
-
-    Deviation from the reference noted: MetaLayerNormLayer's affine is over
-    the full (C,H,W) feature shape; ours is per-channel, which keeps the
-    parameter pytree shape-stable across stages. MAML++ shipped configs use
-    batch_norm, so this only affects the optional layer_norm mode.
-    """
+    """Per-sample normalization over all non-batch dims, elementwise
+    affine (γ/β broadcast over the trailing feature dims — full
+    ``(H, W, C)`` shape when initialized by the VGG backbone, matching
+    the reference's elementwise LayerNorm affine)."""
     xf = x.astype(jnp.float32)
     axes = tuple(range(1, x.ndim))
     mean = jnp.mean(xf, axis=axes, keepdims=True)

@@ -78,15 +78,28 @@ def make_vgg(cfg: MAMLConfig) -> Tuple[InitFn, ApplyFn]:
         state: State = {}
         keys = jax.random.split(key, cfg.num_stages + 1)
         in_ch = c
+        stride = 1 if cfg.max_pooling else 2
+        padding = "SAME" if cfg.conv_padding else "VALID"
+        # Running post-conv feature shape, tracked abstractly so the
+        # layer-norm affine can cover the full (H, W, C) feature shape
+        # (reference MetaLayerNormLayer: elementwise affine) without
+        # duplicating the conv/pool geometry arithmetic here.
+        cur = jax.ShapeDtypeStruct((1, h, w, c), jnp.float32)
         for i in range(cfg.num_stages):
             params[f"conv{i}"] = layers.conv2d_init(
                 keys[i], in_ch, cfg.cnn_num_filters)
+            conv_out = jax.eval_shape(
+                lambda x, p=params[f"conv{i}"]: layers.conv2d_apply(
+                    p, x, stride=stride, padding=padding,
+                    compute_dtype=jnp.float32), cur)
             if cfg.norm_layer == "batch_norm":
                 params[f"norm{i}"], state[f"norm{i}"] = (
                     layers.batch_norm_init(cfg.cnn_num_filters, num_steps))
             else:
                 params[f"norm{i}"], state[f"norm{i}"] = (
-                    layers.layer_norm_init(cfg.cnn_num_filters))
+                    layers.layer_norm_init(conv_out.shape[1:]))
+            cur = (jax.eval_shape(layers.max_pool2d, conv_out)
+                   if cfg.max_pooling else conv_out)
             in_ch = cfg.cnn_num_filters
 
         # Infer flatten dim (reference does a dummy forward in __init__).

@@ -178,3 +178,51 @@ def test_batch_norm_fast_math_grads_close():
     g_fast = jax.grad(lambda x: loss(x, True))(x)
     np.testing.assert_allclose(np.asarray(g_fast), np.asarray(g_ref),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_layer_norm_full_shape_affine_matches_torch():
+    """The LN affine covers the full (H, W, C) feature shape (reference
+    MetaLayerNormLayer: elementwise nn.LayerNorm((C, H, W)) affine) and
+    matches torch's layer_norm with elementwise weights."""
+    import torch
+    import torch.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    params, state = layers.layer_norm_init((5, 6, 4))
+    assert params["gamma"].shape == (1, 5, 6, 4)
+    params = {
+        "gamma": jnp.asarray(rng.normal(1.0, 0.2, (1, 5, 6, 4)),
+                             jnp.float32),
+        "beta": jnp.asarray(rng.normal(0.0, 0.2, (1, 5, 6, 4)),
+                            jnp.float32),
+    }
+    x = jnp.asarray(rng.normal(size=(3, 5, 6, 4)), jnp.float32)
+    y, _ = layers.layer_norm_apply(params, state, x, jnp.int32(0),
+                                   training=True)
+    xt = torch.tensor(np.asarray(x).transpose(0, 3, 1, 2))
+    w = torch.tensor(np.asarray(params["gamma"][0]).transpose(2, 0, 1))
+    b = torch.tensor(np.asarray(params["beta"][0]).transpose(2, 0, 1))
+    want = F.layer_norm(xt, (4, 5, 6), weight=w, bias=b, eps=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(y).transpose(0, 3, 1, 2), want.numpy(),
+        rtol=1e-5, atol=1e-5)
+
+
+def test_vgg_layer_norm_params_cover_stage_shapes():
+    """Each VGG stage's LN affine matches that stage's post-conv feature
+    shape (28x28 grayscale, SAME convs, 2x2 pools: 28, 14, 7, 3)."""
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+
+    cfg = MAMLConfig(norm_layer="layer_norm", image_height=28,
+                     image_width=28, image_channels=1, cnn_num_filters=6,
+                     num_stages=4, compute_dtype="float32")
+    init, apply = make_model(cfg)
+    params, state = init(jax.random.PRNGKey(0))
+    got = [params[f"norm{i}"]["gamma"].shape for i in range(4)]
+    assert got == [(1, 28, 28, 6), (1, 14, 14, 6), (1, 7, 7, 6),
+                   (1, 3, 3, 6)]
+    # And the backbone still runs end to end.
+    x = jnp.zeros((10, 28, 28, 1), jnp.float32)
+    logits, _ = apply(params, state, x, jnp.int32(0), True)
+    assert logits.shape == (10, cfg.num_classes_per_set)

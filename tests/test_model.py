@@ -67,4 +67,6 @@ def test_layer_norm_backbone():
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 28, 28, 1))
     logits, new_state = apply(params, state, x, jnp.int32(0), True)
     assert logits.shape == (2, 5)
-    assert params["norm0"]["gamma"].shape == (1, 16)
+    # Full elementwise affine over the stage's post-conv feature shape
+    # (reference MetaLayerNormLayer semantics).
+    assert params["norm0"]["gamma"].shape == (1, 28, 28, 16)
