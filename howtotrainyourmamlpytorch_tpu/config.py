@@ -92,6 +92,13 @@ class MAMLConfig:
     # mean=std=0.5 (i.e. x -> 2x-1). See MOUNT-AUDIT.md.
     image_norm_mean: Optional[Tuple[float, ...]] = None
     image_norm_std: Optional[Tuple[float, ...]] = None
+    # Fetch a missing packaged dataset over the network (reference
+    # behavior: download-then-extract via the Google-Drive links in
+    # utils/dataset_tools.py § DATASET_URLS). Off by default: the IDs are
+    # reconstructed offline and unverified (MOUNT-AUDIT #9), and a missing
+    # dataset then falls back to the synthetic source with a warning
+    # instead of attempting a download.
+    download_datasets: bool = False
 
     # ---- backbone ------------------------------------------------------
     num_stages: int = 4
@@ -170,10 +177,29 @@ class MAMLConfig:
                                            # re-transfer is pure waste)
     eval_batch_size: int = 0               # meta-batch for val/test sweeps
                                            # (no outer-grad memory pressure,
-                                           # so much larger than the train
-                                           # batch fits; 0 = auto: 8x train
-                                           # batch, capped at the padded
-                                           # evaluation episode count)
+                                           # so larger than the train batch
+                                           # fits; 0 = auto: 2x train batch —
+                                           # the measured sweep optimum, see
+                                           # effective_eval_batch_size and
+                                           # docs/PERF.md — capped at the
+                                           # padded evaluation episode count)
+    precompile_phases: bool = False        # compile the phase executables
+                                           # the schedule visits LATER
+                                           # (MSL→steady at epoch 15, DA
+                                           # first→second order) ahead of
+                                           # their epoch boundary — in a
+                                           # background thread overlapped
+                                           # with the early epochs (single
+                                           # process) or synchronously at
+                                           # startup (multi-host, where a
+                                           # racing warmup step would
+                                           # misorder collectives) — so the
+                                           # executable swap is stall-free.
+                                           # Transient device cost while
+                                           # warming: ~one extra state copy
+                                           # + one concurrent step's
+                                           # activations — leave off for
+                                           # runs tuned to the edge of HBM
     live_progress: bool = True             # in-epoch running loss/acc line
                                            # at each dispatch sync (the
                                            # reference's tqdm equivalent);
@@ -225,6 +251,13 @@ class MAMLConfig:
             raise ValueError(
                 f"msl_target_batching must be 'auto'|'on'|'off', got "
                 f"{self.msl_target_batching!r}")
+        if self.msl_target_batching == "on" and math.prod(self.mesh_shape) > 1:
+            raise ValueError(
+                "msl_target_batching='on' is single-chip only: the "
+                "step-vmapped target forwards lower to doubly-grouped convs "
+                "that the SPMD partitioner mis-partitions on >1-chip meshes "
+                "(INVALID_ARGUMENT at compile — see meta/inner.py); use "
+                "'auto', which picks the serial partitionable form")
         if (len(self.train_val_test_split) != 3
                 or any(f < 0 for f in self.train_val_test_split)):
             raise ValueError(

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import signal
 import sys
+import threading
 import time
 import warnings
 from typing import Any, Dict, Iterable, List, Optional, Tuple
@@ -32,7 +33,8 @@ import numpy as np
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
 from howtotrainyourmamlpytorch_tpu.meta.outer import (
-    MetaTrainState, init_train_state, migrate_lslr_rows)
+    MetaTrainState, init_train_state, migrate_lslr_rows,
+    reconcile_loaded_shapes, state_leaf_shapes)
 from howtotrainyourmamlpytorch_tpu.models import make_model
 from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
     make_mesh, make_sharded_steps, replicated_sharding)
@@ -152,6 +154,10 @@ class ExperimentBuilder:
                     # (reference default for restartable jobs)
         err: Optional[BaseException] = None
         meta: Dict[str, Any] = {}
+        # Fresh-init leaf shapes, captured before load overwrites them —
+        # from_bytes restores without shape validation, so the loaded
+        # leaves must be reconciled against these after.
+        template_shapes = state_leaf_shapes(self.state)
         try:
             if from_latest:
                 # Falls back to the newest readable epoch checkpoint if
@@ -211,8 +217,12 @@ class ExperimentBuilder:
             # Rewind: epochs after the resume point are abandoned; their
             # checkpoints must not feed the top-k ensemble.
             self.ckpt.rewind_to(int(tag), write=self.is_main_process)
-        # Pre-(K+1) LSLR checkpoint format: pad in place of failing.
+        # Pre-(K+1) LSLR checkpoint format: pad in place of failing; then
+        # migrate-or-refuse any other leaf-shape drift (e.g. the pre-full-
+        # affine per-channel layer-norm γ/β).
         self.state = migrate_lslr_rows(self.cfg, self.state)
+        self.state = reconcile_loaded_shapes(self.cfg, self.state,
+                                             template_shapes)
         print(f"resumed from checkpoint {tag!r} at iter "
               f"{self.current_iter}")
 
@@ -220,6 +230,64 @@ class ExperimentBuilder:
     @property
     def epoch(self) -> int:
         return self.current_iter // self.cfg.total_iter_per_epoch
+
+    def _phase_order(self) -> List[Tuple[bool, bool]]:
+        """The (second_order, use_msl) phase keys the remaining schedule
+        visits, in first-visit order."""
+        cfg, seen, order = self.cfg, set(), []
+        for e in range(self.epoch, cfg.total_epochs):
+            key = (cfg.use_second_order(e), cfg.use_msl(e))
+            if key not in seen:
+                seen.add(key)
+                order.append(key)
+        return order
+
+    def _start_phase_warmup(self) -> None:
+        """Pre-compile the phase executables the schedule visits later, so
+        the MSL→steady and DA first→second-order epoch-boundary executable
+        swaps (`MeshPlan.train_steps` keys) hit jit's cache instead of
+        stalling the boundary epoch behind an XLA compile.
+
+        Runs each not-yet-needed phase once on a throwaway state copy and
+        a real-shaped batch (same avals + shardings as the loop's, so the
+        later real call is a cache hit). Single-process: a daemon thread
+        overlapped with the early epochs — the one wasted step serializes
+        harmlessly on the device. Multi-host: synchronous, because a
+        warmup step racing the training steps would dispatch collectives
+        in different orders on different processes.
+        """
+        later = self._phase_order()[1:]
+        if not later:
+            return
+        batch = next(iter(self.data.get_train_batches(self.current_iter, 1)),
+                     None)
+        if batch is None:
+            return
+        snapshot = jax.tree.map(jnp.copy, self.state)
+
+        def warm() -> None:
+            for i, key in enumerate(later):
+                t0 = time.time()
+                # The warmup step donates its input; the LAST phase donates
+                # the snapshot itself so at most one extra state copy is
+                # live at a time (the transient device cost of the flag is
+                # ~one state copy + one concurrent step's activations).
+                donated = (snapshot if i == len(later) - 1
+                           else jax.tree.map(jnp.copy, snapshot))
+                out, _ = self.plan.train_steps[key](donated, batch,
+                                                    jnp.float32(self.epoch))
+                jax.block_until_ready(out.params)
+                del out
+                if self.is_main_process:
+                    print(f"[warmup] phase (second_order={key[0]}, "
+                          f"msl={key[1]}) compiled in "
+                          f"{time.time() - t0:.1f}s", flush=True)
+
+        if self._multihost:
+            warm()
+        else:
+            threading.Thread(target=warm, daemon=True,
+                             name="phase-warmup").start()
 
     def _train_epoch(self) -> Optional[Dict[str, float]]:
         """Train to the next epoch boundary (a resumed run mid-epoch does
@@ -388,6 +456,8 @@ class ExperimentBuilder:
 
         total_iters = cfg.total_epochs * cfg.total_iter_per_epoch
         epochs_this_session = 0
+        if cfg.precompile_phases and self.current_iter < total_iters:
+            self._start_phase_warmup()
         # Save-on-signal: SIGTERM (cluster preemption notice) checkpoints
         # 'latest' at the current iteration and exits the loop cleanly;
         # resume with continue_from_epoch='latest' loses zero iterations.
@@ -486,9 +556,11 @@ class ExperimentBuilder:
                                  collect_logits=True)
             per_model_logits.append(res["logits"])
             per_model_acc["current"] = res["accuracy"]
+        template_shapes = state_leaf_shapes(self.state)
         for epoch in top:
             state, _ = self.ckpt.load(self.state, epoch)
             state = migrate_lslr_rows(cfg, state)
+            state = reconcile_loaded_shapes(cfg, state, template_shapes)
             state = jax.device_put(state, replicated_sharding(self.mesh))
             res = self._evaluate(self._eval_batches("test"), state,
                                  collect_logits=True)

@@ -118,6 +118,59 @@ def migrate_lslr_rows(cfg: MAMLConfig,
     return state.replace(lslr=new_lslr, opt_state=opt)
 
 
+def state_leaf_shapes(state: MetaTrainState) -> Tuple[Tuple[int, ...], ...]:
+    """Leaf shapes of a (template) train state, in tree-leaf order — capture
+    BEFORE ``CheckpointManager.load`` overwrites the template, feed to
+    :func:`reconcile_loaded_shapes` after."""
+    return tuple(jnp.shape(leaf) for leaf in jax.tree.leaves(state))
+
+
+def reconcile_loaded_shapes(cfg: MAMLConfig, state: MetaTrainState,
+                            template_shapes) -> MetaTrainState:
+    """Validate a just-loaded checkpoint's leaf shapes against the fresh
+    template's, migrating the one known historical format change.
+
+    ``flax.serialization.from_bytes`` restores dict leaves WITHOUT shape
+    validation, so an old checkpoint whose leaves still broadcast (e.g. the
+    pre-full-affine per-channel ``(1, C)`` layer-norm γ/β, before they grew
+    to the reference's elementwise ``(1, H, W, C)``) would otherwise resume
+    silently with parameter shapes that differ from a fresh run's.
+
+    Known migration: per-channel layer-norm γ/β (and their Adam moments)
+    are broadcast over ``(H, W)`` — numerically identical to the forward
+    pass the old parameterization computed, each element inheriting its
+    channel's moment. Any OTHER shape mismatch refuses loudly. Run AFTER
+    :func:`migrate_lslr_rows` (which legitimately changes LSLR row counts).
+    """
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(state)
+    if len(paths_leaves) != len(template_shapes):
+        raise ValueError(
+            f"checkpoint has {len(paths_leaves)} leaves but the template "
+            f"state has {len(template_shapes)}; refusing to resume")
+
+    def fix(path, leaf, want):
+        have = jnp.shape(leaf)
+        if tuple(have) == tuple(want):
+            return leaf
+        name = jax.tree_util.keystr(path)
+        is_ln_affine = (cfg.norm_layer == "layer_norm"
+                        and (name.endswith("['gamma']")
+                             or name.endswith("['beta']")))
+        if (is_ln_affine and len(have) == 2 and len(want) == 4
+                and have[0] == want[0] == 1 and have[1] == want[-1]):
+            return jnp.broadcast_to(
+                jnp.asarray(leaf)[:, None, None, :], tuple(want))
+        raise ValueError(
+            f"checkpoint leaf {name} has shape {tuple(have)} but the "
+            f"current model expects {tuple(want)} — an incompatible "
+            f"checkpoint format; refusing to resume with silently "
+            f"mismatched parameters")
+
+    fixed = [fix(path, leaf, want)
+             for (path, leaf), want in zip(paths_leaves, template_shapes)]
+    return jax.tree_util.tree_unflatten(treedef, fixed)
+
+
 class StepMetrics(NamedTuple):
     loss: jax.Array
     accuracy: jax.Array

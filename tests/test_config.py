@@ -104,3 +104,15 @@ def test_msl_phase():
 def test_invalid_norm_layer_rejected():
     with pytest.raises(ValueError):
         MAMLConfig(norm_layer="group_norm")
+
+
+def test_msl_on_rejects_multichip_mesh():
+    """'on' forces the step-vmapped grouped-conv form, which the SPMD
+    partitioner mis-partitions on >1-chip meshes (ADVICE r2 medium) —
+    the config must reject the combination instead of failing at
+    compile time with INVALID_ARGUMENT."""
+    with pytest.raises(ValueError, match="single-chip"):
+        MAMLConfig(msl_target_batching="on", mesh_shape=(2, 4))
+    # single-chip 'on' and multi-chip 'auto' both stay legal
+    MAMLConfig(msl_target_batching="on", mesh_shape=(1, 1))
+    MAMLConfig(msl_target_batching="auto", mesh_shape=(2, 4))

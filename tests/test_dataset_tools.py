@@ -194,3 +194,148 @@ def test_omniglot_layout_zip_to_train_step(tmp_path):
                                      second_order=False, use_msl=False))
     _, metrics = step(state, batch, jnp.float32(0))
     assert np.isfinite(float(metrics.loss))
+
+
+# ---------------------------------------------------------------------------
+# download path (VERDICT r2 #5): fetch -> extract -> source -> train step
+# ---------------------------------------------------------------------------
+
+def test_fetch_to_train_step_end_to_end(tmp_path):
+    """The reference's download-then-extract provisioning driven all the
+    way into a train step: a local fetcher stands in for the network,
+    serving a fixture zip in the packaged layout; maybe_unzip_dataset
+    fetches + extracts it, DiskImageSource indexes it, and one real
+    sharded train step runs on its episodes."""
+    import jax.numpy as jnp
+
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader)
+    from howtotrainyourmamlpytorch_tpu.data.sources import DiskImageSource
+    from howtotrainyourmamlpytorch_tpu.meta import (init_train_state,
+                                                    make_train_step)
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+
+    rng = np.random.default_rng(7)
+    cfg = MAMLConfig(
+        dataset_name="omniglot_dataset",
+        dataset_path=str(tmp_path / "omniglot_dataset"),
+        image_height=14, image_width=14, image_channels=1,
+        num_classes_per_set=3, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2, cnn_num_filters=4,
+        num_stages=2, number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        indexes_of_folders_indicating_class=(-2,),
+        compute_dtype="float32")
+
+    def fetcher(url, dest):
+        assert url == DATASET_URLS["omniglot_dataset"]
+        with zipfile.ZipFile(dest, "w") as zf:
+            for split, n_cls in (("train", 6), ("val", 3), ("test", 3)):
+                for c in range(n_cls):
+                    for i in range(3):
+                        img = Image.fromarray(
+                            rng.integers(0, 255, (14, 14), np.uint8), "L")
+                        buf = io.BytesIO()
+                        img.save(buf, "PNG")
+                        zf.writestr(
+                            f"omniglot_dataset/{split}/class_{c:02d}/"
+                            f"{i}.png", buf.getvalue())
+
+    assert maybe_unzip_dataset(cfg, fetcher=fetcher, require=True) is True
+    assert dataset_dir_is_ready(cfg.dataset_path)
+
+    loader = MetaLearningDataLoader(cfg)
+    assert isinstance(loader.sampler("train").source, DiskImageSource)
+    batch = next(iter(loader.get_train_batches(0, 1)))
+    init, apply_fn = make_model(cfg)
+    import jax
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    step = jax.jit(make_train_step(cfg, apply_fn), static_argnames=(
+        "second_order", "use_msl"))
+    state2, metrics = step(state, batch, jnp.float32(0),
+                           second_order=False, use_msl=False)
+    assert np.isfinite(float(metrics.loss))
+
+
+def test_wrong_download_trips_class_count_check(tmp_path):
+    """A fetched archive whose class counts don't match the packaged
+    dataset's documented shape must fail loudly (the unverified-Drive-id
+    tripwire), not train on wrong data."""
+    cfg = MAMLConfig(dataset_name="mini_imagenet_full_size",
+                     dataset_path=str(tmp_path / "mini_imagenet_full_size"))
+
+    def fetcher(url, dest):
+        _make_zip(dest, prefix="mini_imagenet_full_size/")  # 1 class/split
+
+    with pytest.raises(ValueError, match="class directories"):
+        maybe_unzip_dataset(cfg, fetcher=fetcher, require=True)
+    # The rejected extraction and the fetched zip must both be gone — a
+    # restarted job must re-fail, not pass the ready-directory check on
+    # the very data just rejected.
+    assert not os.path.exists(cfg.dataset_path)
+    assert not any(p.endswith(".zip") for p in os.listdir(tmp_path))
+
+    # A user's OWN zip with the same shape is their business: no fetcher
+    # involved -> no tripwire, provisioning succeeds.
+    _make_zip(tmp_path / "mini_imagenet_full_size.zip",
+              prefix="mini_imagenet_full_size/")
+    assert maybe_unzip_dataset(cfg) is True
+
+
+def test_gdrive_fetcher_confirm_flow(tmp_path, monkeypatch):
+    """gdrive_fetcher's large-file flow against a stubbed opener: first
+    response is the virus-scan HTML interstitial, the replayed confirm
+    request streams the bytes; partial downloads never land at dest."""
+    import urllib.request
+
+    from howtotrainyourmamlpytorch_tpu.utils import dataset_tools
+
+    payload = b"PK\x03\x04 fake zip bytes"
+    html = (b'<html><form action="https://drive.usercontent.google.com/'
+            b'download"><input type="hidden" name="confirm" value="t0k3n">'
+            b'<input type="hidden" name="uuid" value="u-u-i-d">'
+            b'</form></html>')
+    calls = []
+
+    class Resp(io.BytesIO):
+        def __init__(self, body, ctype):
+            super().__init__(body)
+            self.headers = {"Content-Type": ctype}
+
+    class Opener:
+        def open(self, url, timeout=None):
+            calls.append(url)
+            assert timeout is not None  # stalled sockets must not hang
+            if len(calls) == 1:
+                return Resp(html, "text/html; charset=utf-8")
+            return Resp(payload, "application/zip")
+
+    monkeypatch.setattr(urllib.request, "build_opener",
+                        lambda *a, **k: Opener())
+    dest = str(tmp_path / "data.zip")
+    dataset_tools.gdrive_fetcher(
+        "https://drive.google.com/uc?export=download&id=FILE-ID_123", dest)
+    assert open(dest, "rb").read() == payload
+    assert not os.path.exists(dest + ".part")
+    assert "id=FILE-ID_123" in calls[0]
+    assert calls[1].startswith("https://drive.usercontent.google.com/")
+    assert "confirm=t0k3n" in calls[1] and "uuid=u-u-i-d" in calls[1]
+
+
+def test_gdrive_fetcher_direct_stream(tmp_path, monkeypatch):
+    """Small files skip the interstitial: one request, bytes written."""
+    import urllib.request
+
+    from howtotrainyourmamlpytorch_tpu.utils import dataset_tools
+
+    class Resp(io.BytesIO):
+        headers = {"Content-Type": "application/octet-stream"}
+
+    monkeypatch.setattr(
+        urllib.request, "build_opener",
+        lambda *a, **k: type("O", (), {
+            "open": lambda self, url, timeout=None: Resp(b"bytes")})())
+    dest = str(tmp_path / "d.zip")
+    dataset_tools.gdrive_fetcher(
+        "https://drive.google.com/file/d/abc123/view", dest)
+    assert open(dest, "rb").read() == b"bytes"

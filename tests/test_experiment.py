@@ -348,3 +348,45 @@ def test_cli_tuple_fields_accept_multi_token_and_comma_forms():
          "--indexes_of_folders_indicating_class", "-3", "-2"])
     assert cfg.train_val_test_split == (0.6, 0.2, 0.2)
     assert cfg.indexes_of_folders_indicating_class == (-3, -2)
+
+
+def test_cli_flag_followed_by_flag_errors():
+    """'--mesh_shape --quick' must error 'needs a value', not silently
+    coerce to an empty tuple (ADVICE r2 low)."""
+    with pytest.raises(SystemExit):
+        train_maml_system.get_args(["--mesh_shape", "--batch_size", "4"])
+
+
+def test_cli_multi_token_value_only_for_tuple_fields():
+    """Multi-token values are the tuple-field convenience form; for scalar
+    and string fields they are a user error, not a silent comma-join."""
+    cfg = train_maml_system.get_args(["--mesh_shape", "2", "4"])
+    assert cfg.mesh_shape == (2, 4)
+    with pytest.raises(SystemExit):
+        train_maml_system.get_args(["--experiment_name", "two", "words"])
+    with pytest.raises(SystemExit):
+        train_maml_system.get_args(["--batch_size", "4", "8"])
+
+
+def test_precompile_phases_is_bit_identical(tmp_path):
+    """The background phase warmup must not change training: it runs on
+    throwaway state copies, so a warmed run's parameters match an
+    unwarmed run bit-for-bit (and the warmup covers the DA boundary the
+    schedule crosses)."""
+    import jax
+    cfg_a = _cfg(tmp_path / "a", first_order_to_second_order_epoch=0,
+                 second_order=True)
+    builder_a = ExperimentBuilder(cfg_a)
+    builder_a.run_experiment()
+
+    cfg_b = _cfg(tmp_path / "b", first_order_to_second_order_epoch=0,
+                 second_order=True, precompile_phases=True)
+    builder_b = ExperimentBuilder(cfg_b)
+    # Three phase keys visited: (False, True) epoch 0, (True, False)
+    # epoch 1 — warmup list holds everything after the first.
+    assert len(builder_b._phase_order()) == 2
+    builder_b.run_experiment()
+
+    for a, b in zip(jax.tree.leaves(builder_a.state.params),
+                    jax.tree.leaves(builder_b.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

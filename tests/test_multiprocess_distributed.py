@@ -33,6 +33,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# Multi-process full-loop proof: ~minutes on this 1-core box.
+# Excluded from the quick profile (`pytest -m 'not slow'`).
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Tiny-but-real workload: 3-way 2-shot, K=2, second-order + MSL.

@@ -43,6 +43,10 @@ import sys
 import numpy as np
 import pytest
 
+# Multi-process full-loop proof: ~minutes on this 1-core box.
+# Excluded from the quick profile (`pytest -m 'not slow'`).
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _MESH = tuple(int(x) for x in

@@ -102,3 +102,78 @@ def test_missing_checkpoint_raises(tmp_path):
     mgr = CheckpointManager(str(tmp_path))
     with pytest.raises(FileNotFoundError):
         mgr.load(_state(), 99)
+
+
+# ---------------------------------------------------------------------------
+# loaded-shape reconciliation (ADVICE r2: pre-full-affine layer-norm ckpts)
+# ---------------------------------------------------------------------------
+
+LN_CFG = MAMLConfig(image_height=8, image_width=8, image_channels=1,
+                    num_classes_per_set=2, cnn_num_filters=4, num_stages=1,
+                    number_of_training_steps_per_iter=2,
+                    number_of_evaluation_steps_per_iter=2,
+                    norm_layer="layer_norm", per_step_bn_statistics=False,
+                    compute_dtype="float32")
+
+
+def _ln_state():
+    init, _ = make_model(LN_CFG)
+    return init_train_state(LN_CFG, init, jax.random.PRNGKey(0))
+
+
+def _shrink_ln_affine(state):
+    """Rewrite every 4D layer-norm γ/β leaf (and its Adam moments) to the
+    pre-change per-channel (1, C) shape, as an old checkpoint held."""
+    def shrink(path, leaf):
+        name = jax.tree_util.keystr(path)
+        if (name.endswith("['gamma']") or name.endswith("['beta']")) \
+                and jnp.ndim(leaf) == 4:
+            return leaf[:, 0, 0, :]
+        return leaf
+    return jax.tree_util.tree_map_with_path(shrink, state)
+
+
+def test_old_layer_norm_checkpoint_migrates(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.meta.outer import (
+        reconcile_loaded_shapes, state_leaf_shapes)
+    fresh = _ln_state()
+    template_shapes = state_leaf_shapes(fresh)
+    old = _shrink_ln_affine(fresh)
+    assert any(jnp.shape(a) != jnp.shape(b) for a, b in
+               zip(jax.tree.leaves(old), jax.tree.leaves(fresh)))
+
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(old, epoch=0, current_iter=5, val_acc=0.4)
+    # from_bytes restores the old per-channel leaves without validation...
+    loaded, _ = mgr.load(_ln_state(), 0)
+    assert any(jnp.ndim(l) == 2 for l in jax.tree.leaves(loaded.params))
+    # ...and reconciliation broadcasts them back to the full affine.
+    migrated = reconcile_loaded_shapes(LN_CFG, loaded, template_shapes)
+    for leaf, want in zip(jax.tree.leaves(migrated), template_shapes):
+        assert jnp.shape(leaf) == tuple(want)
+    # Broadcast semantics: every (h, w) position holds the channel value.
+    def check(path, leaf):
+        name = jax.tree_util.keystr(path)
+        if (name.endswith("['gamma']") or name.endswith("['beta']")) \
+                and jnp.ndim(leaf) == 4:
+            np.testing.assert_array_equal(
+                np.asarray(leaf),
+                np.broadcast_to(np.asarray(leaf)[:, :1, :1, :],
+                                leaf.shape))
+    jax.tree_util.tree_map_with_path(check, migrated.params)
+
+
+def test_unknown_shape_mismatch_refuses(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.meta.outer import (
+        reconcile_loaded_shapes, state_leaf_shapes)
+    fresh = _ln_state()
+    template_shapes = state_leaf_shapes(fresh)
+
+    def corrupt(path, leaf):
+        name = jax.tree_util.keystr(path)
+        if name.endswith("['w']") and jnp.ndim(leaf) == 4:
+            return leaf[:-1]  # chop a conv kernel: no legal migration
+        return leaf
+    bad = jax.tree_util.tree_map_with_path(corrupt, fresh)
+    with pytest.raises(ValueError, match="refusing to resume"):
+        reconcile_loaded_shapes(LN_CFG, bad, template_shapes)

@@ -73,12 +73,12 @@ def get_args(argv=None) -> MAMLConfig:
         if not tok.startswith("--"):
             parser.error(f"unexpected argument {tok!r}")
         key, eq, inline = tok[2:].partition("=")
+        if key not in fields:
+            parser.error(f"unknown config field --{key}")
         if eq:
             raw = inline
             i += 1
         else:
-            if i + 1 >= len(overrides):
-                parser.error(f"--{key} needs a value")
             # Greedily take the run of non-flag tokens so tuple fields
             # work naturally: '--mesh_shape 2 4' == '--mesh_shape 2,4'.
             # Negative numbers ('-1') don't start with '--' and are
@@ -87,10 +87,16 @@ def get_args(argv=None) -> MAMLConfig:
             while j < len(overrides) and not overrides[j].startswith("--"):
                 j += 1
             tokens = overrides[i + 1:j]
+            if not tokens:
+                parser.error(f"--{key} needs a value")
+            is_tuple = ("Tuple" in str(fields[key].type)
+                        or "tuple" in str(fields[key].type))
+            if len(tokens) > 1 and not is_tuple:
+                parser.error(
+                    f"--{key} takes one value, got {len(tokens)}: "
+                    f"{' '.join(tokens)!r}")
             raw = tokens[0] if len(tokens) == 1 else ",".join(tokens)
             i = j
-        if key not in fields:
-            parser.error(f"unknown config field --{key}")
         values[key] = _coerce(parser, fields[key], key, raw)
 
     return MAMLConfig.from_dict(values)
@@ -126,7 +132,16 @@ def main(argv=None) -> int:
     from howtotrainyourmamlpytorch_tpu.parallel import barrier
     try:
         if jax.process_index() == 0:
-            maybe_unzip_dataset(cfg)  # reference behavior; synthetic fallback
+            if cfg.download_datasets:
+                # Reference behavior: download-then-extract; a failed or
+                # wrong download raises instead of silently training on
+                # the synthetic fallback.
+                from howtotrainyourmamlpytorch_tpu.utils.dataset_tools \
+                    import gdrive_fetcher
+                maybe_unzip_dataset(cfg, fetcher=gdrive_fetcher,
+                                    require=True)
+            else:
+                maybe_unzip_dataset(cfg)  # synthetic fallback if absent
     finally:
         barrier("dataset_ready")
     builder = ExperimentBuilder(cfg)
