@@ -153,13 +153,14 @@ class MAMLConfig:
                                            # inner steps, longer compiles)
     msl_target_batching: str = "auto"      # MSL-window target forwards:
                                            # 'auto'/'off' = serial in-scan
-                                           # (measured faster on v5e, and
-                                           # the only SPMD-partitionable
-                                           # form — docs/PERF.md); 'on' =
-                                           # batched out of the scan where
-                                           # exactly equivalent (per-step
-                                           # batch_norm only; single-chip
-                                           # meshes only). Numerics
+                                           # (measured faster on v5e —
+                                           # docs/PERF.md); 'on' = batched
+                                           # out of the scan where exactly
+                                           # equivalent (per-step
+                                           # batch_norm only); any mesh —
+                                           # the shard_map formulation
+                                           # keeps the grouped convs
+                                           # device-local. Numerics
                                            # identical either way
                                            # (tests/test_inner.py).
     prefetch_batches: int = 2              # host->device prefetch depth
@@ -251,13 +252,11 @@ class MAMLConfig:
             raise ValueError(
                 f"msl_target_batching must be 'auto'|'on'|'off', got "
                 f"{self.msl_target_batching!r}")
-        if self.msl_target_batching == "on" and math.prod(self.mesh_shape) > 1:
-            raise ValueError(
-                "msl_target_batching='on' is single-chip only: the "
-                "step-vmapped target forwards lower to doubly-grouped convs "
-                "that the SPMD partitioner mis-partitions on >1-chip meshes "
-                "(INVALID_ARGUMENT at compile — see meta/inner.py); use "
-                "'auto', which picks the serial partitionable form")
+        # (An r2-era restriction — 'on' rejected on >1-chip meshes because
+        # the step-vmapped grouped convs broke the SPMD partitioner — was
+        # lifted in r3: sharded steps are shard_map-ped, so the partitioner
+        # never sees the per-task compute and 'on' compiles on any mesh;
+        # verified by tests/test_config.py § test_msl_on_any_mesh.)
         if (len(self.train_val_test_split) != 3
                 or any(f < 0 for f in self.train_val_test_split)):
             raise ValueError(

@@ -173,13 +173,11 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
     # tests/test_inner.py § test_msl_batched_target_path_equals_serial).
     # Shared-row BN (per_step_bn_statistics=False, one row blended serially
     # by every forward in order) keeps the reference's in-scan serial order.
-    # Sharded meshes also keep the serial path: the step-vmap composed with
-    # the task-vmap lowers convs to DOUBLY-grouped form
-    # (feature_group_count = tasks·steps), which the SPMD partitioner
-    # mis-partitions (kernel split by the full group count while the
-    # operand splits by tasks only — INVALID_ARGUMENT at compile; verified
-    # on CPU meshes, and the single-task-grouped form is the only one
-    # proven on real hardware).
+    # (Historical: under the r1/r2 GSPMD formulation the step-vmap composed
+    # with the task-vmap lowered to doubly-grouped convs the SPMD
+    # partitioner mis-partitioned, so 'on' was single-chip only. Since r3
+    # the sharded steps run inside shard_map — per-task compute is
+    # device-local and either MSL form compiles on any mesh.)
     if cfg.msl_target_batching == "on":
         # Equivalence PRECONDITIONS still apply under 'on': with
         # shared-row BN (per_step_bn_statistics=False) the target forward
@@ -193,11 +191,9 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
         # 'auto' (and 'off') resolve to the serial in-scan path: measured
         # on v5e (scripts/perf_msl.py, flagship geometry) the batched
         # form is 1.5-3% SLOWER — the K-wide grouped convs tile the MXU
-        # worse than the serial target forwards they replace — and it
-        # cannot be SPMD-partitioned (the step-vmap grouped-conv form
-        # breaks the partitioner on >1-chip meshes). Kept behind 'on'
-        # for re-evaluation on future hardware; numerics are identical
-        # either way (tests/test_inner.py).
+        # worse than the serial target forwards they replace. Kept behind
+        # 'on' for re-evaluation on future hardware; numerics are
+        # identical either way (tests/test_inner.py).
         batched_msl = False
 
     def inner_step(carry, step):

@@ -17,7 +17,7 @@ Reference behavior reproduced (``few_shot_learning_system.py``):
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, NamedTuple, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -178,13 +178,25 @@ class StepMetrics(NamedTuple):
     learning_rate: jax.Array
 
 
-def make_train_step(cfg: MAMLConfig, apply_fn) -> Callable[..., Any]:
+def make_train_step(cfg: MAMLConfig, apply_fn, *,
+                    reduce_axes: Optional[Tuple[str, ...]] = None
+                    ) -> Callable[..., Any]:
     """Build ``train_step(state, batch, epoch, *, second_order, use_msl)``.
 
     ``second_order`` / ``use_msl`` must be passed as static at the jit site:
     the derivative-order-annealing and MSL-phase epoch boundaries swap
     between (at most four) compiled executables; ``epoch`` itself is traced
     so ordinary epochs never recompile.
+
+    ``reduce_axes`` is set when the step runs inside ``shard_map`` over a
+    device mesh (parallel/mesh.py): the batch then holds only this device's
+    task shard, and the named-axis ``pmean`` inserted after gradient
+    accumulation is the ONE cross-device collective of the outer step —
+    per-task adaptation compiles device-local by construction, which is the
+    whole point of the shard_map formulation (GSPMD's partitioner
+    mis-handles the task-vmapped grouped convs and falls back to
+    all-gathering episodes and adapted weights inside the inner scan;
+    verified by tests/test_hlo_collectives.py).
     """
     optimizer = make_optimizer(cfg)
     schedule = meta_lr_schedule(cfg)
@@ -254,6 +266,13 @@ def make_train_step(cfg: MAMLConfig, apply_fn) -> Callable[..., Any]:
             ((loss, (acc, s_loss, new_bn)), grads) = jax.tree.map(
                 lambda a: a / num_micro, acc_out)
 
+        if reduce_axes:
+            # Local task-shard means -> global means: one fused pmean of
+            # (grads, loss, aux). Every device then performs a bitwise-
+            # identical optimizer update, keeping the state replicated.
+            (grads, loss, acc, s_loss, new_bn) = jax.lax.pmean(
+                (grads, loss, acc, s_loss, new_bn), axis_name=reduce_axes)
+
         if not learnable_lslr:
             grads["lslr"] = jax.tree.map(jnp.zeros_like, grads["lslr"])
         # BNWB off: γ/β stay at their 1/0 init (the functional equivalent of
@@ -292,9 +311,17 @@ class EvalResult(NamedTuple):
     target_logits: jax.Array   # (B, N*T, N) for the ensemble test protocol
 
 
-def make_eval_step(cfg: MAMLConfig, apply_fn) -> Callable[..., EvalResult]:
+def make_eval_step(cfg: MAMLConfig, apply_fn, *,
+                   gather_axes: Optional[Tuple[str, ...]] = None
+                   ) -> Callable[..., EvalResult]:
     """Validation/test: adapt with the evaluation step count, final-step
-    loss only, first-order (no outer grads exist), norm state discarded."""
+    loss only, first-order (no outer grads exist), norm state discarded.
+
+    ``gather_axes`` is set under ``shard_map``: per-task results are
+    computed on the device owning the task, then one tiled ``all_gather``
+    of the tiny per-task scalars + logits replicates the full result on
+    every device (multi-host needs every process able to ``device_get``
+    the whole sweep; single-host it is the same bytes GSPMD moved)."""
     num_steps = cfg.number_of_evaluation_steps_per_iter
 
     def eval_step(state: MetaTrainState, batch: Episode) -> EvalResult:
@@ -306,7 +333,11 @@ def make_eval_step(cfg: MAMLConfig, apply_fn) -> Callable[..., EvalResult]:
                 num_steps=num_steps, second_order=False, use_msl=False,
                 msl_weights=None)
         res = jax.vmap(one_task)(batch)
-        return EvalResult(loss=res.loss, accuracy=res.target_accuracy,
-                          target_logits=res.target_logits)
+        out = EvalResult(loss=res.loss, accuracy=res.target_accuracy,
+                         target_logits=res.target_logits)
+        if gather_axes:
+            out = jax.lax.all_gather(out, axis_name=gather_axes, axis=0,
+                                     tiled=True)
+        return out
 
     return eval_step

@@ -11,11 +11,16 @@ and actually parallel:
   * The meta-batch of episodes is sharded over both axes' product; model
     parameters, LSLR LRs, BN state and optimizer state are replicated.
   * Inner-loop adaptation is entirely local to a chip (tasks are
-    embarrassingly parallel — zero communication for K inner steps).
-  * The only collective per outer step is the mean over tasks inside the
-    loss/aux (XLA lowers it to one ``psum`` riding ICI, then DCN), exactly
-    the all-reduce a DDP-style design would issue — but derived by the SPMD
-    partitioner from sharding annotations rather than hand-written.
+    embarrassingly parallel — zero communication for K inner steps),
+    GUARANTEED by construction: steps are ``shard_map``-ped over the mesh,
+    so the per-task compute is compiled per-device and the SPMD
+    partitioner never gets a vote (r3: GSPMD sharding annotations were
+    measured mis-partitioning the task-vmapped grouped convs into per-
+    inner-step episode/kernel all-gathers — see make_sharded_steps).
+  * The only collective per outer step is one hand-written fused ``pmean``
+    of grads+metrics (riding ICI, then DCN) — exactly the all-reduce a
+    DDP-style design would issue — plus one tiny result ``all_gather`` per
+    eval step. tests/test_hlo_collectives.py audits the compiled HLO.
 
 TP/PP/EP/sequence-parallel axes are deliberately absent: the reference's
 workload (4-conv CNN on 28-84px episodic batches, no sequence dimension) has
@@ -84,9 +89,24 @@ class MeshPlan(NamedTuple):
 
 def make_sharded_steps(cfg: MAMLConfig, apply_fn,
                        mesh: Mesh) -> MeshPlan:
-    """jit the train/eval steps with explicit shardings: state replicated,
-    episode batch task-sharded, outputs replicated. The task-mean in the
-    loss becomes the per-step psum over (tasks, dcn)."""
+    """Build the sharded train/eval executables as ``jit(shard_map(step))``
+    over the (dcn, tasks) mesh: state replicated, episode batch
+    task-sharded, outputs replicated.
+
+    shard_map — not GSPMD sharding annotations — is the load-bearing
+    choice: per-task adaptation must compile DEVICE-LOCAL. Under plain
+    ``jit`` + ``in_shardings``, the SPMD partitioner mis-handles the
+    task-vmapped grouped convolutions (per-task fast weights make every
+    conv a grouped conv with feature_group_count == tasks) and falls back
+    to all-gathering full episode activations and adapted kernels inside
+    the inner ``lax.scan`` — O(K) collectives of activation size per step
+    instead of zero. With shard_map the partitioner never sees the
+    per-task compute; the collective inventory is exactly what
+    meta/outer.py writes by hand: one fused grad/metric ``pmean`` per
+    train step, one tiny tiled ``all_gather`` per eval step.
+    tests/test_hlo_collectives.py walks the optimized HLO and fails on
+    anything else.
+    """
     if cfg.batch_size % mesh.size != 0:
         raise ValueError(
             f"batch_size {cfg.batch_size} not divisible by mesh size "
@@ -95,22 +115,46 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
         raise ValueError(
             f"eval batch size {cfg.effective_eval_batch_size} not "
             f"divisible by mesh size {mesh.size}")
+    local_batch = cfg.batch_size // mesh.size
+    if local_batch % cfg.task_microbatches != 0:
+        raise ValueError(
+            f"task_microbatches {cfg.task_microbatches} must divide the "
+            f"PER-DEVICE task count {local_batch} (= batch_size "
+            f"{cfg.batch_size} / mesh size {mesh.size}); the accumulation "
+            f"scan runs on each device's local shard")
     repl = replicated_sharding(mesh)
     bsh = batch_sharding(mesh)
+    axes = tuple(mesh.axis_names)
+    batch_spec = P(axes)   # leading (task) axis split over both mesh axes
 
-    train_step = make_train_step(cfg, apply_fn)
+    train_step = make_train_step(cfg, apply_fn, reduce_axes=axes)
     train_steps = {}
     for so in (False, True):
         for msl in (False, True):
-            train_steps[(so, msl)] = jax.jit(
+            smapped = jax.shard_map(
                 functools.partial(train_step, second_order=so, use_msl=msl),
+                mesh=mesh,
+                in_specs=(P(), batch_spec, P()),
+                out_specs=(P(), P()),
+                # The pmean makes outputs device-invariant; the static
+                # checker cannot prove it through optax's update tree.
+                check_vma=False,
+            )
+            train_steps[(so, msl)] = jax.jit(
+                smapped,
                 in_shardings=(repl, bsh, None),
                 out_shardings=(repl, repl),
                 donate_argnums=(0,),
             )
 
     eval_step = jax.jit(
-        make_eval_step(cfg, apply_fn),
+        jax.shard_map(
+            make_eval_step(cfg, apply_fn, gather_axes=axes),
+            mesh=mesh,
+            in_specs=(P(), batch_spec),
+            out_specs=P(),
+            check_vma=False,
+        ),
         in_shardings=(repl, bsh),
         # Replicated outputs: the trailing all-gather (tiny per-task
         # scalars + logits) makes every host able to device_get the full

@@ -123,7 +123,11 @@ def test_sharded_microbatch_accumulation():
     to (M, B/M) chunks re-annotates sharding without host round-trips and
     the step still produces finite, matching results."""
     devices = jax.devices()[:8]
-    cfg = CFG.replace(mesh_shape=(2, 4), task_microbatches=2)
+    cfg = CFG.replace(mesh_shape=(2, 4), task_microbatches=2,
+                  batch_size=16)  # 2 tasks/device -> local chunks of 1
+                                  # (microbatching is per-device under
+                                  # shard_map; it must divide the local
+                                  # shard, not the global batch)
     init, apply = make_model(cfg)
     mesh = make_mesh(cfg, devices)
     plan = make_sharded_steps(cfg, apply, mesh)
@@ -143,7 +147,7 @@ def test_sharded_microbatch_accumulation():
 
     # Single-shot on the same mesh gives the same loss and gradients
     # (first-moment check, linear in grads).
-    cfg1 = CFG.replace(mesh_shape=(2, 4))
+    cfg1 = CFG.replace(mesh_shape=(2, 4), batch_size=16)
     _, apply1 = make_model(cfg1)
     plan1 = make_sharded_steps(cfg1, apply1, mesh)
     s1, m1 = plan1.train_steps[(True, True)](
@@ -165,6 +169,7 @@ def test_resnet12_trains_on_sharded_mesh():
     convs now lower as per-pixel matmuls (layers.conv2d_apply)."""
     cfg = CFG.replace(backbone="resnet12", cnn_num_filters=4,
                       image_channels=3, task_microbatches=2,
+                      batch_size=16,  # keeps local chunks >= 1 task
                       image_height=16, image_width=16)  # 4 pool stages
     _, losses = _run_steps(cfg, (2, 4), jax.devices())
     assert np.isfinite(losses).all()
@@ -184,3 +189,25 @@ def test_conv1x1_dot_matches_conv_lowering():
         dimension_numbers=("NHWC", "HWIO", "NHWC")) + params["b"]
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_msl_batched_on_multichip_mesh_matches_serial():
+    """'on' (out-of-scan batched MSL target forwards) on a >1-chip mesh:
+    legal under the shard_map formulation (the r2 GSPMD form could not
+    compile this), and numerically identical to the serial path."""
+    cfg_on = CFG.replace(mesh_shape=(2, 4), msl_target_batching="on",
+                         second_order=True,
+                         use_multi_step_loss_optimization=True)
+    cfg_ser = cfg_on.replace(msl_target_batching="off")
+    losses = {}
+    for name, cfg in (("on", cfg_on), ("off", cfg_ser)):
+        init, apply = make_model(cfg)
+        mesh = make_mesh(cfg, jax.devices()[:8])
+        plan = make_sharded_steps(cfg, apply, mesh)
+        state = jax.device_put(
+            init_train_state(cfg, init, jax.random.PRNGKey(0)),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()))
+        batch = shard_batch(_batch(jax.random.PRNGKey(5), cfg), mesh)
+        _, m = plan.train_steps[(True, True)](state, batch, jnp.float32(0))
+        losses[name] = float(m.loss)
+    np.testing.assert_allclose(losses["on"], losses["off"], rtol=1e-6)
