@@ -144,6 +144,41 @@ def synthetic_batch(cfg: MAMLConfig, seed: int) -> Episode:
     return Episode(sx, sy, tx, ty)
 
 
+def measure_rate(step_fn, state, batch_ep, epoch, *, batch_size: int,
+                 n_dev: int, steps: int = 30, warmup: int = 3,
+                 windows: int = 3) -> float:
+    """Median-of-windows pipelined throughput of a (compiled) train step,
+    in tasks/s/chip — THE timing methodology, shared by bench.py,
+    scripts/perf_ceiling.py and scripts/perf_resnet12_sweep.py so a fix
+    here (warmup, window count, tunnel-latency handling) changes every
+    reported number consistently.
+
+    Warmup uses a host fetch as the fence (on the tunneled 'axon'
+    backend ``block_until_ready`` has been observed returning without
+    waiting). Timed windows do NO per-step sync: steps chain through the
+    donated state and fetching each window's final loss forces the whole
+    sequence while host dispatch runs ahead of the device. The median of
+    3 windows drops the occasional 2-4x-slow window the shared tunnel
+    serves under contention. Raises FloatingPointError on a non-finite
+    loss.
+    """
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch_ep, epoch)
+        float(jax.device_get(metrics.loss))
+    per_window = max(1, steps // windows)
+    rates = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(per_window):
+            state, metrics = step_fn(state, batch_ep, epoch)
+        loss = float(jax.device_get(metrics.loss))
+        dt = time.perf_counter() - t0
+        if not np.isfinite(loss):
+            raise FloatingPointError(f"non-finite loss {loss}")
+        rates.append(batch_size * per_window / dt)
+    return float(np.median(rates)) / n_dev
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30,
@@ -211,36 +246,15 @@ def main() -> int:
     compiled = train.lower(state, batch_ep, epoch).compile()
     train = compiled
 
-    # Warmup: 3 steady-state steps, with a host fetch as the fence (on
-    # the tunneled 'axon' TPU backend ``block_until_ready`` has been
-    # observed returning without waiting; a transfer is reliable).
-    for _ in range(3):
-        state, metrics = train(state, batch_ep, epoch)
-        float(jax.device_get(metrics.loss))
-
-    # Timed loop: NO per-step sync — steps chain through the donated
-    # ``state``, so fetching a window's FINAL loss forces the whole
-    # sequence while letting host dispatch run ahead of the device
-    # (hides the ~100ms per-call tunnel latency; +14% measured).
-    # Three independent windows, median reported: the tunneled device
-    # occasionally serves a window 2-4x slow under contention, and a
-    # single-window bench would report that outlier as the framework's
-    # throughput.
-    windows = 3
-    per_window = max(1, args.steps // windows)
-    rates = []
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(per_window):
-            state, metrics = train(state, batch_ep, epoch)
-        loss = float(jax.device_get(metrics.loss))
-        dt = time.perf_counter() - t0
-        if not np.isfinite(loss):
-            print(json.dumps({"error": f"non-finite loss {loss}"}))
-            return 1
-        rates.append(cfg.batch_size * per_window / dt)
-
-    per_chip = float(np.median(rates)) / n_dev
+    # Timing methodology lives in measure_rate (shared with the perf
+    # scripts): pipelined dispatch, 3-window median, fetch-as-fence.
+    try:
+        per_chip = measure_rate(train, state, batch_ep, epoch,
+                                batch_size=cfg.batch_size, n_dev=n_dev,
+                                steps=args.steps)
+    except FloatingPointError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
     # The baseline estimate is for the FLAGSHIP workload (either batch
     # variant); a ratio against it means nothing for other configs.
     is_flagship = cfg.experiment_name.startswith(

@@ -1,0 +1,118 @@
+"""ResNet-12 pod-step tuning sweep (VERDICT r2 weak #3 / next #3).
+
+Gives the tiered-imagenet resnet12 pod workload the same treatment the
+VGG flagship got in rounds 1-2: on ONE chip, steady-state executable,
+sweep the execution knobs that do not change the science —
+
+  - remat_policy: nothing | dots | conv_outs | block_outs
+  - bn_fast_math: off | on
+  - compute_dtype: bfloat16 | float32
+  - task_microbatches: 1 | 2 | 4 (at the shipped per-chip batch)
+  - per-chip batch at the best combo
+
+Every variant times the REAL sharded second-order train step (the pod
+config's own executable re-shaped to the local chip count, exactly as
+``bench.py --config`` does). Prints one JSON line per variant; failures
+(OOM, compile errors) are recorded, not fatal.
+
+Usage: python scripts/perf_resnet12_sweep.py [--steps N] [--phase base|micro|batch]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import measure_rate, synthetic_batch
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel import (
+    make_mesh, make_sharded_steps, replicated_sharding, shard_batch)
+
+POD_CONFIG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "experiment_config", "tiered-imagenet_maml++_5-way_5-shot_resnet12_pod.json")
+
+
+def pod_cfg(**overrides) -> MAMLConfig:
+    base = MAMLConfig.from_json_file(POD_CONFIG)
+    n_dev = len(jax.devices())
+    per_chip = max(base.batch_size // int(np.prod(base.mesh_shape)), 1)
+    cfg = base.replace(batch_size=per_chip * n_dev, mesh_shape=(1, n_dev))
+    return cfg.replace(**overrides)
+
+
+def run_variant(tag: str, steps: int, **overrides) -> None:
+    t_start = time.perf_counter()
+    try:
+        cfg = pod_cfg(**overrides)
+        init, apply = make_model(cfg)
+        mesh = make_mesh(cfg, jax.devices())
+        plan = make_sharded_steps(cfg, apply, mesh)
+        # Steady-state epoch, as ExperimentBuilder selects it (second
+        # order from epoch 0 for this config: DA boundary is -1).
+        ep_idx = max(cfg.total_epochs - 1, 0)
+        train = plan.train_steps[(cfg.use_second_order(ep_idx),
+                                  cfg.use_msl(ep_idx))]
+        state = jax.device_put(
+            init_train_state(cfg, init, jax.random.PRNGKey(0)),
+            replicated_sharding(mesh))
+        ep = shard_batch(synthetic_batch(cfg, 0), mesh)
+        epoch = jnp.float32(ep_idx)
+        for _ in range(2):
+            state, m = train(state, ep, epoch)
+            float(jax.device_get(m.loss))
+        compile_s = time.perf_counter() - t_start
+        rate = measure_rate(train, state, ep, epoch,
+                            batch_size=cfg.batch_size,
+                            n_dev=len(jax.devices()),
+                            steps=steps, warmup=0)
+        print(json.dumps({
+            "variant": tag, **overrides,
+            "tasks_per_sec_per_chip": round(rate, 3),
+            "warmup_s": round(compile_s, 1)}), flush=True)
+    except Exception as e:  # noqa: BLE001 — sweep must survive OOMs
+        print(json.dumps({
+            "variant": tag, **overrides,
+            "error": f"{type(e).__name__}: {str(e)[:200]}"}), flush=True)
+        traceback.print_exc(file=sys.stderr)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--phase", default="base",
+                    choices=("base", "micro", "batch"))
+    args = ap.parse_args()
+
+    if args.phase == "base":
+        # remat x bn_fast_math at the shipped operating point.
+        for policy in ("block_outs", "nothing", "dots", "conv_outs"):
+            for fast in (True, False):
+                run_variant("remat_x_fastmath", args.steps,
+                            remat_policy=policy, bn_fast_math=fast)
+        run_variant("compute_f32", args.steps, compute_dtype="float32")
+    elif args.phase == "micro":
+        for mb in (1, 2, 4):
+            run_variant("microbatch", args.steps, task_microbatches=mb)
+    elif args.phase == "batch":
+        n_dev = len(jax.devices())
+        for b in (1, 2, 4, 8, 12):
+            run_variant("per_chip_batch", args.steps,
+                        batch_size=b * n_dev, task_microbatches=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

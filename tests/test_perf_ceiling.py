@@ -1,0 +1,137 @@
+"""Unit tests for the HLO cost model behind scripts/perf_ceiling.py.
+
+The ceiling number (docs/PERF.md) is only as good as the parser: these
+pin shape/layout byte accounting (tile padding), conv/dot FLOP parsing,
+while-loop trip multiplication, and fusion boundary-traffic costing on a
+small hand-written optimized-HLO module.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+from perf_ceiling import (  # noqa: E402
+    HloCostModel, _conv_flops, _dot_flops, _parse_instr, _shape_bytes)
+
+
+def test_shape_bytes_logical():
+    b, elems = _shape_bytes("f32[2,3]{1,0}", physical=False)
+    assert b == 24 and elems == 6
+    b, _ = _shape_bytes("bf16[4]{0}", physical=False)
+    assert b == 8
+    # Tuples: all components summed.
+    b, _ = _shape_bytes("(f32[2]{0}, s32[2]{0})", physical=False)
+    assert b == 16
+
+
+def test_shape_bytes_tile_padding():
+    # Minor-to-major {4,1,0,3,2} with T(8,128): dim4 (48) pads to 128,
+    # dim1 (25) pads to 32 — the flagship's documented ~3.4x padding.
+    text = "bf16[12,25,84,84,48]{4,1,0,3,2:T(8,128)(2,1)}"
+    logical, _ = _shape_bytes(text, physical=False)
+    physical, _ = _shape_bytes(text, physical=True)
+    assert logical == 12 * 25 * 84 * 84 * 48 * 2
+    assert physical == 12 * 32 * 84 * 84 * 128 * 2
+    # No layout string -> no padding.
+    p2, _ = _shape_bytes("bf16[12,25,84,84,48]", physical=True)
+    assert p2 == logical
+
+
+def test_parse_instr_tuple_output():
+    line = ("%fusion.1 = (f32[2]{0}, f32[3]{0}) fusion(f32[4]{0} %p.1), "
+            "kind=kLoop, calls=%fused_computation.1")
+    opcode, out_t, ops_t, attrs = _parse_instr(line)
+    assert opcode == "fusion"
+    assert out_t.startswith("(") and "f32[3]" in out_t
+    assert "f32[4]" in ops_t
+    assert "fused_computation.1" in attrs
+
+
+def test_conv_flops_grouped():
+    # Grouped conv (the task-vmapped form): kernel i-dim is already
+    # Cin/groups, so flops = 2 * out_elems * kh * kw * i.
+    out_t = "f32[12,25,84,84,48]{4,3,2,1,0}"
+    ops_t = ("f32[12,25,84,84,48]{4,3,2,1,0} %a, "
+             "f32[3,3,4,48]{3,2,1,0} %k")
+    attrs = (", window={size=3x3 pad=1_1x1_1}, "
+             "dim_labels=b01f_01io->b01f, feature_group_count=12")
+    out_elems = 12 * 25 * 84 * 84 * 48
+    assert _conv_flops(out_t, ops_t, attrs) == 2.0 * out_elems * 3 * 3 * 4
+
+
+def test_dot_flops():
+    out_t = "f32[8,16]{1,0}"
+    ops_t = "f32[8,32]{1,0} %a, f32[32,16]{1,0} %b"
+    attrs = ", lhs_contracting_dims={1}, rhs_contracting_dims={0}"
+    assert _dot_flops(out_t, ops_t, attrs) == 2.0 * 8 * 16 * 32
+
+
+_TINY_HLO = """\
+HloModule tiny
+
+%body.1 (p.0: (s32[], f32[128,128])) -> (s32[], f32[128,128]) {
+  %p.0 = (s32[]{:T(128)}, f32[128,128]{1,0}) parameter(0)
+  %gte.0 = s32[]{:T(128)} get-tuple-element(%p.0), index=0
+  %c.1 = s32[]{:T(128)} constant(1)
+  %add.0 = s32[]{:T(128)} add(s32[] %gte.0, s32[] %c.1)
+  %gte.1 = f32[128,128]{1,0} get-tuple-element(%p.0), index=1
+  %mul.0 = f32[128,128]{1,0} multiply(f32[128,128]{1,0} %gte.1, f32[128,128]{1,0} %gte.1)
+  ROOT %tuple.0 = (s32[]{:T(128)}, f32[128,128]{1,0}) tuple(%add.0, %mul.0)
+}
+
+%cond.1 (p.1: (s32[], f32[128,128])) -> pred[] {
+  %p.1 = (s32[]{:T(128)}, f32[128,128]{1,0}) parameter(0)
+  %gte.2 = s32[]{:T(128)} get-tuple-element(%p.1), index=0
+  %c.5 = s32[]{:T(128)} constant(5)
+  ROOT %lt.0 = pred[]{:T(512)} compare(s32[] %gte.2, s32[] %c.5), direction=LT
+}
+
+%fused_computation.1 (fp.0: f32[64,64], fp.1: f32[64,64]) -> f32[64,64] {
+  %fp.0 = f32[64,64]{1,0} parameter(0)
+  %fp.1 = f32[64,64]{1,0} parameter(1)
+  %d.0 = f32[64,64]{1,0} dot(f32[64,64]{1,0} %fp.0, f32[64,64]{1,0} %fp.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %r.0 = f32[64,64]{1,0} negate(f32[64,64]{1,0} %d.0)
+}
+
+ENTRY %main.1 (a.0: f32[128,128], b.0: f32[64,64]) -> f32[64,64] {
+  %a.0 = f32[128,128]{1,0} parameter(0)
+  %b.0 = f32[64,64]{1,0} parameter(1)
+  %c.0 = s32[]{:T(128)} constant(0)
+  %t.0 = (s32[]{:T(128)}, f32[128,128]{1,0}) tuple(%c.0, %a.0)
+  %w.0 = (s32[]{:T(128)}, f32[128,128]{1,0}) while(%t.0), condition=%cond.1, body=%body.1
+  ROOT %f.0 = f32[64,64]{1,0} fusion(f32[64,64]{1,0} %b.0, f32[64,64]{1,0} %b.0), kind=kOutput, calls=%fused_computation.1
+}
+"""
+
+
+def test_cost_model_tiny_module():
+    floor = 1e-6
+    bw = 1e9  # 1 GB/s so byte terms are visible
+    model = HloCostModel(_TINY_HLO, floor_s=floor, hbm_bps=bw,
+                         mxu_fps=1e12)
+    total = model.step_bound_s()
+    # While loop found with trip count 5 from the condition constant.
+    assert model.trip_counts == {"cond.1": 5}
+    # Body multiply runs 5x: each costs bytes/bw = 3*128*128*4 / 1e9.
+    mul = model.by_cat["multiply"]
+    assert mul["n"] == 5
+    assert abs(mul["time_s"] - 5 * 3 * 128 * 128 * 4 / bw) < 1e-9
+    # Fusion charged boundary bytes AND the internal dot's flops.
+    fus = model.by_cat["fusion"]
+    assert fus["flops"] == 2.0 * 64 * 64 * 64
+    # Free ops (parameter/constant/tuple/gte) contribute no kernels.
+    assert "parameter" not in model.by_cat
+    assert "tuple" not in model.by_cat
+    # Total >= the multiply chain alone.
+    assert total > mul["time_s"]
+
+
+def test_free_ops_and_kernel_count():
+    model = HloCostModel(_TINY_HLO, floor_s=1e-6, hbm_bps=1e12,
+                         mxu_fps=1e15)
+    model.step_bound_s()
+    # Executed kernels: 5x (add + multiply) in body, 5x compare in cond,
+    # 1 fusion. add/compare are tiny -> floor-bound.
+    assert model.kernels == 5 * 2 + 5 * 1 + 1
