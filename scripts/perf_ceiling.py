@@ -318,9 +318,18 @@ class HloCostModel:
                     total += self.comp_cost(m_c.group(1), mult * trips)
                 continue
             if opcode in ("call", "conditional"):
-                for c in re.findall(r"(?:to_apply|calls)=%?([\w.\-]+)",
-                                    attrs):
+                # conditionals name their branches via true_computation=/
+                # false_computation=/branch_computations={...}; calls use
+                # to_apply=. Cost every referenced branch (upper bound:
+                # one branch executes, but which one is data-dependent).
+                for c in re.findall(
+                        r"(?:to_apply|calls|true_computation|"
+                        r"false_computation)=%?([\w.\-]+)", attrs):
                     total += self.comp_cost(c, mult)
+                m = re.search(r"branch_computations=\{([^}]*)\}", attrs)
+                if m:
+                    for c in _NAME_RE.findall(m.group(1)):
+                        total += self.comp_cost(c, mult)
                 continue
             # Async pairs (copy-start/-done, async-start/-done): the DMA
             # overlaps the main kernel stream, so a speed-of-light bound
@@ -395,9 +404,15 @@ def _slope(make_fn, args_fn, n_lo: int, n_hi: int) -> float:
     fetch latency that swamps any single absolute measurement (a naive
     calibration here read the SAME ~95ms wall-clock for all three
     constants); the slope cancels it exactly."""
-    t_lo = _time_chain(make_fn(n_lo), *args_fn())
-    t_hi = _time_chain(make_fn(n_hi), *args_fn())
-    return max(t_hi - t_lo, 1e-9) / (n_hi - n_lo)
+    for attempt in range(3):
+        t_lo = _time_chain(make_fn(n_lo), *args_fn())
+        t_hi = _time_chain(make_fn(n_hi), *args_fn())
+        if t_hi > t_lo:
+            return (t_hi - t_lo) / (n_hi - n_lo)
+    raise RuntimeError(
+        f"calibration slope non-positive after 3 attempts "
+        f"(t_lo={t_lo:.4f}s, t_hi={t_hi:.4f}s) — the tunnel is too "
+        f"contended to calibrate; rerun on a quiet box")
 
 
 def calibrate() -> dict:
@@ -456,7 +471,9 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=12)
     ap.add_argument("--config", default=None)
     ap.add_argument("--skip-measure", action="store_true",
-                    help="model only (use a recorded measured rate)")
+                    help="model only: skip the measurement leg (pct_of_"
+                         "bound is then null; compare against bench.py's "
+                         "recorded rate by hand)")
     ap.add_argument("--dump", default=None, metavar="PATH",
                     help="write the optimized HLO text to PATH")
     args = ap.parse_args()
