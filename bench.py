@@ -320,8 +320,10 @@ def main() -> int:
             out["run_weighted_tasks_per_sec_per_chip"] = round(rw, 3)
             out["vs_baseline_run_weighted"] = round(
                 rw / BASELINE_TASKS_PER_SEC, 3)
-        except Exception:  # noqa: BLE001 — diagnostic key only
-            pass
+        except Exception as e:  # noqa: BLE001 — headline must survive,
+            # but a swallowed divergence (non-finite loss in a shipped
+            # executable) must still be visible in the artifact.
+            out["run_weighted_error"] = f"{type(e).__name__}: {e}"
     out["workload"] = cfg.experiment_name
     print(json.dumps(out))
     return 0

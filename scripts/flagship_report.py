@@ -1,0 +1,129 @@
+"""Digest a driven run's logs into the per-phase evidence table.
+
+Reads an experiment directory (events.jsonl + config.json +
+summary_statistics.csv + test_summary.csv) and prints, as JSON lines:
+
+- one row per schedule phase — the (second_order, use_msl) executable
+  groups the config's epoch schedule visits — with epoch range, median
+  synced whole-epoch throughput (includes host sampling + tunnel
+  transfer), and median dispatch throughput (the device-side rate,
+  robust to this box's host/tunnel bound);
+- a boundary-stall check for every phase switch: the first epoch of the
+  new phase vs its own phase's median epoch_seconds (a compile stall at
+  the swap would make it an outlier; `precompile_phases` exists to
+  prevent exactly that);
+- the cosine meta-LR endpoints (first/last train_epoch rows);
+- checkpoint retention (files on disk vs max_models_to_save);
+- the final test protocol line from test_summary.csv, if present.
+
+Usage: python scripts/flagship_report.py /path/to/<experiment_name>
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def load_events(exp_dir: str) -> list[dict]:
+    path = os.path.join(exp_dir, "logs", "events.jsonl")
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def phase_key(cfg: dict, epoch: int) -> tuple[bool, bool]:
+    """Mirror MAMLConfig.use_second_order/use_msl from the raw config
+    dict (so the report needs no package import)."""
+    # Reference semantic (few_shot_learning_system.py § forward, mirrored
+    # by MAMLConfig.use_second_order): STRICTLY epoch > boundary — the
+    # flagship's boundary-40 config flips at epoch 41.
+    da = cfg.get("first_order_to_second_order_epoch", -1)
+    so = bool(cfg.get("second_order", False)) and epoch > da
+    msl = (bool(cfg.get("use_multi_step_loss_optimization", False))
+           and epoch < cfg.get("multi_step_loss_num_epochs", 0))
+    return so, msl
+
+
+def main() -> int:
+    exp_dir = sys.argv[1]
+    with open(os.path.join(exp_dir, "config.json")) as f:
+        cfg = json.load(f)
+    events = load_events(exp_dir)
+    train = {e["epoch"]: e for e in events if e["event"] == "train_epoch"}
+    if not train:
+        print(json.dumps({"error": "no train_epoch events"}))
+        return 1
+
+    epochs = sorted(train)
+    # Group contiguous epochs by phase key.
+    phases: list[dict] = []
+    for e in epochs:
+        k = phase_key(cfg, e)
+        if phases and phases[-1]["key"] == k and phases[-1]["end"] == e - 1:
+            phases[-1]["end"] = e
+            phases[-1]["epochs"].append(e)
+        else:
+            phases.append({"key": k, "start": e, "end": e, "epochs": [e]})
+
+    for ph in phases:
+        rows = [train[e] for e in ph["epochs"]]
+        secs = [r["epoch_seconds"] for r in rows]
+        synced = [r["meta_tasks_per_sec_per_chip"] for r in rows]
+        disp = [r["dispatch_meta_tasks_per_sec_per_chip"] for r in rows
+                if "dispatch_meta_tasks_per_sec_per_chip" in r]
+        print(json.dumps({
+            "phase": {"second_order": ph["key"][0], "use_msl": ph["key"][1]},
+            "epochs": [ph["start"], ph["end"]],
+            "n": len(rows),
+            "median_epoch_seconds": round(float(np.median(secs)), 1),
+            "median_synced_tasks_per_sec_per_chip":
+                round(float(np.median(synced)), 2),
+            # None (JSON null) when no epoch carried dispatch timings
+            # (e.g. preempted epochs) — a NaN would break the JSON-lines
+            # contract.
+            "median_dispatch_tasks_per_sec_per_chip":
+                (round(float(np.median(disp)), 2) if disp else None),
+        }))
+
+    # Boundary-stall check: first epoch of each later phase vs that
+    # phase's own median.
+    for prev, ph in zip(phases, phases[1:]):
+        first = train[ph["start"]]["epoch_seconds"]
+        med = float(np.median([train[e]["epoch_seconds"]
+                               for e in ph["epochs"]]))
+        print(json.dumps({
+            "boundary": f"epoch {ph['start']} "
+                        f"({prev['key']} -> {ph['key']})",
+            "first_epoch_seconds": round(first, 1),
+            "phase_median_seconds": round(med, 1),
+            "stall_ratio": round(first / med, 2) if med else None,
+            "stalled": bool(med and first > 1.5 * med),
+        }))
+
+    print(json.dumps({
+        "meta_lr_first": train[epochs[0]]["meta_lr"],
+        "meta_lr_last": train[epochs[-1]]["meta_lr"],
+        "train_acc_last": round(train[epochs[-1]]["train_accuracy"], 4),
+    }))
+
+    models = os.path.join(exp_dir, "saved_models")
+    if os.path.isdir(models):
+        names = sorted(os.listdir(models))
+        print(json.dumps({"checkpoints": names,
+                          "max_models_to_save":
+                              cfg.get("max_models_to_save")}))
+
+    test_csv = os.path.join(exp_dir, "logs", "test_summary.csv")
+    if os.path.exists(test_csv):
+        with open(test_csv) as f:
+            for row in csv.DictReader(f):
+                print(json.dumps({"test_summary": row}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+"""Tests for the shared perf-measurement helpers.
+
+bench.measure_rate is THE timing methodology behind every reported
+number (bench.py headline, scripts/perf_ceiling.py's %-of-bound,
+scripts/perf_resnet12_sweep.py); scripts/flagship_report.py turns a
+driven run's events.jsonl into the per-phase evidence table. Both are
+pure enough to pin without a device.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import bench  # noqa: E402
+from flagship_report import phase_key  # noqa: E402
+
+
+class _FakeMetrics:
+    def __init__(self, loss):
+        self.loss = np.float32(loss)
+
+
+def _fake_step(loss=1.0):
+    calls = []
+
+    def step(state, batch, epoch):
+        calls.append(epoch)
+        return state + 1, _FakeMetrics(loss)
+
+    return step, calls
+
+
+def test_measure_rate_counts_steps_and_returns_per_chip(monkeypatch):
+    # Deterministic clock: every perf_counter() call advances 1s, so
+    # each timed window reads exactly 1s and the arithmetic is exact —
+    # the assertions below would catch a dropped n_dev division or a
+    # changed window/warmup count outright.
+    t = iter(range(10_000))
+    monkeypatch.setattr(bench.time, "perf_counter", lambda: float(next(t)))
+    step, calls = _fake_step()
+    rate = bench.measure_rate(step, 0, None, 0.0, batch_size=8, n_dev=2,
+                              steps=9, warmup=3, windows=3)
+    # 3 warmup + 3 windows x 3 steps.
+    assert len(calls) == 3 + 9
+    # Each 3-step window spans one 1s clock tick: 8*3/1s /2 chips = 12.
+    assert rate == pytest.approx(12.0)
+    step1, _ = _fake_step()
+    rate1 = bench.measure_rate(step1, 0, None, 0.0, batch_size=8,
+                               n_dev=1, steps=9, warmup=0, windows=3)
+    assert rate1 == pytest.approx(24.0)
+
+
+def test_measure_rate_raises_on_nonfinite_loss():
+    step, _ = _fake_step(loss=float("nan"))
+    with pytest.raises(FloatingPointError):
+        bench.measure_rate(step, 0, None, 0.0, batch_size=4, n_dev=1,
+                           steps=3, warmup=0)
+
+
+def test_phase_key_matches_flagship_schedule():
+    cfg = {"second_order": True, "first_order_to_second_order_epoch": 40,
+           "use_multi_step_loss_optimization": True,
+           "multi_step_loss_num_epochs": 15}
+    assert phase_key(cfg, 0) == (False, True)     # MSL window, first-order
+    assert phase_key(cfg, 14) == (False, True)
+    assert phase_key(cfg, 15) == (False, False)   # steady first-order
+    assert phase_key(cfg, 40) == (False, False)   # boundary epoch itself
+    assert phase_key(cfg, 41) == (True, False)    # DA flip: STRICTLY >
+    assert phase_key(cfg, 99) == (True, False)
+    # DA boundary -1 = second order from epoch 0 (resnet12 pod config).
+    cfg2 = {"second_order": True, "first_order_to_second_order_epoch": -1,
+            "use_multi_step_loss_optimization": True,
+            "multi_step_loss_num_epochs": 15}
+    assert phase_key(cfg2, 0) == (True, True)
+    # Plain first-order MAML never flips.
+    cfg3 = {"second_order": False, "first_order_to_second_order_epoch": -1}
+    assert phase_key(cfg3, 50) == (False, False)
+
+
+def test_phase_key_agrees_with_config_class():
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    cfg = MAMLConfig(second_order=True,
+                     first_order_to_second_order_epoch=40,
+                     use_multi_step_loss_optimization=True,
+                     multi_step_loss_num_epochs=15, total_epochs=100)
+    raw = {"second_order": True, "first_order_to_second_order_epoch": 40,
+           "use_multi_step_loss_optimization": True,
+           "multi_step_loss_num_epochs": 15}
+    for e in (0, 1, 14, 15, 16, 39, 40, 41, 99):
+        assert phase_key(raw, e) == (cfg.use_second_order(e),
+                                     cfg.use_msl(e)), e
