@@ -37,14 +37,17 @@ def load_events(exp_dir: str) -> list[dict]:
 
 def phase_key(cfg: dict, epoch: int) -> tuple[bool, bool]:
     """Mirror MAMLConfig.use_second_order/use_msl from the raw config
-    dict (so the report needs no package import)."""
+    dict (so the report needs no package import). Fallback defaults
+    MUST equal the MAMLConfig dataclass defaults (config.py:122-125,
+    pinned by tests/test_perf_tooling.py) or a config dict that omits a
+    field would silently produce a wrong phase table."""
     # Reference semantic (few_shot_learning_system.py § forward, mirrored
     # by MAMLConfig.use_second_order): STRICTLY epoch > boundary — the
     # flagship's boundary-40 config flips at epoch 41.
     da = cfg.get("first_order_to_second_order_epoch", -1)
-    so = bool(cfg.get("second_order", False)) and epoch > da
-    msl = (bool(cfg.get("use_multi_step_loss_optimization", False))
-           and epoch < cfg.get("multi_step_loss_num_epochs", 0))
+    so = bool(cfg.get("second_order", True)) and epoch > da
+    msl = (bool(cfg.get("use_multi_step_loss_optimization", True))
+           and epoch < cfg.get("multi_step_loss_num_epochs", 15))
     return so, msl
 
 
@@ -59,11 +62,14 @@ def main() -> int:
         return 1
 
     epochs = sorted(train)
-    # Group contiguous epochs by phase key.
+    # Group epochs by phase KEY transitions only: a gap in logged epochs
+    # (e.g. the epoch a preemption interrupted, re-run after resume)
+    # must not fragment a phase into two groups — that would emit a
+    # spurious same-key "boundary" row and fragment the medians.
     phases: list[dict] = []
     for e in epochs:
         k = phase_key(cfg, e)
-        if phases and phases[-1]["key"] == k and phases[-1]["end"] == e - 1:
+        if phases and phases[-1]["key"] == k:
             phases[-1]["end"] = e
             phases[-1]["epochs"].append(e)
         else:

@@ -85,6 +85,16 @@ def test_phase_key_matches_flagship_schedule():
     assert phase_key(cfg3, 50) == (False, False)
 
 
+def test_phase_key_defaults_match_dataclass():
+    # A raw dict OMITTING fields must behave like MAMLConfig's defaults
+    # (second_order=True, MSL on with a 15-epoch window, DA boundary -1).
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    cfg = MAMLConfig()
+    for e in (0, 14, 15, 50):
+        assert phase_key({}, e) == (cfg.use_second_order(e),
+                                    cfg.use_msl(e)), e
+
+
 def test_phase_key_agrees_with_config_class():
     from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
     cfg = MAMLConfig(second_order=True,
