@@ -404,6 +404,18 @@ def _slope(make_fn, args_fn, n_lo: int, n_hi: int) -> float:
     fetch latency that swamps any single absolute measurement (a naive
     calibration here read the SAME ~95ms wall-clock for all three
     constants); the slope cancels it exactly."""
+    # The BW and matmul chain lengths are chosen so the REAL work delta
+    # is ~1 s of device time: the tunnel adds tens of ms of per-call
+    # jitter, and a slope whose true delta is comparable to that jitter
+    # swings wildly (observed: 593-2815 GB/s for the same chip across
+    # runs before the lengths were scaled up); at ~1 s deltas that
+    # jitter is <5%. The kernel-floor chain cannot reach ~1 s (its ops
+    # must stay at module top level, and a ~300k-op HLO won't compile
+    # in reasonable time), so its ~24 ms delta stays jitter-exposed —
+    # acceptable because the floor term is ~2% of the modeled bound,
+    # and min-of-reps timing plus the plausibility bounds below cap the
+    # damage.
+    t_lo = t_hi = float("nan")
     for attempt in range(3):
         t_lo = _time_chain(make_fn(n_lo), *args_fn())
         t_hi = _time_chain(make_fn(n_hi), *args_fn())
@@ -416,9 +428,15 @@ def _slope(make_fn, args_fn, n_lo: int, n_hi: int) -> float:
 
 
 def calibrate() -> dict:
-    """Measure the three model constants on this chip (slope method)."""
-    # Kernel floor: N dependent kernels, fusion broken by
-    # optimization_barrier, so each multiply is its own tiny kernel.
+    """Measure the three model constants on this chip (slope method,
+    ~1 s work deltas — see _slope). Results are sanity-bounded: a value
+    outside physical plausibility for any current TPU means the
+    measurement was corrupted and the model must not run on it."""
+    # Kernel floor: dependent TOP-LEVEL kernels, fusion broken by
+    # optimization_barrier. The ops must be at module top level — inside
+    # a scan body they execute within one compiled loop region and
+    # measure ~0.02us/op, which is not the entry-computation per-kernel
+    # overhead this constant represents (a sanity-bound catch).
     x0 = jnp.ones((8, 128), jnp.float32)
 
     def make_chain(n):
@@ -429,7 +447,7 @@ def calibrate() -> dict:
             return jnp.sum(x)
         return chain
 
-    floor = _slope(make_chain, lambda: (x0,), 200, 2200)
+    floor = _slope(make_chain, lambda: (x0,), 400, 8400)
 
     # Streaming bandwidth: chained big-buffer add (reads+writes 2*size).
     size = 192 * 1024 * 1024  # 192 MB, comfortably inside HBM
@@ -444,10 +462,10 @@ def calibrate() -> dict:
             return jnp.sum(c[:1])
         return stream
 
-    per_iter = _slope(make_stream, lambda: (big,), 4, 64)
+    per_iter = _slope(make_stream, lambda: (big,), 10, 2010)
     bw = 2.0 * size / per_iter
 
-    # Matmul peak: chained 2048^3 bf16 matmuls.
+    # Matmul peak: chained 2048^3 bf16 matmuls (~17.2 GFLOP each).
     a = jnp.ones((2048, 2048), jnp.bfloat16)
 
     def make_mm(n):
@@ -459,10 +477,29 @@ def calibrate() -> dict:
             return jnp.sum(c[:1, :1].astype(jnp.float32))
         return mm
 
-    per_mm = _slope(make_mm, lambda: (a,), 5, 105)
+    per_mm = _slope(make_mm, lambda: (a,), 10, 25010)
     peak = 2.0 * 2048 ** 3 / per_mm
-    return {"kernel_floor_us": floor * 1e6, "hbm_gbps": bw / 1e9,
-            "matmul_tflops": peak / 1e12}
+    cal = {"kernel_floor_us": floor * 1e6, "hbm_gbps": bw / 1e9,
+           "matmul_tflops": peak / 1e12}
+    _check_cal_bounds(cal)
+    return cal
+
+
+# Physical plausibility for any current TPU generation: HBM3e tops out
+# under 2 TB/s/chip and no chip exceeds ~1 PFLOP/s dense bf16 — the
+# observed corrupted readings (2815 GB/s, 3755 TFLOP/s) must fail.
+_CAL_BOUNDS = {"kernel_floor_us": (0.2, 100.0), "hbm_gbps": (50, 2000),
+               "matmul_tflops": (10, 1000)}
+
+
+def _check_cal_bounds(cal: dict) -> None:
+    for k, (lo, hi) in _CAL_BOUNDS.items():
+        if not lo <= cal[k] <= hi:
+            raise RuntimeError(
+                f"calibration {k}={cal[k]:.3g} outside plausible "
+                f"range [{lo}, {hi}] — measurement corrupted (tunnel "
+                f"contention?) or --cal fields out of order; expected "
+                f"FLOOR_US,BW_GBPS,MM_TFLOPS")
 
 
 def main() -> int:
@@ -476,6 +513,14 @@ def main() -> int:
                          "recorded rate by hand)")
     ap.add_argument("--dump", default=None, metavar="PATH",
                     help="write the optimized HLO text to PATH")
+    ap.add_argument("--cal", default=None,
+                    metavar="FLOOR_US,BW_GBPS,MM_TFLOPS",
+                    help="reuse recorded calibration constants instead "
+                         "of measuring (the shared tunnel time-slices "
+                         "long bursts, so sustained calibrations can "
+                         "understate capability — see docs/PERF.md; "
+                         "pass the best-observed envelope for a true "
+                         "ceiling)")
     args = ap.parse_args()
 
     devices = jax.devices()
@@ -489,7 +534,19 @@ def main() -> int:
     batch = args.batch or per_chip * n_dev
     cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev))
 
-    cal = calibrate()
+    if args.cal:
+        parts = args.cal.split(",")
+        if len(parts) != 3:
+            print(json.dumps({"error": "--cal needs exactly 3 comma-"
+                              "separated values: FLOOR_US,BW_GBPS,"
+                              "MM_TFLOPS"}))
+            return 1
+        cal = {"kernel_floor_us": float(parts[0]),
+               "hbm_gbps": float(parts[1]),
+               "matmul_tflops": float(parts[2]), "recorded": True}
+        _check_cal_bounds(cal)
+    else:
+        cal = calibrate()
     print(json.dumps({"calibration": cal}), flush=True)
 
     init, apply = make_model(cfg)
