@@ -222,10 +222,16 @@ def main() -> int:
     batch = args.batch or per_chip * n_dev
     cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev))
     if args.quick:
+        quick_batch = max(2 * n_dev, 2)
         cfg = cfg.replace(
             image_height=16, image_width=16,
             cnn_num_filters=8, num_stages=2,
-            batch_size=max(2 * n_dev, 2))
+            batch_size=quick_batch,
+            # The shipped pod config runs task_microbatches=8, which
+            # cannot divide the shrunken quick batch — clamp to keep
+            # the accumulation scan legal at tiny scale.
+            task_microbatches=min(cfg.task_microbatches,
+                                  quick_batch // n_dev))
         args.steps = min(args.steps, 3)
 
     init, apply = make_model(cfg)
