@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -220,7 +221,13 @@ def main() -> int:
     per_chip = max(
         base.batch_size // max(int(np.prod(base.mesh_shape)), 1), 1)
     batch = args.batch or per_chip * n_dev
-    cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev))
+    # A --batch override can make the shipped task_microbatches (12/8
+    # on the flagship configs) stop dividing the per-device share —
+    # clamp to the gcd so the accumulation geometry stays as close to
+    # shipped as the requested batch allows.
+    mb = math.gcd(max(batch // n_dev, 1), base.task_microbatches)
+    cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev),
+                       task_microbatches=mb)
     if args.quick:
         quick_batch = max(2 * n_dev, 2)
         cfg = cfg.replace(

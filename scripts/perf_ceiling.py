@@ -532,7 +532,10 @@ def main() -> int:
     per_chip = max(base.batch_size // max(
         int(np.prod(base.mesh_shape)), 1), 1)
     batch = args.batch or per_chip * n_dev
-    cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev))
+    import math as _math
+    mb = _math.gcd(max(batch // n_dev, 1), base.task_microbatches)
+    cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev),
+                       task_microbatches=mb)
 
     if args.cal:
         parts = args.cal.split(",")
