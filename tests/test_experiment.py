@@ -404,6 +404,10 @@ def test_parity_runner_smoke(tmp_path):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # Pin the subprocess to CPU: the ambient sitecustomize overrides
+    # JAX_PLATFORMS, and a TPU-tunnel outage would otherwise hang the
+    # smoke in backend init (observed 2026-07-31).
+    env["MAML_JAX_PLATFORM"] = "cpu"
     proc = subprocess.run(
         ["bash", os.path.join(repo, "scripts", "parity_run.sh"),
          str(tmp_path / "datasets"), str(tmp_path / "out"),
@@ -412,6 +416,9 @@ def test_parity_runner_smoke(tmp_path):
          "--dataset_name", "synthetic_mini_imagenet",
          "--image_height", "28", "--image_width", "28",
          "--cnn_num_filters", "8", "--batch_size", "4",
+         # The shipped config's task_microbatches=8 cannot divide the
+         # scaled batch; mb=4 keeps the one-task-per-chunk geometry.
+         "--task_microbatches", "4",
          "--num_samples_per_class", "1", "--num_target_samples", "1",
          "--total_epochs", "2", "--total_iter_per_epoch", "4",
          "--num_evaluation_tasks", "8", "--max_models_to_save", "2",

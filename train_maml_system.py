@@ -14,6 +14,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
@@ -104,6 +105,15 @@ def get_args(argv=None) -> MAMLConfig:
 
 def main(argv=None) -> int:
     cfg = get_args(argv)
+    # Optional platform pin (e.g. MAML_JAX_PLATFORM=cpu): this
+    # environment's sitecustomize overrides the JAX_PLATFORMS env var,
+    # so CI subprocesses (scripts/parity_run.sh smoke) and CPU-only
+    # boxes need an env knob that wins — jax.config.update does, as
+    # long as it runs before first backend use.
+    platform = os.environ.get("MAML_JAX_PLATFORM")
+    if platform:
+        import jax as _jax
+        _jax.config.update("jax_platforms", platform)
     # Multi-host bootstrap (no-op single-process); must run before any
     # device query so jax.devices() is the global pod device list.
     from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
