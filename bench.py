@@ -34,11 +34,16 @@ BASELINE.json's north-star target is 4x single-A100, i.e. vs_baseline >= 4.
 
 Usage: python bench.py [--steps N] [--batch B] [--quick]
                        [--config experiment_config/<cfg>.json]
+                       [--backend-timeout S]
+Backend init is retried with bounded backoff (default up to 10 min,
+subprocess probes so a wedged/hung tunnel can be escaped) before
+failing — one transient tunnel outage must not zero a capture.
 Prints the headline JSON line {"metric", "value", "unit",
-"vs_baseline"} as soon as it is measured; for the flagship workload a
-second, enriched line (a strict superset, adding the run-weighted
+"vs_baseline"} as soon as it is measured; for the flagship workload
+enriched lines follow (each a strict superset): the run-weighted
 whole-schedule throughput measured across every executable the config's
-epoch schedule visits) follows. The LAST JSON line is authoritative. With
+epoch schedule visits, then the strict paper batch-8 operating point
+(`strict_b8_*` keys). The LAST JSON line is authoritative. With
 --config, any shipped workload is benched instead of the flagship (batch
 and mesh re-shaped to the local device count, everything else as
 shipped); "vs_baseline" is then null — the baseline estimate is for the
@@ -51,8 +56,11 @@ import argparse
 import json
 import math
 import os
+import subprocess
 import sys
+import threading
 import time
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -76,6 +84,82 @@ _PEAK_BF16_FLOPS = (
     ("v6", 918e12), ("trillium", 918e12),
     ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
 )
+
+
+def wait_for_backend(timeout_s: float = 600.0, interval_s: float = 20.0,
+                     probe_timeout_s: float = 150.0) -> None:
+    """Block until the JAX backend can initialize, or raise after
+    ``timeout_s`` (VERDICT r3 weak #1: the tunneled 'axon' TPU backend
+    has transient outages, and BENCH_r03 died rc=1 in a bare
+    ``jax.devices()`` during one — a single outage must not be able to
+    zero a round's capture).
+
+    Probes in a SUBPROCESS: a failed in-process init is cached by
+    jax.xla_bridge and would keep re-raising even after the tunnel
+    recovers, and a WEDGED tunnel makes ``jax.devices()`` hang forever
+    (observed), which only a killable child escapes. The probe inherits
+    this process's env, so it initializes the same backend bench will.
+    No-op cost when the backend is healthy: one short-lived child.
+    """
+    code = ("import os, jax\n"
+            "p = os.environ.get('MAML_JAX_PLATFORM')\n"
+            "if p: jax.config.update('jax_platforms', p)\n"
+            "jax.devices()\n")
+    deadline = time.monotonic() + timeout_s
+    attempt = 0
+    while True:
+        attempt += 1
+        # Clamp each probe (and each sleep, below) to the remaining
+        # budget so the call returns within ~timeout_s even when the
+        # first probe would hang for the full probe timeout.
+        budget = max(deadline - time.monotonic(), 1.0)
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               timeout=min(probe_timeout_s, budget),
+                               capture_output=True, text=True)
+            if r.returncode == 0:
+                if attempt > 1:
+                    print(f"[bench] backend up after {attempt} probes",
+                          file=sys.stderr, flush=True)
+                return
+            err = (r.stderr or r.stdout).strip().splitlines()
+            err = err[-1] if err else f"rc={r.returncode}"
+        except subprocess.TimeoutExpired:
+            err = f"probe hung (wedged tunnel?)"
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RuntimeError(
+                f"JAX backend unavailable after {timeout_s:.0f}s "
+                f"({attempt} probes); last error: {err}")
+        sleep_s = min(interval_s, remaining)
+        print(f"[bench] backend probe {attempt} failed: {err[:160]} — "
+              f"retrying in {sleep_s:.0f}s ({remaining:.0f}s left)",
+              file=sys.stderr, flush=True)
+        time.sleep(sleep_s)
+
+
+def init_devices_with_watchdog(timeout_s: float = 300.0):
+    """First in-process backend init, bounded: if the tunnel wedges in
+    the gap after wait_for_backend's probe child succeeded, a bare
+    ``jax.devices()`` would hang this process FOREVER (a blocked PJRT C
+    call cannot be interrupted in-process, and a failed init is cached
+    by xla_bridge so no in-process retry is possible either). A daemon
+    watchdog turns that into a bounded, explained exit the driver can
+    record instead of an infinite stall."""
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(timeout_s):
+            print(json.dumps({"error": f"in-process backend init hung "
+                                       f">{timeout_s:.0f}s after a "
+                                       f"successful probe (tunnel wedged "
+                                       f"mid-gap)"}), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    devices = jax.devices()
+    done.set()
+    return devices
 
 
 def _peak_flops(device) -> float:
@@ -184,6 +268,59 @@ def measure_rate(step_fn, state, batch_ep, epoch, *, batch_size: int,
     return float(np.median(rates)) / n_dev
 
 
+def load_workload(config_path: str, batch_override: int,
+                  n_dev: int) -> MAMLConfig:
+    """A shipped config re-shaped to the local device count: per-chip
+    batch = the file's global batch over the file's mesh size; every
+    execution knob (microbatching, remat, bn_fast_math, toggles) stays
+    as shipped so the timed step IS the training step. A --batch
+    override clamps task_microbatches to the gcd so the accumulation
+    geometry stays as close to shipped as the requested batch allows."""
+    base = MAMLConfig.from_json_file(config_path)
+    per_chip = max(
+        base.batch_size // max(int(np.prod(base.mesh_shape)), 1), 1)
+    batch = batch_override or per_chip * n_dev
+    mb = math.gcd(max(batch // n_dev, 1), base.task_microbatches)
+    return base.replace(batch_size=batch, mesh_shape=(1, n_dev),
+                        task_microbatches=mb)
+
+
+class Workload(NamedTuple):
+    """A config built + AOT-compiled at its steady-state epoch — THE
+    single build path behind the headline, run-weighted and strict-b8
+    numbers (one place to fix sharding/epoch-pick rules)."""
+    init: Any
+    mesh: Any
+    plan: Any
+    state: Any
+    batch_ep: Any
+    epoch: Any
+    compiled: Any
+    bench_epoch: int
+
+
+def build_steady_state(cfg: MAMLConfig, devices) -> Workload:
+    """Build cfg's steady-state (last-epoch) train step: by definition an
+    executable real training runs, past every annealing boundary that is
+    ever crossed (DA's switch to second order, MSL's window), selected
+    exactly as ExperimentBuilder does per epoch. The compiled executable
+    serves warmup, the timed windows AND the FLOPs cost analysis."""
+    init, apply = make_model(cfg)
+    mesh = make_mesh(cfg, devices)
+    plan = make_sharded_steps(cfg, apply, mesh)
+    bench_epoch = max(cfg.total_epochs - 1, 0)
+    train = plan.train_steps[(cfg.use_second_order(bench_epoch),
+                              cfg.use_msl(bench_epoch))]
+    state = jax.device_put(init_train_state(cfg, init,
+                                            jax.random.PRNGKey(0)),
+                           replicated_sharding(mesh))
+    batch_ep = shard_batch(synthetic_batch(cfg, 0), mesh)
+    epoch = jnp.float32(bench_epoch)
+    compiled = train.lower(state, batch_ep, epoch).compile()
+    return Workload(init, mesh, plan, state, batch_ep, epoch, compiled,
+                    bench_epoch)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30,
@@ -203,31 +340,34 @@ def main() -> int:
                     help="skip timing the schedule's other executables "
                          "(MSL window / first-order phases) for the "
                          "vs_baseline_run_weighted key")
+    ap.add_argument("--no-strict-b8", action="store_true",
+                    help="skip the strict paper batch-8 operating point "
+                         "leg (the strict_b8_* keys)")
+    ap.add_argument("--backend-timeout", type=float, default=600.0,
+                    help="seconds to poll for JAX backend availability "
+                         "before failing (tunnel outages are transient; "
+                         "0 = no retry, fail on first init error)")
     args = ap.parse_args()
 
-    devices = jax.devices()
+    # Platform pin (same contract as train_maml_system.py): the config
+    # update bypasses the axon sitecustomize where the env var alone
+    # does not.
+    platform = os.environ.get("MAML_JAX_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if args.backend_timeout > 0:
+        wait_for_backend(timeout_s=args.backend_timeout)
+        devices = init_devices_with_watchdog()
+    else:
+        devices = jax.devices()
     n_dev = len(devices)
     # No --config: bench the shipped flagship operating point (see module
     # docstring) so the headline number IS a shipped-config number.
+    repo = os.path.dirname(os.path.abspath(__file__))
     config_path = args.config or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "experiment_config",
+        repo, "experiment_config",
         "mini-imagenet_maml++_5-way_5-shot_DA_b12.json")
-    base = MAMLConfig.from_json_file(config_path)
-    # Default per-chip batch = what real training would run per chip
-    # (the file's global batch over the file's mesh size); only batch
-    # and mesh are re-shaped to the local device count — every
-    # execution knob (microbatching, remat, bn_fast_math, toggles)
-    # stays as shipped so the timed step IS the training step.
-    per_chip = max(
-        base.batch_size // max(int(np.prod(base.mesh_shape)), 1), 1)
-    batch = args.batch or per_chip * n_dev
-    # A --batch override can make the shipped task_microbatches (12/8
-    # on the flagship configs) stop dividing the per-device share —
-    # clamp to the gcd so the accumulation geometry stays as close to
-    # shipped as the requested batch allows.
-    mb = math.gcd(max(batch // n_dev, 1), base.task_microbatches)
-    cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev),
-                       task_microbatches=mb)
+    cfg = load_workload(config_path, args.batch, n_dev)
     if args.quick:
         quick_batch = max(2 * n_dev, 2)
         cfg = cfg.replace(
@@ -241,36 +381,19 @@ def main() -> int:
                                   quick_batch // n_dev))
         args.steps = min(args.steps, 3)
 
-    init, apply = make_model(cfg)
-    mesh = make_mesh(cfg, devices)
-    plan = make_sharded_steps(cfg, apply, mesh)
-    # Steady-state epoch = the LAST training epoch: by definition an
-    # executable real training runs, and past every annealing boundary
-    # that is ever crossed (DA's switch to second order, MSL's window),
-    # whatever the config's schedule looks like. Selected exactly as
-    # ExperimentBuilder does per epoch. For the flagship (total_epochs
-    # 100, DA boundary -1, MSL window 15) this is the second-order,
-    # final-step-loss executable of epochs 15..99.
-    bench_epoch = max(cfg.total_epochs - 1, 0)
-    train = plan.train_steps[(cfg.use_second_order(bench_epoch),
-                              cfg.use_msl(bench_epoch))]
-
-    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
-    state = jax.device_put(
-        state, replicated_sharding(mesh))
-    batch_ep = shard_batch(synthetic_batch(cfg, 0), mesh)
-    epoch = jnp.float32(bench_epoch)
-
-    # AOT-compile once; the same executable serves warmup, the timed
-    # windows AND the FLOPs cost analysis (lowering again later would
-    # re-run the multi-minute flagship compile just to read a counter).
-    compiled = train.lower(state, batch_ep, epoch).compile()
-    train = compiled
+    # One build path (build_steady_state) for every number this tool
+    # prints; for the flagship (total_epochs 100, DA boundary -1, MSL
+    # window 15) the steady state is the second-order, final-step-loss
+    # executable of epochs 15..99.
+    wl = build_steady_state(cfg, devices)
+    init, mesh, plan = wl.init, wl.mesh, wl.plan
+    state, batch_ep, epoch, compiled = (wl.state, wl.batch_ep, wl.epoch,
+                                        wl.compiled)
 
     # Timing methodology lives in measure_rate (shared with the perf
     # scripts): pipelined dispatch, 3-window median, fetch-as-fence.
     try:
-        per_chip = measure_rate(train, state, batch_ep, epoch,
+        per_chip = measure_rate(compiled, state, batch_ep, epoch,
                                 batch_size=cfg.batch_size, n_dev=n_dev,
                                 steps=args.steps)
     except FloatingPointError as e:
@@ -312,6 +435,7 @@ def main() -> int:
     # Each non-headline executable is timed briefly; the whole-run rate
     # is the epoch-weighted harmonic mean (equal tasks per epoch).
     # Fail-soft: the headline line must survive any hiccup here.
+    bench_epoch = wl.bench_epoch
     if is_flagship and not args.no_run_weighted and not args.quick:
         try:
             keys = {}
@@ -347,6 +471,35 @@ def main() -> int:
             # but a swallowed divergence (non-finite loss in a shipped
             # executable) must still be visible in the artifact.
             out["run_weighted_error"] = f"{type(e).__name__}: {e}"
+        out["workload"] = cfg.experiment_name
+        print(json.dumps(out), flush=True)
+    # Strict paper batch-8 operating point (VERDICT r3 item 6: the 4x
+    # gate has been argued three ways across rounds — emit headline,
+    # run-weighted AND strict-b8 in one machine-readable object every
+    # default run). This is the shipped ..._DA.json config: meta-batch
+    # 8/chip exactly as the paper trains, at ITS shipped microbatching.
+    # Fail-soft like run-weighted; the LAST JSON line stays a strict
+    # superset of everything measured before the hiccup. Gated on
+    # is_flagship (NOT on --config absence) so the docstring's
+    # equivalence `python bench.py == python bench.py --config
+    # ..._DA_b12.json` holds key-for-key; skipped when the benched
+    # workload IS the strict-b8 config (it would re-measure itself).
+    if (is_flagship and not args.quick and not args.no_strict_b8
+            and cfg.experiment_name != "mini-imagenet_maml++_5-way_5-shot_DA"):
+        try:
+            b8_cfg = load_workload(
+                os.path.join(repo, "experiment_config",
+                             "mini-imagenet_maml++_5-way_5-shot_DA.json"),
+                0, n_dev)
+            wl8 = build_steady_state(b8_cfg, devices)
+            b8 = measure_rate(wl8.compiled, wl8.state, wl8.batch_ep,
+                              wl8.epoch, batch_size=b8_cfg.batch_size,
+                              n_dev=n_dev, steps=9)
+            out["strict_b8_tasks_per_sec_per_chip"] = round(b8, 3)
+            out["vs_baseline_strict_b8"] = round(
+                b8 / BASELINE_TASKS_PER_SEC, 3)
+        except Exception as e:  # noqa: BLE001
+            out["strict_b8_error"] = f"{type(e).__name__}: {e}"
         out["workload"] = cfg.experiment_name
         print(json.dumps(out), flush=True)
     return 0

@@ -65,6 +65,85 @@ def test_measure_rate_raises_on_nonfinite_loss():
                            steps=3, warmup=0)
 
 
+class _FakeCompleted:
+    def __init__(self, rc, stderr=""):
+        self.returncode = rc
+        self.stderr = stderr
+        self.stdout = ""
+
+
+def test_wait_for_backend_returns_on_first_success(monkeypatch):
+    runs = []
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: (runs.append(a),
+                                         _FakeCompleted(0))[1])
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: pytest.fail("slept on healthy backend"))
+    bench.wait_for_backend(timeout_s=600)
+    assert len(runs) == 1
+
+
+def test_wait_for_backend_retries_then_succeeds(monkeypatch):
+    outcomes = iter([_FakeCompleted(1, "UNAVAILABLE: axon"),
+                     _FakeCompleted(1, "UNAVAILABLE: axon"),
+                     _FakeCompleted(0)])
+    sleeps = []
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: next(outcomes))
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    bench.wait_for_backend(timeout_s=600, interval_s=7)
+    assert sleeps == [7, 7]
+
+
+def test_wait_for_backend_gives_up_after_deadline(monkeypatch):
+    # Monotonic clock that jumps past the deadline after the second
+    # probe; the raise must carry the LAST probe error for the artifact.
+    t = iter([0.0, 1.0, 10_000.0])
+    monkeypatch.setattr(bench.time, "monotonic", lambda: next(t))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(
+        bench.subprocess, "run",
+        lambda *a, **k: _FakeCompleted(1, "UNAVAILABLE: tunnel down"))
+    with pytest.raises(RuntimeError, match="tunnel down"):
+        bench.wait_for_backend(timeout_s=600)
+
+
+def test_wait_for_backend_survives_hung_probe(monkeypatch):
+    # A wedged tunnel HANGS jax.devices(); the probe child is killed by
+    # timeout and must count as a failed attempt, not crash the loop.
+    outcomes = iter([
+        bench.subprocess.TimeoutExpired(cmd="probe", timeout=150),
+        _FakeCompleted(0)])
+
+    def fake_run(*a, **k):
+        o = next(outcomes)
+        if isinstance(o, Exception):
+            raise o
+        return o
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench.wait_for_backend(timeout_s=600)
+
+
+def test_load_workload_reshapes_batch_and_mesh():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "experiment_config",
+                        "mini-imagenet_maml++_5-way_5-shot_DA_b12.json")
+    cfg = bench.load_workload(path, 0, 1)
+    assert cfg.mesh_shape == (1, 1)
+    # Per-chip batch preserved from the shipped global batch / mesh.
+    base = bench.MAMLConfig.from_json_file(path)
+    per_chip = base.batch_size // max(
+        int(np.prod(base.mesh_shape)), 1)
+    assert cfg.batch_size == per_chip
+    assert cfg.task_microbatches == base.task_microbatches
+    # A --batch override that breaks divisibility clamps mb to the gcd.
+    cfg4 = bench.load_workload(path, 4, 1)
+    assert cfg4.batch_size == 4
+    assert 4 % cfg4.task_microbatches == 0
+
+
 def test_phase_key_matches_flagship_schedule():
     cfg = {"second_order": True, "first_order_to_second_order_epoch": 40,
            "use_multi_step_loss_optimization": True,
