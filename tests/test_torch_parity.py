@@ -65,9 +65,12 @@ def _episode(key=0):
         target_y=np.repeat(np.arange(n, dtype=np.int32), t))
 
 
-def torch_forward(params, x_nhwc, step, cfg=CFG):
+def torch_forward(params, x_nhwc, step, cfg=CFG, running=None):
     """Oracle forward: conv(pad=1) -> per-step BN(batch stats) -> relu ->
-    maxpool2 -> flatten -> linear, NCHW."""
+    maxpool2 -> flatten -> linear, NCHW. With ``running`` (a dict
+    ``norm{i} -> (mean_rows, var_rows)``) the indexed per-step running-stat
+    row is updated IN PLACE by F.batch_norm, mirroring the framework's
+    tracked-but-not-normalizing convention."""
     x = torch.tensor(np.asarray(x_nhwc).transpose(0, 3, 1, 2)) \
         if not torch.is_tensor(x_nhwc) else x_nhwc
     for i in range(cfg.num_stages):
@@ -75,9 +78,13 @@ def torch_forward(params, x_nhwc, step, cfg=CFG):
         x = F.conv2d(x, w, b, stride=1, padding=1)
         gamma = params[f"norm{i}_gamma"][step]
         beta = params[f"norm{i}_beta"][step]
+        rm = rv = None
+        if running is not None:
+            rm, rv = (running[f"norm{i}"][0][step],
+                      running[f"norm{i}"][1][step])
         # Reference BN semantics: always batch statistics (training=True),
         # running buffers tracked but never used to normalize.
-        x = F.batch_norm(x, None, None, weight=gamma, bias=beta,
+        x = F.batch_norm(x, rm, rv, weight=gamma, bias=beta,
                          training=True, momentum=cfg.batch_norm_momentum,
                          eps=cfg.batch_norm_eps)
         x = F.relu(x)
@@ -267,6 +274,260 @@ def test_lslr_gradient_parity(model):
             np.testing.assert_allclose(
                 got, want, rtol=5e-3, atol=5e-4,
                 err_msg=f"LSLR grad for {k}.{name}")
+
+
+# ---------------------------------------------------------------------------
+# Trajectory-level parity (VERDICT r3 item 2): N outer steps of BOTH full
+# training systems — Adam + per-epoch cosine meta-LR + MSL annealing across
+# the epoch boundary + derivative-order annealing + BN running-stat
+# threading — must track. The single-step tests above pin each gradient;
+# this pins the OPTIMIZATION DYNAMICS (reference
+# ``few_shot_learning_system.py § meta_update`` + ``CosineAnnealingLR`` +
+# ``get_per_step_loss_importance_vector`` epoch schedule), the strongest
+# accuracy-parity evidence available without the real datasets.
+# ---------------------------------------------------------------------------
+
+TRAJ_STEPS = 50
+
+# 5 iters/epoch x 10 epochs: 50 outer steps sweep the full cosine curve,
+# cross the MSL annealing boundary at epoch 2 (step 10) and — in the DA
+# variant — the first->second order boundary after epoch 4 (step 25),
+# visiting all three executables a real flagship schedule visits.
+TRAJ_CFG = CFG.replace(
+    batch_size=2, total_iter_per_epoch=5, total_epochs=10,
+    use_multi_step_loss_optimization=True, multi_step_loss_num_epochs=2,
+    meta_learning_rate=1e-3, min_learning_rate=1e-5)
+
+
+def _traj_cosine_lr(cfg, step):
+    epoch = min((step // cfg.total_iter_per_epoch) / cfg.total_epochs, 1.0)
+    return (cfg.min_learning_rate
+            + (cfg.meta_learning_rate - cfg.min_learning_rate)
+            * 0.5 * (1.0 + np.cos(np.pi * epoch)))
+
+
+def _traj_msl_weights(cfg, epoch):
+    k = cfg.number_of_training_steps_per_iter
+    decay = 1.0 / k / cfg.multi_step_loss_num_epochs
+    w = np.full(k, max(1.0 / k - epoch * decay, 0.03 / k))
+    w[-1] = min(1.0 / k + epoch * (k - 1) * decay,
+                1.0 - (k - 1) * 0.03 / k)
+    return w
+
+
+def _traj_batches(cfg, n_steps, seed=0):
+    rng = np.random.default_rng(seed)
+    n, k, t = (cfg.num_classes_per_set, cfg.num_samples_per_class,
+               cfg.num_target_samples)
+    h, w, c = cfg.image_shape
+    b = cfg.batch_size
+    out = []
+    for _ in range(n_steps):
+        out.append(Episode(
+            support_x=rng.standard_normal(
+                (b, n * k, h, w, c)).astype(np.float32),
+            support_y=np.tile(np.repeat(np.arange(n, dtype=np.int32), k),
+                              (b, 1)),
+            target_x=rng.standard_normal(
+                (b, n * t, h, w, c)).astype(np.float32),
+            target_y=np.tile(np.repeat(np.arange(n, dtype=np.int32), t),
+                             (b, 1))))
+    return out
+
+
+def _torch_trajectory(cfg, params0, bn0, batches):
+    """The oracle training system: per outer step, loop tasks in Python
+    (the reference's semantic data parallelism), K inner steps with
+    create_graph per the DA schedule, MSL per the annealing window,
+    running-stat rows threaded across iterations as the mean over the
+    task batch; one Adam step at the per-epoch cosine LR."""
+    k_inner = cfg.number_of_training_steps_per_iter
+    fast_keys = [f"conv{i}" for i in range(cfg.num_stages)] + ["linear"]
+    tp = jax_params_to_torch(params0, requires_grad=True)
+    lslr = {(key, leaf): torch.full((cfg.lslr_num_steps,),
+                                    cfg.task_learning_rate,
+                                    requires_grad=True)
+            for key in fast_keys for leaf in (0, 1)}
+    running = {f"norm{i}": (
+        torch.tensor(np.asarray(bn0[f"norm{i}"]["mean"])),
+        torch.tensor(np.asarray(bn0[f"norm{i}"]["var"])))
+        for i in range(cfg.num_stages)}
+    leaves = ([v for pair in (tp[k] for k in fast_keys) for v in pair]
+              + [tp[f"norm{i}_gamma"] for i in range(cfg.num_stages)]
+              + [tp[f"norm{i}_beta"] for i in range(cfg.num_stages)]
+              + list(lslr.values()))
+    opt = torch.optim.Adam(leaves, lr=cfg.meta_learning_rate,
+                           betas=(cfg.meta_adam_beta1, cfg.meta_adam_beta2),
+                           eps=cfg.meta_adam_eps)
+    losses = []
+    for t, ep in enumerate(batches):
+        epoch = t // cfg.total_iter_per_epoch
+        second_order = cfg.use_second_order(epoch)
+        use_msl = cfg.use_msl(epoch)
+        msl_w = _traj_msl_weights(cfg, epoch)
+        task_losses = []
+        new_running = {key: (torch.zeros_like(m), torch.zeros_like(v))
+                       for key, (m, v) in running.items()}
+        for b in range(cfg.batch_size):
+            run_b = {key: (m.clone(), v.clone())
+                     for key, (m, v) in running.items()}
+            sx = torch.tensor(
+                np.asarray(ep.support_x[b]).transpose(0, 3, 1, 2))
+            tx = torch.tensor(
+                np.asarray(ep.target_x[b]).transpose(0, 3, 1, 2))
+            sy = torch.tensor(np.asarray(ep.support_y[b]),
+                              dtype=torch.long)
+            ty = torch.tensor(np.asarray(ep.target_y[b]),
+                              dtype=torch.long)
+            fast = {key: tp[key] for key in fast_keys}
+            step_losses = []
+            for s in range(k_inner):
+                loss_s = F.cross_entropy(
+                    torch_forward({**tp, **fast}, sx, s, cfg=cfg,
+                                  running=run_b), sy)
+                flat = [v for pair in fast.values() for v in pair]
+                grads = torch.autograd.grad(loss_s, flat,
+                                            create_graph=second_order)
+                it = iter(grads)
+                fast = {key: tuple(fast[key][leaf]
+                                   - lslr[(key, leaf)][s] * next(it)
+                                   for leaf in (0, 1))
+                        for key in fast_keys}
+                if use_msl:
+                    step_losses.append(F.cross_entropy(
+                        torch_forward({**tp, **fast}, tx, s, cfg=cfg,
+                                      running=run_b), ty))
+            if use_msl:
+                task_loss = sum(float(msl_w[s]) * step_losses[s]
+                                for s in range(k_inner))
+            else:
+                task_loss = F.cross_entropy(
+                    torch_forward({**tp, **fast}, tx, k_inner - 1,
+                                  cfg=cfg, running=run_b), ty)
+            task_losses.append(task_loss)
+            for key, (m, v) in run_b.items():
+                new_running[key][0].add_(m / cfg.batch_size)
+                new_running[key][1].add_(v / cfg.batch_size)
+        loss = sum(task_losses) / cfg.batch_size
+        opt.zero_grad()
+        loss.backward()
+        for group in opt.param_groups:
+            group["lr"] = _traj_cosine_lr(cfg, t)
+        opt.step()
+        running = new_running
+        losses.append(float(loss.detach()))
+    return losses, tp, lslr, running
+
+
+@pytest.mark.parametrize("variant", ["first_order", "da_second_order"])
+def test_trajectory_parity(variant):
+    """50 outer steps of both systems on the same synthetic stream:
+    losses, the cosine LR actually applied, final params, final LSLR and
+    final BN running stats must all track. Catches optimizer-state or
+    schedule drift that every single-step test is blind to."""
+    cfg = TRAJ_CFG.replace(
+        second_order=(variant == "da_second_order"),
+        # DA flip after epoch 4 (reference: second order iff epoch > this)
+        first_order_to_second_order_epoch=4)
+    batches = _traj_batches(cfg, TRAJ_STEPS)
+
+    init, apply = make_model(cfg)
+    params0, bn0 = init(jax.random.PRNGKey(21))
+
+    from howtotrainyourmamlpytorch_tpu.meta.outer import (
+        init_train_state, make_train_step)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(21))
+    # init_train_state re-inits params from the same key: identical to
+    # params0 by construction; assert so the two systems share θ0.
+    np.testing.assert_array_equal(
+        np.asarray(state.params["conv0"]["w"]),
+        np.asarray(params0["conv0"]["w"]))
+    step_fn = jax.jit(make_train_step(cfg, apply),
+                      static_argnames=("second_order", "use_msl"))
+
+    losses_jax, lrs_jax = [], []
+    for t, ep in enumerate(batches):
+        epoch = t // cfg.total_iter_per_epoch
+        state, metrics = step_fn(
+            state, Episode(*(jnp.asarray(f) for f in ep)),
+            jnp.float32(epoch),
+            second_order=cfg.use_second_order(epoch),
+            use_msl=cfg.use_msl(epoch))
+        losses_jax.append(float(metrics.loss))
+        lrs_jax.append(float(metrics.learning_rate))
+
+    losses_t, tp, lslr_t, running_t = _torch_trajectory(
+        cfg, params0, bn0, batches)
+
+    # The LR schedule actually applied, step by step (pins the per-epoch
+    # cosine + the step->epoch mapping exactly).
+    np.testing.assert_allclose(
+        lrs_jax, [_traj_cosine_lr(cfg, t) for t in range(TRAJ_STEPS)],
+        rtol=1e-5, err_msg="cosine meta-LR schedule drift")
+    # Loss trajectories: f32 conv reassociation differences compound over
+    # 50 Adam steps (measured: agreement ~1e-5 at step 1 drifting to ~1%
+    # by step 50); the tolerance still catches any schedule/optimizer
+    # semantic drift (wrong epoch mapping, biased accumulation, momentum
+    # convention), which moves losses at the >10% scale within a few
+    # steps. The early window is additionally pinned tightly.
+    np.testing.assert_allclose(losses_jax[:10], losses_t[:10],
+                               rtol=1e-3, atol=1e-4,
+                               err_msg=f"early loss trajectory ({variant})")
+    np.testing.assert_allclose(losses_jax, losses_t, rtol=2e-2, atol=5e-3,
+                               err_msg=f"loss trajectory ({variant})")
+
+    # Final parameters (the whole point: where did 50 updates LAND).
+    for i in range(cfg.num_stages):
+        np.testing.assert_allclose(
+            np.asarray(state.params[f"conv{i}"]["w"]),
+            tp[f"conv{i}"][0].detach().numpy().transpose(2, 3, 1, 0),
+            rtol=5e-3, atol=5e-4, err_msg=f"final conv{i}.w ({variant})")
+        np.testing.assert_allclose(
+            np.asarray(state.params[f"norm{i}"]["gamma"]),
+            tp[f"norm{i}_gamma"].detach().numpy(),
+            rtol=5e-3, atol=5e-4, err_msg=f"final norm{i}.gamma")
+    np.testing.assert_allclose(
+        np.asarray(state.params["linear"]["w"]),
+        tp["linear"][0].detach().numpy().T,
+        rtol=5e-3, atol=5e-4, err_msg="final linear.w")
+    # Final LSLR learning rates (trained per-step inner LRs).
+    for key in ("conv0", "linear"):
+        np.testing.assert_allclose(
+            np.asarray(state.lslr[key]["w"]),
+            lslr_t[(key, 0)].detach().numpy(),
+            rtol=5e-3, atol=5e-4, err_msg=f"final LSLR[{key}.w]")
+    # Final BN running stats, threaded across all 50 iterations as the
+    # task-mean of per-task tracked rows.
+    #
+    # A structural caveat discovered BY this test: conv biases feed
+    # straight into batch-stat BN, which cancels them exactly (shift
+    # invariance), so their meta-gradient is analytically ZERO — both
+    # systems compute ~1e-9 f32 noise there, and Adam's normalizer
+    # amplifies that noise into full-size ±lr steps in backend-specific
+    # directions (~1.5e-3 bias gap after ONE step; true of the PyTorch
+    # reference on any two backends as well — conv biases are dead
+    # parameters under this architecture). Running VARs are
+    # shift-invariant and pin the whole threading convention tightly
+    # (update counts per row, momentum blend, unbiased var, task-mean);
+    # running MEANs track conv output INCLUDING the bias, so their
+    # cross-system gap is bounded by the accumulated bias gap — asserted
+    # with a tolerance scaled to the measured bias divergence.
+    for i in range(cfg.num_stages):
+        np.testing.assert_allclose(
+            np.asarray(state.bn_state[f"norm{i}"]["var"]),
+            running_t[f"norm{i}"][1].detach().numpy(),
+            rtol=5e-3, atol=5e-4, err_msg=f"final norm{i} running var")
+    bias_gap = max(
+        float(np.abs(np.asarray(state.params[f"conv{i}"]["b"])
+                     - tp[f"conv{i}"][1].detach().numpy()).max())
+        for i in range(cfg.num_stages))
+    for i in range(cfg.num_stages):
+        gap = np.abs(np.asarray(state.bn_state[f"norm{i}"]["mean"])
+                     - running_t[f"norm{i}"][0].detach().numpy()).max()
+        assert gap <= 2.0 * bias_gap + 1e-3, (
+            f"norm{i} running-mean gap {gap:.2e} exceeds the dead-bias "
+            f"drift bound (bias gap {bias_gap:.2e}) — structural "
+            f"threading drift, not f32 noise")
 
 
 def test_first_vs_second_order_differ(model):
