@@ -3,10 +3,13 @@
 Reference: ``data.py § MetaLearningSystemDataLoader`` — a torch DataLoader
 with ``num_dataset_workers`` processes and ``batch_size = meta-batch``.
 Here the sampler is cheap host numpy (no JPEG decode in the loop for the
-packaged episodic datasets), so a thread pool + a small prefetch queue
-suffices and avoids process-fork overhead; batches are placed on the mesh
-(task-sharded) while the previous step computes — the host→device overlap
-the reference gets from CUDA streams.
+packaged episodic datasets — profiled r4 at ~890 episodes/s for the
+flagship geometry, 20x the device's consumption rate), so a thread +
+small prefetch queue suffices and avoids process-fork overhead. The
+worker ALSO places each batch on the mesh (task-sharded device_put), so
+the host→device transfer — the dominant per-batch cost on a tunneled
+device — overlaps the previous step's compute, the same overlap the
+reference gets from CUDA streams + pinned-memory DataLoader workers.
 
 Episode-index contract (resume correctness, reference
 ``continue_from_iter``): train batch ``i`` uses episode indices
@@ -63,6 +66,16 @@ class MetaLearningDataLoader:
 
     # -- iteration --------------------------------------------------------
     def _place(self, batch: Episode) -> Episode:
+        """Host batch -> device-placed batch. Runs in the PREFETCH WORKER
+        (not the consumer): the host->device copy is the dominant
+        per-batch cost on a tunneled device (docs/PERF.md § Data-path,
+        ~10MB uint8 per flagship batch), and placing from the worker
+        overlaps it with the previous step's compute instead of
+        serializing transfer-then-dispatch on the consumer thread —
+        profiled r4 (docs/PERF.md § Host-feed bound): sampling is ~5% of
+        the step budget (~890 eps/s produced vs ~44 consumed), so the
+        serialization is the predicted driver of the r3 driven-run gap;
+        hardware confirmation pending per PERF.md."""
         if self.mesh is None or self._multihost:
             return batch  # multihost batches are assembled already sharded
         from howtotrainyourmamlpytorch_tpu.parallel.mesh import shard_batch
@@ -109,7 +122,7 @@ class MetaLearningDataLoader:
                     else:
                         batch = sampler.sample_batch(
                             range(base, base + batch_size))
-                    put_bounded(batch)
+                    put_bounded(self._place(batch))
             except Exception as e:  # surface in consumer, don't hang
                 put_bounded(e)
             put_bounded(_STOP)
@@ -123,7 +136,7 @@ class MetaLearningDataLoader:
                     break
                 if isinstance(item, Exception):
                     raise item
-                yield self._place(item)
+                yield item
         finally:
             # Consumer abandoned (error or early break): stop the worker
             # instead of letting it produce the rest of the epoch.
