@@ -34,10 +34,14 @@ _SOURCE_EXTS = (".py", ".cc", ".cpp", ".h", ".json", ".sh")
 _SKIP_DIRS = {"tests", ".git", "__pycache__", ".claude"}
 
 
-def find_files(root: str, exts=None) -> list:
+def find_files(root: str, exts=None, skip_dirs=frozenset()) -> list:
+    """``skip_dirs`` applies to the REPO walk only (our tests/ are not
+    candidate copies); the reference mount is walked in full — a
+    reference file under its tests/ dir is still a file to verify
+    against and a valid copy-check candidate."""
     out = []
     for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS]
+        dirnames[:] = [d for d in dirnames if d not in skip_dirs]
         for f in filenames:
             if exts is None or f.endswith(exts):
                 out.append(os.path.join(dirpath, f))
@@ -57,7 +61,10 @@ def _norm_lines(path: str) -> list:
 def copy_check(repo: str, ref: str) -> list:
     """Flag repo sources >SIMILARITY_FLAG similar to a same-named or
     similar-sized reference file. Returns [{repo_file, ref_file, ratio}]."""
-    ref_files = find_files(ref)
+    # Source files only on BOTH sides: a mount shipping its datasets
+    # (thousands of images/checkpoints) must not enter the candidate
+    # pool or the line cache.
+    ref_files = find_files(ref, _SOURCE_EXTS)
     ref_by_name = {}
     for p in ref_files:
         ref_by_name.setdefault(os.path.basename(p), []).append(p)
@@ -67,7 +74,7 @@ def copy_check(repo: str, ref: str) -> list:
                     # for many repo files under the size window
 
     flags = []
-    for rp in find_files(repo, _SOURCE_EXTS):
+    for rp in find_files(repo, _SOURCE_EXTS, skip_dirs=_SKIP_DIRS):
         size = os.path.getsize(rp)
         cands = set(ref_by_name.get(os.path.basename(rp), []))
         for p, s in ref_sizes:

@@ -1,0 +1,111 @@
+"""task_microbatches sweep over the shipped mb=1 configs (VERDICT r3
+item 4): the lever measured +34-39% on the two configs it was applied to
+(docs/PERF.md § Microbatching); this script asks the same question at
+fixed per-chip batch for every config family member that still runs mb=1.
+
+For each target config: build the steady-state executable (bench.py's
+single build path) at each divisor of the per-chip batch and measure
+with the shared 3-window-median methodology. One JSON line per point;
+a final line per config names the winner and the shipped value so the
+ship-only-with-a-measurement rule has its numbers.
+
+Usage: python scripts/perf_microbatch_sweep.py [--steps N]
+           [--configs a.json b.json ...] [--max-mb M]
+Run on a QUIET box (any concurrent compile contaminates the timings —
+docs/PERF.md § methodology).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from bench import (build_steady_state, load_workload, measure_rate,  # noqa: E402
+                   wait_for_backend)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The family members still at task_microbatches=1 (docs/PERF.md § "Not
+# yet swept") — the four Omniglot MAML++ configs, both mini-ImageNet
+# 1-shot configs, and the canonical plain-MAML point.
+DEFAULT_TARGETS = [
+    "omniglot_maml++_5-way_1-shot.json",
+    "omniglot_maml++_5-way_5-shot.json",
+    "omniglot_maml++_20-way_1-shot.json",
+    "omniglot_maml++_20-way_5-shot.json",
+    "mini-imagenet_maml++_5-way_1-shot.json",
+    "mini-imagenet_maml_5-way_1-shot.json",
+    "mini-imagenet_maml_5-way_1-shot_canonical.json",
+]
+
+
+def divisors(n: int, cap: int) -> list:
+    return [d for d in range(1, min(n, cap) + 1) if n % d == 0]
+
+
+def sweep_config(name: str, steps: int, max_mb: int) -> dict:
+    path = os.path.join(REPO, "experiment_config", name)
+    devices = jax.devices()
+    n_dev = len(devices)
+    base = load_workload(path, 0, n_dev)
+    per_chip = max(base.batch_size // n_dev, 1)
+    shipped_mb = base.task_microbatches
+    rows = {}
+    for mb in divisors(per_chip, max_mb):
+        cfg = base.replace(task_microbatches=mb)
+        try:
+            wl = build_steady_state(cfg, devices)
+            rate = measure_rate(wl.compiled, wl.state, wl.batch_ep,
+                                wl.epoch, batch_size=cfg.batch_size,
+                                n_dev=n_dev, steps=steps)
+            rows[mb] = round(rate, 2)
+            print(json.dumps({"config": name, "mb": mb,
+                              "tasks_per_sec_per_chip": rows[mb]}),
+                  flush=True)
+        except Exception:
+            print(json.dumps({"config": name, "mb": mb,
+                              "error": traceback.format_exc(limit=1)}),
+                  flush=True)
+    verdict = {"config": name, "per_chip_batch": per_chip,
+               "shipped_mb": shipped_mb, "rows": rows}
+    if rows:
+        best_mb = max(rows, key=rows.get)
+        verdict.update(
+            best_mb=best_mb, best_rate=rows[best_mb],
+            shipped_rate=rows.get(shipped_mb),
+            gain_vs_shipped=(round(rows[best_mb] / rows[shipped_mb], 3)
+                             if rows.get(shipped_mb) else None))
+    print(json.dumps(verdict), flush=True)
+    return verdict
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--configs", nargs="*", default=DEFAULT_TARGETS)
+    ap.add_argument("--max-mb", type=int, default=16)
+    ap.add_argument("--backend-timeout", type=float, default=600.0)
+    args = ap.parse_args()
+    platform = os.environ.get("MAML_JAX_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    if args.backend_timeout > 0:
+        wait_for_backend(timeout_s=args.backend_timeout)
+    verdicts = [sweep_config(c, args.steps, args.max_mb)
+                for c in args.configs]
+    print(json.dumps({"summary": {v["config"]: v.get("best_mb")
+                                  for v in verdicts}}), flush=True)
+    # A sweep where EVERY point errored (backend half-up) must not read
+    # as a successful capture to the session driver.
+    return 0 if any(v["rows"] for v in verdicts) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+#!/bin/bash
+# Round-4 hardware session: runs the full VERDICT r3 measurement agenda
+# in priority order (most driver-critical first, so a tunnel drop
+# mid-session still leaves the most important evidence captured).
+# Usage: bash scripts/r4_tpu_session.sh [logdir]   (default /tmp/r4_session)
+# Keep the box QUIET while this runs — concurrent compiles contaminate
+# every timing (docs/PERF.md § methodology; memory: 1 CPU core).
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-/tmp/r4_session}"
+mkdir -p "$LOG"
+stamp() { date -u +%H:%M:%S; }
+run() { # run <name> <timeout-s> <cmd...>
+  local name="$1" to="$2"; shift 2
+  echo "[$(stamp)] >>> $name"
+  timeout "$to" "$@" > "$LOG/$name.log" 2> "$LOG/$name.err"
+  local rc=$?
+  echo "[$(stamp)] <<< $name rc=$rc"
+  tail -2 "$LOG/$name.log"
+  return $rc
+}
+
+# 1. THE driver artifact: headline + run-weighted + strict-b8 in one
+#    JSON object (VERDICT item 1/6). bench.py retries backend init
+#    itself for up to 10 min.
+run bench_full 3600 python bench.py
+
+# 2. Microbatch sweep over the seven mb=1 configs (item 4).
+run mb_sweep 7200 python scripts/perf_microbatch_sweep.py
+
+# 3. Speed-of-light recalibration at the SHIPPED mb=12 executable
+#    (item 3): the ceiling model reads the shipped config by default;
+#    --cal replays the recorded best-observed envelope (sustained
+#    calibration chains understate the time-sliced tunnel's capability
+#    — docs/PERF.md § "MFU, corrected by measurement").
+run ceiling_cal 3600 python scripts/perf_ceiling.py --cal 3.03,791.5,455.8
+
+# 4. Eval-path throughput at the new operating point (item 7).
+run perf_eval 3600 python scripts/perf_eval.py
+
+# 5. Host-feed validation (item 5 done-criterion): a short flagship
+#    driven run; compare its synced tasks/s against bench_full's
+#    headline — target within ~1.5x after the r4 loader overlap fix.
+run driven_flagship 5400 python train_maml_system.py \
+  --name_of_args_json_file experiment_config/mini-imagenet_maml++_5-way_5-shot_DA_b12.json \
+  --experiment_name r4_feed_check --dataset_name synthetic_mini_imagenet \
+  --total_epochs 2 --total_iter_per_epoch 60 --num_evaluation_tasks 48 \
+  --experiment_root /tmp/r4_feed_check
+
+echo "[$(stamp)] session complete; logs in $LOG"
