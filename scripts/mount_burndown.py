@@ -31,14 +31,17 @@ import sys
 SIMILARITY_FLAG = 0.60       # driver detector's documented threshold
 SIZE_RATIO_WINDOW = (0.5, 2.0)  # "similar-sized" candidate window
 _SOURCE_EXTS = (".py", ".cc", ".cpp", ".h", ".json", ".sh")
+# Repo walk: our tests/ are not candidate copies. Reference walk: its
+# tests/ ARE files to verify against, but VCS/cache junk still is not
+# (an rsynced clone's .git objects must not flip the emptiness check).
 _SKIP_DIRS = {"tests", ".git", "__pycache__", ".claude"}
+_REF_SKIP_DIRS = {".git", "__pycache__", ".claude"}
 
 
 def find_files(root: str, exts=None, skip_dirs=frozenset()) -> list:
-    """``skip_dirs`` applies to the REPO walk only (our tests/ are not
-    candidate copies); the reference mount is walked in full — a
-    reference file under its tests/ dir is still a file to verify
-    against and a valid copy-check candidate."""
+    """Walk ``root`` for files; callers pass ``_SKIP_DIRS`` for the repo
+    (our tests/ are not candidate copies) and ``_REF_SKIP_DIRS`` for the
+    mount (its tests/ count, VCS/cache junk never does)."""
     out = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d not in skip_dirs]
@@ -64,7 +67,8 @@ def copy_check(repo: str, ref: str) -> list:
     # Source files only on BOTH sides: a mount shipping its datasets
     # (thousands of images/checkpoints) must not enter the candidate
     # pool or the line cache.
-    ref_files = find_files(ref, _SOURCE_EXTS)
+    ref_files = find_files(ref, _SOURCE_EXTS,
+                           skip_dirs=_REF_SKIP_DIRS)
     ref_by_name = {}
     for p in ref_files:
         ref_by_name.setdefault(os.path.basename(p), []).append(p)
@@ -151,7 +155,8 @@ def rank_items(items: list, ref: str) -> list:
     """Attach mount availability to each open item and rank: items whose
     reference files are ALL present first, then partially present, then
     blocked (none present); resolved items dropped."""
-    present = {os.path.basename(p) for p in find_files(ref)}
+    present = {os.path.basename(p)
+               for p in find_files(ref, skip_dirs=_REF_SKIP_DIRS)}
     ranked = []
     for it in items:
         if it["resolved"]:
@@ -177,7 +182,7 @@ def main() -> int:
                     help="machine-readable output")
     args = ap.parse_args()
 
-    ref_files = (find_files(args.ref)
+    ref_files = (find_files(args.ref, skip_dirs=_REF_SKIP_DIRS)
                  if os.path.isdir(args.ref) else [])
     if not ref_files:
         msg = {"mount": args.ref, "files": 0,

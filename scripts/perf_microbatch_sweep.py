@@ -27,8 +27,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
-from bench import (build_steady_state, load_workload, measure_rate,  # noqa: E402
-                   wait_for_backend)
+from bench import (build_steady_state, init_devices_with_watchdog,  # noqa: E402
+                   load_workload, measure_rate, wait_for_backend)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -50,9 +50,8 @@ def divisors(n: int, cap: int) -> list:
     return [d for d in range(1, min(n, cap) + 1) if n % d == 0]
 
 
-def sweep_config(name: str, steps: int, max_mb: int) -> dict:
+def sweep_config(name: str, steps: int, max_mb: int, devices) -> dict:
     path = os.path.join(REPO, "experiment_config", name)
-    devices = jax.devices()
     n_dev = len(devices)
     base = load_workload(path, 0, n_dev)
     per_chip = max(base.batch_size // n_dev, 1)
@@ -98,8 +97,21 @@ def main() -> int:
         jax.config.update("jax_platforms", platform)
     if args.backend_timeout > 0:
         wait_for_backend(timeout_s=args.backend_timeout)
-    verdicts = [sweep_config(c, args.steps, args.max_mb)
-                for c in args.configs]
+        devices = init_devices_with_watchdog()  # bounded, like bench.py
+    else:
+        devices = jax.devices()
+    verdicts = []
+    for c in args.configs:
+        try:
+            verdicts.append(sweep_config(c, args.steps, args.max_mb,
+                                         devices))
+        except Exception:  # one bad config must not lose the rest of a
+            # possibly hours-long sweep; the error verdict keeps the
+            # one-JSON-line-per-point crash-resilient record complete.
+            print(json.dumps({"config": c, "rows": {},
+                              "error": traceback.format_exc(limit=1)}),
+                  flush=True)
+            verdicts.append({"config": c, "rows": {}})
     print(json.dumps({"summary": {v["config"]: v.get("best_mb")
                                   for v in verdicts}}), flush=True)
     # A sweep where EVERY point errored (backend half-up) must not read

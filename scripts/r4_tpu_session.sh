@@ -19,6 +19,14 @@ run() { # run <name> <timeout-s> <cmd...>
   tail -2 "$LOG/$name.log"
   return $rc
 }
+# perf_ceiling/perf_eval/the trainer have no built-in backend retry
+# (bench.py and the sweep do); gate those legs on a bounded wait so a
+# transient outage between legs can't silently zero them.
+waitb() {
+  timeout 700 python -c \
+    "from bench import wait_for_backend; wait_for_backend(600)" \
+    >> "$LOG/backend_wait.log" 2>&1 || echo "[$(stamp)] backend wait failed"
+}
 
 # 1. THE driver artifact: headline + run-weighted + strict-b8 in one
 #    JSON object (VERDICT item 1/6). bench.py retries backend init
@@ -33,14 +41,17 @@ run mb_sweep 7200 python scripts/perf_microbatch_sweep.py
 #    --cal replays the recorded best-observed envelope (sustained
 #    calibration chains understate the time-sliced tunnel's capability
 #    — docs/PERF.md § "MFU, corrected by measurement").
+waitb
 run ceiling_cal 3600 python scripts/perf_ceiling.py --cal 3.03,791.5,455.8
 
 # 4. Eval-path throughput at the new operating point (item 7).
+waitb
 run perf_eval 3600 python scripts/perf_eval.py
 
 # 5. Host-feed validation (item 5 done-criterion): a short flagship
 #    driven run; compare its synced tasks/s against bench_full's
 #    headline — target within ~1.5x after the r4 loader overlap fix.
+waitb
 run driven_flagship 5400 python train_maml_system.py \
   --name_of_args_json_file experiment_config/mini-imagenet_maml++_5-way_5-shot_DA_b12.json \
   --experiment_name r4_feed_check --dataset_name synthetic_mini_imagenet \
