@@ -138,6 +138,21 @@ def wait_for_backend(timeout_s: float = 600.0, interval_s: float = 20.0,
         time.sleep(sleep_s)
 
 
+def maybe_enable_compilation_cache() -> None:
+    """Opt-in persistent XLA compilation cache for the bench/perf tools
+    (``MAML_COMPILATION_CACHE=<dir>``): a hardware session re-compiling
+    the flagship and the sweep's dozens of executables spends most of
+    its wall-clock in compiles a previous session already did. Same
+    mechanism the trainer exposes via ``compilation_cache_dir``
+    (train_maml_system.py); caches only affect compile time, never the
+    timed steady-state rate."""
+    cache = os.environ.get("MAML_COMPILATION_CACHE")
+    if cache:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+
 def init_devices_with_watchdog(timeout_s: float = 300.0):
     """First in-process backend init, bounded: if the tunnel wedges in
     the gap after wait_for_backend's probe child succeeded, a bare
@@ -160,6 +175,22 @@ def init_devices_with_watchdog(timeout_s: float = 300.0):
     devices = jax.devices()
     done.set()
     return devices
+
+
+def init_backend(backend_timeout: float = 600.0):
+    """THE backend preamble, shared by bench.py and every perf script:
+    MAML_JAX_PLATFORM pin (the config update bypasses the axon
+    sitecustomize where the env var alone does not), opt-in compile
+    cache, bounded outage retry, watchdogged in-process init. One place
+    to fix hang protection for every measurement tool."""
+    platform = os.environ.get("MAML_JAX_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    maybe_enable_compilation_cache()
+    if backend_timeout > 0:
+        wait_for_backend(timeout_s=backend_timeout)
+        return init_devices_with_watchdog()
+    return jax.devices()
 
 
 def _peak_flops(device) -> float:
@@ -349,17 +380,7 @@ def main() -> int:
                          "0 = no retry, fail on first init error)")
     args = ap.parse_args()
 
-    # Platform pin (same contract as train_maml_system.py): the config
-    # update bypasses the axon sitecustomize where the env var alone
-    # does not.
-    platform = os.environ.get("MAML_JAX_PLATFORM")
-    if platform:
-        jax.config.update("jax_platforms", platform)
-    if args.backend_timeout > 0:
-        wait_for_backend(timeout_s=args.backend_timeout)
-        devices = init_devices_with_watchdog()
-    else:
-        devices = jax.devices()
+    devices = init_backend(args.backend_timeout)
     n_dev = len(devices)
     # No --config: bench the shipped flagship operating point (see module
     # docstring) so the headline number IS a shipped-config number.

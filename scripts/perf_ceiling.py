@@ -523,7 +523,7 @@ def main() -> int:
                          "ceiling)")
     args = ap.parse_args()
 
-    devices = jax.devices()
+    devices = bench.init_backend()
     n_dev = len(devices)
     config_path = args.config or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),

@@ -67,6 +67,9 @@ def main() -> int:
     ap.add_argument("--episodes", type=int, default=600)
     args = ap.parse_args()
 
+    import bench
+    bench.init_backend()  # outage retry + watchdog + compile cache
+
     cfg = MAMLConfig.from_json_file(os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "experiment_config", "mini-imagenet_maml++_5-way_5-shot_DA_b12.json"))

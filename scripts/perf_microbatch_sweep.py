@@ -25,10 +25,8 @@ import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
-
-from bench import (build_steady_state, init_devices_with_watchdog,  # noqa: E402
-                   load_workload, measure_rate, wait_for_backend)
+from bench import (build_steady_state, init_backend, load_workload,  # noqa: E402
+                   measure_rate)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -92,14 +90,7 @@ def main() -> int:
     ap.add_argument("--max-mb", type=int, default=16)
     ap.add_argument("--backend-timeout", type=float, default=600.0)
     args = ap.parse_args()
-    platform = os.environ.get("MAML_JAX_PLATFORM")
-    if platform:
-        jax.config.update("jax_platforms", platform)
-    if args.backend_timeout > 0:
-        wait_for_backend(timeout_s=args.backend_timeout)
-        devices = init_devices_with_watchdog()  # bounded, like bench.py
-    else:
-        devices = jax.devices()
+    devices = init_backend(args.backend_timeout)
     verdicts = []
     for c in args.configs:
         try:

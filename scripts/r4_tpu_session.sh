@@ -9,6 +9,10 @@ set -u
 cd "$(dirname "$0")/.."
 LOG="${1:-/tmp/r4_session}"
 mkdir -p "$LOG"
+# Persistent XLA compile cache: a session interrupted by a tunnel drop
+# resumes without re-paying the multi-minute flagship compiles.
+export MAML_COMPILATION_CACHE="${MAML_COMPILATION_CACHE:-/tmp/r4_xla_cache}"
+mkdir -p "$MAML_COMPILATION_CACHE"
 stamp() { date -u +%H:%M:%S; }
 run() { # run <name> <timeout-s> <cmd...>
   local name="$1" to="$2"; shift 2
@@ -19,13 +23,16 @@ run() { # run <name> <timeout-s> <cmd...>
   tail -2 "$LOG/$name.log"
   return $rc
 }
-# perf_ceiling/perf_eval/the trainer have no built-in backend retry
-# (bench.py and the sweep do); gate those legs on a bounded wait so a
-# transient outage between legs can't silently zero them.
+# bench.py, the sweep, perf_ceiling and perf_eval all run their own
+# bench.init_backend (outage retry + watchdog + cache); only the trainer
+# leg lacks one — gate it on this bounded wait via `waitb && run ...`.
 waitb() {
   timeout 700 python -c \
     "from bench import wait_for_backend; wait_for_backend(600)" \
-    >> "$LOG/backend_wait.log" 2>&1 || echo "[$(stamp)] backend wait failed"
+    >> "$LOG/backend_wait.log" 2>&1
+  local rc=$?
+  [ $rc -ne 0 ] && echo "[$(stamp)] backend wait FAILED (leg skipped)"
+  return $rc
 }
 
 # 1. THE driver artifact: headline + run-weighted + strict-b8 in one
@@ -41,21 +48,22 @@ run mb_sweep 7200 python scripts/perf_microbatch_sweep.py
 #    --cal replays the recorded best-observed envelope (sustained
 #    calibration chains understate the time-sliced tunnel's capability
 #    — docs/PERF.md § "MFU, corrected by measurement").
-waitb
 run ceiling_cal 3600 python scripts/perf_ceiling.py --cal 3.03,791.5,455.8
 
 # 4. Eval-path throughput at the new operating point (item 7).
-waitb
 run perf_eval 3600 python scripts/perf_eval.py
 
 # 5. Host-feed validation (item 5 done-criterion): a short flagship
 #    driven run; compare its synced tasks/s against bench_full's
 #    headline — target within ~1.5x after the r4 loader overlap fix.
-waitb
-run driven_flagship 5400 python train_maml_system.py \
+#    The trainer has no built-in backend retry, so gate this leg on a
+#    bounded wait (&&: a dead tunnel skips the leg instead of hanging
+#    it until the 5400s timeout).
+waitb && run driven_flagship 5400 python train_maml_system.py \
   --name_of_args_json_file experiment_config/mini-imagenet_maml++_5-way_5-shot_DA_b12.json \
   --experiment_name r4_feed_check --dataset_name synthetic_mini_imagenet \
   --total_epochs 2 --total_iter_per_epoch 60 --num_evaluation_tasks 48 \
-  --experiment_root /tmp/r4_feed_check
+  --experiment_root /tmp/r4_feed_check \
+  --compilation_cache_dir "$MAML_COMPILATION_CACHE"
 
 echo "[$(stamp)] session complete; logs in $LOG"
