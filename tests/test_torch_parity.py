@@ -411,6 +411,14 @@ def _torch_trajectory(cfg, params0, bn0, batches):
         loss = sum(task_losses) / cfg.batch_size
         opt.zero_grad()
         loss.backward()
+        if cfg.clamp_meta_grad_value is not None:
+            # Reference scope: classifier parameter grads only — LSLR
+            # learning-rate grads are NOT clamped (meta/outer.py).
+            c = cfg.clamp_meta_grad_value
+            for key, val in tp.items():
+                for leaf in (val if isinstance(val, tuple) else (val,)):
+                    if leaf.grad is not None:
+                        leaf.grad.clamp_(-c, c)
         for group in opt.param_groups:
             group["lr"] = _traj_cosine_lr(cfg, t)
         opt.step()
@@ -419,16 +427,22 @@ def _torch_trajectory(cfg, params0, bn0, batches):
     return losses, tp, lslr, running
 
 
-@pytest.mark.parametrize("variant", ["first_order", "da_second_order"])
+@pytest.mark.parametrize(
+    "variant", ["first_order", "da_second_order", "clamped"])
 def test_trajectory_parity(variant):
     """50 outer steps of both systems on the same synthetic stream:
     losses, the cosine LR actually applied, final params, final LSLR and
     final BN running stats must all track. Catches optimizer-state or
-    schedule drift that every single-step test is blind to."""
+    schedule drift that every single-step test is blind to. The
+    'clamped' variant runs a BINDING per-parameter grad clamp (the
+    *ImageNet ±10 feature at a tiny value so it actually bites),
+    pinning its scope (params yes, LSLR no) and its ordering (before
+    Adam) against the oracle."""
     cfg = TRAJ_CFG.replace(
         second_order=(variant == "da_second_order"),
         # DA flip after epoch 4 (reference: second order iff epoch > this)
-        first_order_to_second_order_epoch=4)
+        first_order_to_second_order_epoch=4,
+        clamp_meta_grad_value=(0.01 if variant == "clamped" else None))
     batches = _traj_batches(cfg, TRAJ_STEPS)
 
     init, apply = make_model(cfg)
