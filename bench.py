@@ -388,18 +388,24 @@ def main() -> int:
     config_path = args.config or os.path.join(
         repo, "experiment_config",
         "mini-imagenet_maml++_5-way_5-shot_DA_b12.json")
-    cfg = load_workload(config_path, args.batch, n_dev)
-    if args.quick:
+    def quick_shrink(c: MAMLConfig) -> MAMLConfig:
+        """Tiny shapes for CI/CPU sanity — applied identically to the
+        headline and (in quick mode) the strict-b8 leg, so --quick
+        smoke-executes EVERY code path a real capture runs."""
         quick_batch = max(2 * n_dev, 2)
-        cfg = cfg.replace(
+        return c.replace(
             image_height=16, image_width=16,
             cnn_num_filters=8, num_stages=2,
             batch_size=quick_batch,
-            # The shipped pod config runs task_microbatches=8, which
-            # cannot divide the shrunken quick batch — clamp to keep
-            # the accumulation scan legal at tiny scale.
-            task_microbatches=min(cfg.task_microbatches,
-                                  quick_batch // n_dev))
+            # gcd (same pattern as load_workload): the shipped configs'
+            # task_microbatches need not divide the shrunken quick
+            # batch; the gcd is unconditionally legal geometry.
+            task_microbatches=math.gcd(quick_batch // n_dev,
+                                       c.task_microbatches))
+
+    cfg = load_workload(config_path, args.batch, n_dev)
+    if args.quick:
+        cfg = quick_shrink(cfg)
         args.steps = min(args.steps, 3)
 
     # One build path (build_steady_state) for every number this tool
@@ -457,7 +463,9 @@ def main() -> int:
     # is the epoch-weighted harmonic mean (equal tasks per epoch).
     # Fail-soft: the headline line must survive any hiccup here.
     bench_epoch = wl.bench_epoch
-    if is_flagship and not args.no_run_weighted and not args.quick:
+    # --quick runs this leg too (tiny shapes, minimal steps): every
+    # capture path executes in CI or it breaks on capture day.
+    if is_flagship and not args.no_run_weighted:
         try:
             keys = {}
             for e in range(cfg.total_epochs):
@@ -482,7 +490,8 @@ def main() -> int:
                     st, batch_ep, rep).compile()
                 rate = measure_rate(other, st, batch_ep, rep,
                                     batch_size=cfg.batch_size,
-                                    n_dev=n_dev, steps=9)
+                                    n_dev=n_dev,
+                                    steps=min(9, args.steps))
                 inv_sum += n_epochs / rate
             rw = cfg.total_epochs / inv_sum
             out["run_weighted_tasks_per_sec_per_chip"] = round(rw, 3)
@@ -505,17 +514,21 @@ def main() -> int:
     # equivalence `python bench.py == python bench.py --config
     # ..._DA_b12.json` holds key-for-key; skipped when the benched
     # workload IS the strict-b8 config (it would re-measure itself).
-    if (is_flagship and not args.quick and not args.no_strict_b8
+    # --quick still runs this leg (tiny shapes): a capture path that CI
+    # never executes is a capture path that breaks on capture day.
+    if (is_flagship and not args.no_strict_b8
             and cfg.experiment_name != "mini-imagenet_maml++_5-way_5-shot_DA"):
         try:
             b8_cfg = load_workload(
                 os.path.join(repo, "experiment_config",
                              "mini-imagenet_maml++_5-way_5-shot_DA.json"),
                 0, n_dev)
+            if args.quick:
+                b8_cfg = quick_shrink(b8_cfg)
             wl8 = build_steady_state(b8_cfg, devices)
             b8 = measure_rate(wl8.compiled, wl8.state, wl8.batch_ep,
                               wl8.epoch, batch_size=b8_cfg.batch_size,
-                              n_dev=n_dev, steps=9)
+                              n_dev=n_dev, steps=min(9, args.steps))
             out["strict_b8_tasks_per_sec_per_chip"] = round(b8, 3)
             out["vs_baseline_strict_b8"] = round(
                 b8 / BASELINE_TASKS_PER_SEC, 3)
