@@ -24,9 +24,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Iterator, Optional
-
-import numpy as np
+from typing import Iterator
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.data.sampler import EpisodeSampler
