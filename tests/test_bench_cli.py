@@ -1,0 +1,46 @@
+"""bench.py end-to-end CLI contract (slow profile).
+
+The driver's round artifact is `python bench.py`'s LAST stdout JSON
+line; VERDICT r3 item 6 requires it to carry the headline, run-weighted
+and strict-b8 numbers in ONE object. --quick executes every leg of that
+capture path at tiny shapes, so this test pins the whole contract
+mechanically — argparse wiring, backend preamble, all three legs, the
+strict-superset line discipline — the way capture day exercises it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_quick_emits_full_capture_contract():
+    env = dict(os.environ, MAML_JAX_PLATFORM="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--quick",
+         "--steps", "3"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert lines, r.stdout
+    first, last = json.loads(lines[0]), json.loads(lines[-1])
+    # Headline keys, printed immediately (fail-soft discipline).
+    for key in ("metric", "value", "unit", "vs_baseline", "workload"):
+        assert key in first, key
+    assert first["metric"] == "meta_tasks_per_sec_per_chip"
+    assert first["value"] > 0
+    # The authoritative LAST line is a strict superset with all three
+    # measurement groups.
+    for key in ("value", "run_weighted_tasks_per_sec_per_chip",
+                "vs_baseline_run_weighted",
+                "strict_b8_tasks_per_sec_per_chip",
+                "vs_baseline_strict_b8"):
+        assert key in last, (key, last)
+    assert last["strict_b8_tasks_per_sec_per_chip"] > 0
+    for key, val in first.items():
+        assert last.get(key) == val, f"superset violated at {key}"
