@@ -1,0 +1,127 @@
+"""Backend bring-up resilience: bounded outage retry + hang watchdog.
+
+On a tunneled/remote accelerator (this environment's 'axon' TPU), backend
+init has two documented failure modes a long-lived job must survive:
+
+* transient outages — ``jax.devices()`` raises UNAVAILABLE, and
+  jax.xla_bridge CACHES the failed init, so the same process can never
+  recover by retrying in-process. The only safe probe is a killable
+  subprocess (:func:`wait_for_backend`).
+* wedges — ``jax.devices()`` blocks FOREVER in an uninterruptible PJRT
+  C call. A daemon watchdog (:func:`init_devices_with_watchdog`) turns
+  that into a bounded, explained exit instead of an infinite stall.
+
+Shared by ``bench.py``, every ``scripts/perf_*`` harness, and the
+trainer CLI (``MAML_BACKEND_TIMEOUT``). The reference has no equivalent
+because a local CUDA device either exists or does not; a tunneled
+device fails in richer ways.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+
+
+def wait_for_backend(timeout_s: float = 600.0, interval_s: float = 20.0,
+                     probe_timeout_s: float = 150.0) -> None:
+    """Block until the JAX backend can initialize, or raise after
+    ``timeout_s``. Probes in a SUBPROCESS (inheriting this process's
+    env, so it initializes the same backend) — a failed in-process init
+    is cached by jax.xla_bridge and would keep re-raising even after
+    the tunnel recovers, and a wedged tunnel hangs ``jax.devices()``,
+    which only a killable child escapes."""
+    code = ("import os, jax\n"
+            "p = os.environ.get('MAML_JAX_PLATFORM')\n"
+            "if p: jax.config.update('jax_platforms', p)\n"
+            "jax.devices()\n")
+    deadline = time.monotonic() + timeout_s
+    attempt = 0
+    while True:
+        attempt += 1
+        # Clamp each probe (and each sleep, below) to the remaining
+        # budget so the call returns within ~timeout_s even when the
+        # first probe would hang for the full probe timeout.
+        budget = max(deadline - time.monotonic(), 1.0)
+        try:
+            r = subprocess.run([sys.executable, "-c", code],
+                               timeout=min(probe_timeout_s, budget),
+                               capture_output=True, text=True)
+            if r.returncode == 0:
+                if attempt > 1:
+                    print(f"[backend] up after {attempt} probes",
+                          file=sys.stderr, flush=True)
+                return
+            err = (r.stderr or r.stdout).strip().splitlines()
+            err = err[-1] if err else f"rc={r.returncode}"
+        except subprocess.TimeoutExpired:
+            err = "probe hung (wedged tunnel?)"
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RuntimeError(
+                f"JAX backend unavailable after {timeout_s:.0f}s "
+                f"({attempt} probes); last error: {err}")
+        sleep_s = min(interval_s, remaining)
+        print(f"[backend] probe {attempt} failed: {err[:160]} — "
+              f"retrying in {sleep_s:.0f}s ({remaining:.0f}s left)",
+              file=sys.stderr, flush=True)
+        time.sleep(sleep_s)
+
+
+def init_devices_with_watchdog(timeout_s: float = 300.0):
+    """First in-process backend init, bounded: if the tunnel wedges in
+    the gap after :func:`wait_for_backend`'s probe child succeeded, a
+    bare ``jax.devices()`` would hang this process forever (a blocked
+    PJRT C call cannot be interrupted in-process, and a failed init is
+    cached so no in-process retry is possible either). A daemon
+    watchdog turns that into a bounded, explained exit."""
+    done = threading.Event()
+
+    def watchdog():
+        if not done.wait(timeout_s):
+            print(json.dumps({"error": f"in-process backend init hung "
+                                       f">{timeout_s:.0f}s after a "
+                                       f"successful probe (tunnel wedged "
+                                       f"mid-gap)"}), flush=True)
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    devices = jax.devices()
+    done.set()
+    return devices
+
+
+def maybe_enable_compilation_cache() -> None:
+    """Opt-in persistent XLA compilation cache
+    (``MAML_COMPILATION_CACHE=<dir>``): a measurement session or a
+    restarted run re-compiling dozens of executables spends most of its
+    wall-clock in compiles a previous session already did. Same
+    mechanism the trainer exposes via ``compilation_cache_dir``; caches
+    only affect compile time, never timed steady-state rates."""
+    cache = os.environ.get("MAML_COMPILATION_CACHE")
+    if cache:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+
+def init_backend(backend_timeout: float = 600.0):
+    """THE backend preamble: MAML_JAX_PLATFORM pin (the config update
+    bypasses sitecustomize platform pinning where the env var alone does
+    not), opt-in compile cache, bounded outage retry, watchdogged
+    in-process init. One place to fix hang protection for every entry
+    point."""
+    platform = os.environ.get("MAML_JAX_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    maybe_enable_compilation_cache()
+    if backend_timeout > 0:
+        wait_for_backend(timeout_s=backend_timeout)
+        return init_devices_with_watchdog()
+    return jax.devices()

@@ -28,7 +28,7 @@ run() { # run <name> <timeout-s> <cmd...>
 # leg lacks one — gate it on this bounded wait via `waitb && run ...`.
 waitb() {
   timeout 700 python -c \
-    "from bench import wait_for_backend; wait_for_backend(600)" \
+    "from howtotrainyourmamlpytorch_tpu.utils.backend import wait_for_backend; wait_for_backend(600)" \
     >> "$LOG/backend_wait.log" 2>&1
   local rc=$?
   [ $rc -ne 0 ] && echo "[$(stamp)] backend wait FAILED (leg skipped)"
@@ -56,9 +56,10 @@ run perf_eval 3600 python scripts/perf_eval.py
 # 5. Host-feed validation (item 5 done-criterion): a short flagship
 #    driven run; compare its synced tasks/s against bench_full's
 #    headline — target within ~1.5x after the r4 loader overlap fix.
-#    The trainer has no built-in backend retry, so gate this leg on a
-#    bounded wait (&&: a dead tunnel skips the leg instead of hanging
-#    it until the 5400s timeout).
+#    MAML_BACKEND_TIMEOUT gives the trainer the shared bounded retry;
+#    the waitb && gate additionally skips the leg outright on a tunnel
+#    that stays dead past the wait budget.
+export MAML_BACKEND_TIMEOUT=600
 waitb && run driven_flagship 5400 python train_maml_system.py \
   --name_of_args_json_file experiment_config/mini-imagenet_maml++_5-way_5-shot_DA_b12.json \
   --experiment_name r4_feed_check --dataset_name synthetic_mini_imagenet \

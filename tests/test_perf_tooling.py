@@ -20,6 +20,7 @@ sys.path.insert(0, os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
 
 import bench  # noqa: E402
+from howtotrainyourmamlpytorch_tpu.utils import backend  # noqa: E402
 from flagship_report import phase_key  # noqa: E402
 
 
@@ -74,10 +75,10 @@ class _FakeCompleted:
 
 def test_wait_for_backend_returns_on_first_success(monkeypatch):
     runs = []
-    monkeypatch.setattr(bench.subprocess, "run",
+    monkeypatch.setattr(backend.subprocess, "run",
                         lambda *a, **k: (runs.append(a),
                                          _FakeCompleted(0))[1])
-    monkeypatch.setattr(bench.time, "sleep",
+    monkeypatch.setattr(backend.time, "sleep",
                         lambda s: pytest.fail("slept on healthy backend"))
     bench.wait_for_backend(timeout_s=600)
     assert len(runs) == 1
@@ -88,9 +89,9 @@ def test_wait_for_backend_retries_then_succeeds(monkeypatch):
                      _FakeCompleted(1, "UNAVAILABLE: axon"),
                      _FakeCompleted(0)])
     sleeps = []
-    monkeypatch.setattr(bench.subprocess, "run",
+    monkeypatch.setattr(backend.subprocess, "run",
                         lambda *a, **k: next(outcomes))
-    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+    monkeypatch.setattr(backend.time, "sleep", sleeps.append)
     bench.wait_for_backend(timeout_s=600, interval_s=7)
     assert sleeps == [7, 7]
 
@@ -99,10 +100,10 @@ def test_wait_for_backend_gives_up_after_deadline(monkeypatch):
     # Monotonic clock that jumps past the deadline after the second
     # probe; the raise must carry the LAST probe error for the artifact.
     t = iter([0.0, 1.0, 10_000.0])
-    monkeypatch.setattr(bench.time, "monotonic", lambda: next(t))
-    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(backend.time, "monotonic", lambda: next(t))
+    monkeypatch.setattr(backend.time, "sleep", lambda s: None)
     monkeypatch.setattr(
-        bench.subprocess, "run",
+        backend.subprocess, "run",
         lambda *a, **k: _FakeCompleted(1, "UNAVAILABLE: tunnel down"))
     with pytest.raises(RuntimeError, match="tunnel down"):
         bench.wait_for_backend(timeout_s=600)
@@ -112,7 +113,7 @@ def test_wait_for_backend_survives_hung_probe(monkeypatch):
     # A wedged tunnel HANGS jax.devices(); the probe child is killed by
     # timeout and must count as a failed attempt, not crash the loop.
     outcomes = iter([
-        bench.subprocess.TimeoutExpired(cmd="probe", timeout=150),
+        backend.subprocess.TimeoutExpired(cmd="probe", timeout=150),
         _FakeCompleted(0)])
 
     def fake_run(*a, **k):
@@ -121,8 +122,8 @@ def test_wait_for_backend_survives_hung_probe(monkeypatch):
             raise o
         return o
 
-    monkeypatch.setattr(bench.subprocess, "run", fake_run)
-    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.setattr(backend.subprocess, "run", fake_run)
+    monkeypatch.setattr(backend.time, "sleep", lambda s: None)
     bench.wait_for_backend(timeout_s=600)
 
 

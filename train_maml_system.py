@@ -114,6 +114,20 @@ def main(argv=None) -> int:
     if platform:
         import jax as _jax
         _jax.config.update("jax_platforms", platform)
+    # Optional bounded wait for a transiently-unavailable backend
+    # (MAML_BACKEND_TIMEOUT=<seconds>): on a tunneled device, start-time
+    # outages are transient and a bare first device query either fails
+    # a restartable job instantly or hangs it forever — the shared
+    # preamble (utils/backend.py) turns both into a bounded retry.
+    # Off by default: local/CPU runs should fail fast.
+    # Subprocess probe ONLY — no in-process device query here: the
+    # multi-host bootstrap below must be the first backend touch so
+    # jax.devices() is the global pod list.
+    backend_timeout = float(os.environ.get("MAML_BACKEND_TIMEOUT", "0"))
+    if backend_timeout > 0:
+        from howtotrainyourmamlpytorch_tpu.utils.backend import (
+            wait_for_backend)
+        wait_for_backend(timeout_s=backend_timeout)
     # Multi-host bootstrap (no-op single-process); must run before any
     # device query so jax.devices() is the global pod device list.
     from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
