@@ -127,6 +127,42 @@ def test_wait_for_backend_survives_hung_probe(monkeypatch):
     bench.wait_for_backend(timeout_s=600)
 
 
+def test_compilation_cache_env_knob(monkeypatch, tmp_path):
+    """MAML_COMPILATION_CACHE wires the persistent-cache config trio;
+    absent, the config is untouched."""
+    import jax
+    prev = (jax.config.jax_compilation_cache_dir,
+            jax.config.jax_persistent_cache_min_entry_size_bytes,
+            jax.config.jax_persistent_cache_min_compile_time_secs)
+    try:
+        monkeypatch.delenv("MAML_COMPILATION_CACHE", raising=False)
+        backend.maybe_enable_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir == prev[0]
+        monkeypatch.setenv("MAML_COMPILATION_CACHE", str(tmp_path))
+        backend.maybe_enable_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+        assert jax.config.jax_persistent_cache_min_entry_size_bytes == -1
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev[0])
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          prev[1])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev[2])
+
+
+def test_init_backend_no_timeout_skips_probe(monkeypatch):
+    """backend_timeout=0 must go straight to jax.devices() — no
+    subprocess probes, no watchdog thread (local/CPU fail-fast path)."""
+    monkeypatch.delenv("MAML_COMPILATION_CACHE", raising=False)
+    monkeypatch.delenv("MAML_JAX_PLATFORM", raising=False)
+    monkeypatch.setattr(
+        backend.subprocess, "run",
+        lambda *a, **k: pytest.fail("probed with timeout=0"))
+    devices = backend.init_backend(backend_timeout=0)
+    assert len(devices) >= 1
+
+
 def test_load_workload_reshapes_batch_and_mesh():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(repo, "experiment_config",
