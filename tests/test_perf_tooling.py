@@ -137,7 +137,10 @@ def test_compilation_cache_env_knob(monkeypatch, tmp_path):
     try:
         monkeypatch.delenv("MAML_COMPILATION_CACHE", raising=False)
         backend.maybe_enable_compilation_cache()
-        assert jax.config.jax_compilation_cache_dir == prev[0]
+        assert (jax.config.jax_compilation_cache_dir,
+                jax.config.jax_persistent_cache_min_entry_size_bytes,
+                jax.config.jax_persistent_cache_min_compile_time_secs
+                ) == prev
         monkeypatch.setenv("MAML_COMPILATION_CACHE", str(tmp_path))
         backend.maybe_enable_compilation_cache()
         assert jax.config.jax_compilation_cache_dir == str(tmp_path)
@@ -159,6 +162,9 @@ def test_init_backend_no_timeout_skips_probe(monkeypatch):
     monkeypatch.setattr(
         backend.subprocess, "run",
         lambda *a, **k: pytest.fail("probed with timeout=0"))
+    monkeypatch.setattr(
+        backend, "init_devices_with_watchdog",
+        lambda *a, **k: pytest.fail("watchdog started with timeout=0"))
     devices = backend.init_backend(backend_timeout=0)
     assert len(devices) >= 1
 
