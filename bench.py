@@ -54,7 +54,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
 import time
@@ -212,9 +211,9 @@ def load_workload(config_path: str, batch_override: int,
     per_chip = max(
         base.batch_size // max(int(np.prod(base.mesh_shape)), 1), 1)
     batch = batch_override or per_chip * n_dev
-    mb = math.gcd(max(batch // n_dev, 1), base.task_microbatches)
-    return base.replace(batch_size=batch, mesh_shape=(1, n_dev),
-                        task_microbatches=mb)
+    cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev))
+    return cfg.replace(
+        task_microbatches=cfg.effective_task_microbatches(n_dev))
 
 
 class Workload(NamedTuple):
@@ -294,15 +293,14 @@ def main() -> int:
         headline and (in quick mode) the strict-b8 leg, so --quick
         smoke-executes EVERY code path a real capture runs."""
         quick_batch = max(2 * n_dev, 2)
-        return c.replace(
+        c = c.replace(
             image_height=16, image_width=16,
             cnn_num_filters=8, num_stages=2,
-            batch_size=quick_batch,
-            # gcd (same pattern as load_workload): the shipped configs'
-            # task_microbatches need not divide the shrunken quick
-            # batch; the gcd is unconditionally legal geometry.
-            task_microbatches=math.gcd(quick_batch // n_dev,
-                                       c.task_microbatches))
+            batch_size=quick_batch)
+        # Same clamp as load_workload: the shipped configs'
+        # task_microbatches need not divide the shrunken quick batch.
+        return c.replace(
+            task_microbatches=c.effective_task_microbatches(n_dev))
 
     cfg = load_workload(config_path, args.batch, n_dev)
     if args.quick:

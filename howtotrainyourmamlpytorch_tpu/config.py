@@ -394,6 +394,23 @@ class MAMLConfig:
         cap = -(-self.num_evaluation_tasks // mesh_n) * mesh_n
         return max(min(2 * self.batch_size, cap), self.batch_size)
 
+    def effective_task_microbatches(self, mesh_size: int = 1) -> int:
+        """Accumulation chunk count actually executable at this geometry:
+        the configured value clamped to gcd with the per-device task
+        count. Shipped values are sweep winners measured at the shipped
+        batch/mesh geometry (docs/PERF.md § Round-4 results); a larger
+        mesh or a batch override shrinks the per-device shard below the
+        configured chunk count. The gcd degrades bit-equivalently
+        (chunking never changes the math, tests/test_outer.py) and
+        preserves the measured PER-CHUNK task count whenever that chunk
+        size still divides the shard. Every consumer of the knob —
+        make_sharded_steps, ExperimentBuilder's recorded config.json,
+        bench.py, scripts/perf_ceiling.py — resolves through this one
+        helper so executed and reported geometry cannot drift.
+        """
+        local = max(self.batch_size // max(mesh_size, 1), 1)
+        return math.gcd(self.task_microbatches, local)
+
     def use_second_order(self, epoch: int) -> bool:
         """Derivative-order annealing (reference:
         ``few_shot_learning_system.py § forward`` — second order iff the

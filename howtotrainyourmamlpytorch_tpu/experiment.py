@@ -78,6 +78,15 @@ class ExperimentBuilder:
                 f"single-device mesh")
             cfg = cfg.replace(mesh_shape=(1, 1))
             devices = devices[:1]
+        eff_mb = cfg.effective_task_microbatches(
+            int(np.prod(cfg.mesh_shape)))
+        if eff_mb != cfg.task_microbatches:
+            warnings.warn(
+                f"task_microbatches {cfg.task_microbatches} clamped to "
+                f"{eff_mb} for this batch/mesh geometry (see "
+                f"MAMLConfig.effective_task_microbatches); the recorded "
+                f"config reflects what actually runs")
+            cfg = cfg.replace(task_microbatches=eff_mb)
         self.cfg = cfg
         # Recorded config reflects what actually runs (incl. any fallback).
         if self.is_main_process:

@@ -32,6 +32,7 @@ scaling axes that exist are tasks (sharded here) and inner-loop depth
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -115,13 +116,22 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
         raise ValueError(
             f"eval batch size {cfg.effective_eval_batch_size} not "
             f"divisible by mesh size {mesh.size}")
-    local_batch = cfg.batch_size // mesh.size
-    if local_batch % cfg.task_microbatches != 0:
-        raise ValueError(
-            f"task_microbatches {cfg.task_microbatches} must divide the "
-            f"PER-DEVICE task count {local_batch} (= batch_size "
-            f"{cfg.batch_size} / mesh size {mesh.size}); the accumulation "
-            f"scan runs on each device's local shard")
+    eff = cfg.effective_task_microbatches(mesh.size)
+    if eff != cfg.task_microbatches:
+        # Shipped values are sweep winners at the shipped batch/mesh
+        # geometry; degrade to the bit-equivalent gcd rather than abort
+        # (rationale in MAMLConfig.effective_task_microbatches).
+        # ExperimentBuilder pre-resolves through the same helper so its
+        # recorded config.json matches what executes; this warning fires
+        # for direct API callers.
+        warnings.warn(
+            f"task_microbatches {cfg.task_microbatches} does not divide "
+            f"the per-device task count {cfg.batch_size // mesh.size} "
+            f"(= batch_size {cfg.batch_size} / mesh size {mesh.size}); "
+            f"clamping to gcd {eff}. The shipped value is a measured "
+            f"winner at the shipped batch/mesh geometry — re-sweep at "
+            f"this one to tune.")
+        cfg = cfg.replace(task_microbatches=eff)
     repl = replicated_sharding(mesh)
     bsh = batch_sharding(mesh)
     axes = tuple(mesh.axis_names)

@@ -53,7 +53,6 @@ import jax.numpy as jnp
 import numpy as np
 
 import bench
-from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.meta import init_train_state
 from howtotrainyourmamlpytorch_tpu.models import make_model
 from howtotrainyourmamlpytorch_tpu.parallel import (
@@ -528,14 +527,9 @@ def main() -> int:
     config_path = args.config or os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "experiment_config", "mini-imagenet_maml++_5-way_5-shot_DA_b12.json")
-    base = MAMLConfig.from_json_file(config_path)
-    per_chip = max(base.batch_size // max(
-        int(np.prod(base.mesh_shape)), 1), 1)
-    batch = args.batch or per_chip * n_dev
-    import math as _math
-    mb = _math.gcd(max(batch // n_dev, 1), base.task_microbatches)
-    cfg = base.replace(batch_size=batch, mesh_shape=(1, n_dev),
-                       task_microbatches=mb)
+    # Same reshape-to-local-devices rule as every bench capture: the
+    # modeled geometry must be the measured one.
+    cfg = bench.load_workload(config_path, args.batch or 0, n_dev)
 
     if args.cal:
         parts = args.cal.split(",")

@@ -1,7 +1,10 @@
-"""task_microbatches sweep over the shipped mb=1 configs (VERDICT r3
-item 4): the lever measured +34-39% on the two configs it was applied to
+"""task_microbatches sweep over the non-flagship configs (VERDICT r3
+item 4): the lever measured +34-39% on the two flagship configs
 (docs/PERF.md § Microbatching); this script asks the same question at
-fixed per-chip batch for every config family member that still runs mb=1.
+fixed per-chip batch for the rest of the family. The round-4 session ran
+it and shipped every winner (docs/PERF.md § Round-4 hardware session
+results) — a re-run now sweeps AGAINST those shipped values, which each
+config's closing JSON line reports as `shipped_mb`/`shipped_rate`.
 
 For each target config: build the steady-state executable (bench.py's
 single build path) at each divisor of the per-chip batch and measure
@@ -30,9 +33,9 @@ from bench import (build_steady_state, init_backend, load_workload,  # noqa: E40
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# The family members still at task_microbatches=1 (docs/PERF.md § "Not
-# yet swept") — the four Omniglot MAML++ configs, both mini-ImageNet
-# 1-shot configs, and the canonical plain-MAML point.
+# The non-flagship family members — the four Omniglot MAML++ configs,
+# both mini-ImageNet 1-shot configs, and the canonical plain-MAML point.
+# All carry r4-measured winners now (docs/PERF.md § Round-4 results).
 DEFAULT_TARGETS = [
     "omniglot_maml++_5-way_1-shot.json",
     "omniglot_maml++_5-way_5-shot.json",

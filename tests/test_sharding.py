@@ -161,6 +161,48 @@ def test_sharded_microbatch_accumulation():
                                    rtol=2e-4, atol=1e-7)
 
 
+def test_microbatch_clamped_to_local_shard():
+    """The shipped single-chip sweep winners set task_microbatches as
+    high as the full batch (e.g. omniglot 5w1s: mb=16, batch=16). On a
+    multi-chip mesh the per-device shard shrinks below that; the plan
+    must degrade to gcd(mb, local) with a warning rather than abort,
+    and the clamped step must reproduce single-shot numerics (the
+    accumulation chunking is bit-equivalent)."""
+    devices = jax.devices()[:8]
+    cfg = CFG.replace(mesh_shape=(1, 8), batch_size=16,
+                      task_microbatches=16)  # local shard = 2 < mb
+    init, apply = make_model(cfg)
+    mesh = make_mesh(cfg, devices)
+    with pytest.warns(UserWarning, match="clamping to gcd 2"):
+        plan = make_sharded_steps(cfg, apply, mesh)
+    repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    def fresh_state():
+        return jax.device_put(
+            init_train_state(cfg, init, jax.random.PRNGKey(0)), repl)
+
+    batch = shard_batch(_batch(jax.random.PRNGKey(1), cfg), mesh)
+    _, m = plan.train_steps[(True, True)](fresh_state(), batch,
+                                          jnp.float32(0))
+    assert np.isfinite(float(m.loss))
+
+    cfg1 = cfg.replace(task_microbatches=1)
+    _, apply1 = make_model(cfg1)
+    plan1 = make_sharded_steps(cfg1, apply1, mesh)
+    _, m1 = plan1.train_steps[(True, True)](fresh_state(), batch,
+                                            jnp.float32(0))
+    np.testing.assert_allclose(float(m1.loss), float(m.loss), rtol=1e-6)
+
+    # A --batch_size downscale of a shipped config (mb now > the new
+    # batch) must also clamp, not abort: gcd(16, 8) = 8 keeps one task
+    # per chunk on the shrunken single-chip geometry too.
+    cfg_small = CFG.replace(mesh_shape=(1, 1), batch_size=8,
+                            task_microbatches=16)
+    with pytest.warns(UserWarning, match="clamping to gcd 8"):
+        make_sharded_steps(cfg_small, apply,
+                           make_mesh(cfg_small, jax.devices()[:1]))
+
+
 def test_resnet12_trains_on_sharded_mesh():
     """Regression (r2): resnet12's 1x1 skip projections, vmapped over
     per-task fast kernels, used to lower to feature-grouped convs that the
