@@ -115,3 +115,25 @@ def test_msl_on_any_mesh():
     MAMLConfig(msl_target_batching="on", mesh_shape=(1, 1))
     with pytest.raises(ValueError, match="'auto'"):
         MAMLConfig(msl_target_batching="sometimes")
+
+
+def test_effective_task_microbatches_geometry():
+    """The one helper every consumer resolves the accumulation chunk
+    count through (mesh.py, ExperimentBuilder's recorded config,
+    bench.py, perf_ceiling.py): gcd with the per-device task count."""
+    cfg = MAMLConfig(batch_size=16, task_microbatches=16)
+    # Shipped geometry: the configured winner stands.
+    assert cfg.effective_task_microbatches(1) == 16
+    # Mesh growth shrinks the shard; gcd preserves 1 task per chunk.
+    assert cfg.effective_task_microbatches(2) == 8
+    assert cfg.effective_task_microbatches(8) == 2
+    # Batch override below the configured count clamps the same way.
+    assert cfg.replace(batch_size=8).effective_task_microbatches(1) == 8
+    # Non-divisor value degrades to a legal divisor, never aborts.
+    assert cfg.replace(task_microbatches=5).effective_task_microbatches(1) == 1
+    assert cfg.replace(task_microbatches=6).effective_task_microbatches(1) == 2
+    # mb=1 is a fixed point at any geometry.
+    assert cfg.replace(task_microbatches=1).effective_task_microbatches(8) == 1
+    # Degenerate mesh size guards.
+    assert cfg.effective_task_microbatches(0) == 16
+    assert cfg.effective_task_microbatches(32) == 1
