@@ -51,6 +51,40 @@ def test_full_experiment_end_to_end(tmp_path):
                                        "config.json"))
 
 
+def test_full_experiment_from_disk_dataset(tmp_path):
+    """The real-data user's first path: a reference-layout on-disk PNG
+    tree (datasets/<name>/{train,val,test}/<class>/*.png) must drive the
+    FULL loop — train epochs, val sweeps, checkpointing, ensemble test —
+    through DiskImageSource, not the synthetic fallback."""
+    from PIL import Image
+    from howtotrainyourmamlpytorch_tpu.data.sources import DiskImageSource
+
+    rng = np.random.default_rng(7)
+    data_root = tmp_path / "datasets"
+    for split, classes in (("train", 6), ("val", 4), ("test", 4)):
+        for c in range(classes):
+            d = data_root / "pngset" / split / f"class_{c}"
+            d.mkdir(parents=True)
+            for i in range(4):
+                Image.fromarray(
+                    rng.integers(0, 255, (10, 10), np.uint8), "L"
+                ).save(d / f"{i}.png")
+
+    cfg = _cfg(tmp_path / "exp", dataset_name="pngset",
+               dataset_path=str(data_root), total_iter_per_epoch=3,
+               num_evaluation_tasks=4, batch_size=2)
+    builder = ExperimentBuilder(cfg)
+    # No synthetic fallback: every split must resolve to the disk tree.
+    for split in ("train", "val", "test"):
+        assert isinstance(builder.data.sampler(split).source,
+                          DiskImageSource), split
+    result = builder.run_experiment()
+    assert result["num_models"] == 2
+    assert 0.0 <= result["test_accuracy_mean"] <= 1.0
+    stats = load_statistics(builder.paths["logs"])
+    assert stats["epoch"] == ["0", "1"]
+
+
 def test_resume_matches_uninterrupted(tmp_path):
     """Checkpoint/resume determinism: pause after epoch 0, resume, and the
     final params must match a straight-through run exactly (the data
