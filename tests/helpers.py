@@ -1,0 +1,44 @@
+"""Shared test fixtures for on-disk / in-archive PNG dataset trees.
+
+Three test modules exercise the reference dataset layout
+(``<dataset>/<split>/<class>/*.png`` and the Omniglot nested
+``<alphabet>/<character>`` variant). They build their trees through
+these helpers so the on-disk contract (grayscale PNG, uint8, extension)
+lives in one place.
+"""
+
+import io
+
+import numpy as np
+
+
+def write_png(path, rng, size=(12, 12)):
+    """Write one random grayscale PNG to ``path``."""
+    from PIL import Image
+    Image.fromarray(rng.integers(0, 255, size, np.uint8), "L").save(path)
+
+
+def png_bytes(rng, size):
+    """Random grayscale PNG as bytes (for writing into zip archives)."""
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(rng.integers(0, 255, size, np.uint8), "L").save(
+        buf, "PNG")
+    return buf.getvalue()
+
+
+def make_png_split_tree(root, splits, rng, size=(12, 12),
+                        images_per_class=4):
+    """Reference flat layout: ``root/<split>/<class>/<i>.png``.
+
+    ``splits`` maps split name -> class-name iterable (or an int for
+    ``class_0..class_{n-1}``).
+    """
+    for split, classes in splits.items():
+        if isinstance(classes, int):
+            classes = [f"class_{c}" for c in range(classes)]
+        for cls in classes:
+            d = root / split / cls
+            d.mkdir(parents=True)
+            for i in range(images_per_class):
+                write_png(d / f"{i}.png", rng, size)

@@ -12,6 +12,8 @@ import numpy as np
 import pytest
 from PIL import Image
 
+from helpers import png_bytes
+
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import (
     DATASET_URLS, dataset_dir_is_ready, maybe_unzip_dataset)
@@ -159,13 +161,9 @@ def test_omniglot_layout_zip_to_train_step(tmp_path):
             for alpha in alphabets:
                 for char in ("character01", "character02", "character03"):
                     for i in range(4):
-                        img = Image.fromarray(
-                            rng.integers(0, 255, (28, 28), np.uint8), "L")
-                        buf = io.BytesIO()
-                        img.save(buf, "PNG")
                         zf.writestr(
                             f"omniglot_dataset/{split}/{alpha}/{char}/"
-                            f"{i}.png", buf.getvalue())
+                            f"{i}.png", png_bytes(rng, (28, 28)))
 
     cfg = MAMLConfig(
         dataset_name="omniglot_dataset", dataset_path=str(tmp_path),
@@ -233,13 +231,9 @@ def test_fetch_to_train_step_end_to_end(tmp_path):
             for split, n_cls in (("train", 6), ("val", 3), ("test", 3)):
                 for c in range(n_cls):
                     for i in range(3):
-                        img = Image.fromarray(
-                            rng.integers(0, 255, (14, 14), np.uint8), "L")
-                        buf = io.BytesIO()
-                        img.save(buf, "PNG")
                         zf.writestr(
                             f"omniglot_dataset/{split}/class_{c:02d}/"
-                            f"{i}.png", buf.getvalue())
+                            f"{i}.png", png_bytes(rng, (14, 14)))
 
     assert maybe_unzip_dataset(cfg, fetcher=fetcher, require=True) is True
     assert dataset_dir_is_ready(cfg.dataset_path)

@@ -56,19 +56,14 @@ def test_full_experiment_from_disk_dataset(tmp_path):
     tree (datasets/<name>/{train,val,test}/<class>/*.png) must drive the
     FULL loop — train epochs, val sweeps, checkpointing, ensemble test —
     through DiskImageSource, not the synthetic fallback."""
-    from PIL import Image
+    from helpers import make_png_split_tree
     from howtotrainyourmamlpytorch_tpu.data.sources import DiskImageSource
 
     rng = np.random.default_rng(7)
     data_root = tmp_path / "datasets"
-    for split, classes in (("train", 6), ("val", 4), ("test", 4)):
-        for c in range(classes):
-            d = data_root / "pngset" / split / f"class_{c}"
-            d.mkdir(parents=True)
-            for i in range(4):
-                Image.fromarray(
-                    rng.integers(0, 255, (10, 10), np.uint8), "L"
-                ).save(d / f"{i}.png")
+    make_png_split_tree(data_root / "pngset",
+                        {"train": 6, "val": 4, "test": 4}, rng,
+                        size=(10, 10))
 
     cfg = _cfg(tmp_path / "exp", dataset_name="pngset",
                dataset_path=str(data_root), total_iter_per_epoch=3,
