@@ -230,6 +230,15 @@ class Workload(NamedTuple):
     bench_epoch: int
 
 
+# XLA compiler options forwarded to every .compile() in this module
+# (set from --compiler-option KEY=VAL; empty = compiler defaults). The
+# tunneled backend rejects client-side XLA_FLAGS outright (unknown-flag
+# abort in the client parser; TPU flags live in the SERVER compiler),
+# but PJRT compiler_options pass through — this is the only working
+# channel for per-experiment compiler knobs in this environment.
+COMPILER_OPTIONS: dict = {}
+
+
 def build_steady_state(cfg: MAMLConfig, devices) -> Workload:
     """Build cfg's steady-state (last-epoch) train step: by definition an
     executable real training runs, past every annealing boundary that is
@@ -247,7 +256,8 @@ def build_steady_state(cfg: MAMLConfig, devices) -> Workload:
                            replicated_sharding(mesh))
     batch_ep = shard_batch(synthetic_batch(cfg, 0), mesh)
     epoch = jnp.float32(bench_epoch)
-    compiled = train.lower(state, batch_ep, epoch).compile()
+    compiled = train.lower(state, batch_ep, epoch).compile(
+        compiler_options=COMPILER_OPTIONS or None)
     return Workload(init, mesh, plan, state, batch_ep, epoch, compiled,
                     bench_epoch)
 
@@ -274,11 +284,25 @@ def main() -> int:
     ap.add_argument("--no-strict-b8", action="store_true",
                     help="skip the strict paper batch-8 operating point "
                          "leg (the strict_b8_* keys)")
+    ap.add_argument("--compiler-option", action="append", default=[],
+                    metavar="KEY=VAL",
+                    help="XLA compiler option forwarded via PJRT "
+                         "compiler_options to every compile (repeatable; "
+                         "e.g. xla_tpu_scoped_vmem_limit_kib=65536). "
+                         "Client-side XLA_FLAGS do NOT reach the "
+                         "tunneled server compiler — this does.")
     ap.add_argument("--backend-timeout", type=float, default=600.0,
                     help="seconds to poll for JAX backend availability "
                          "before failing (tunnel outages are transient; "
                          "0 = no retry, fail on first init error)")
     args = ap.parse_args()
+    for kv in args.compiler_option:
+        key, sep, val = kv.partition("=")
+        if not sep or not key:
+            print(json.dumps({"error": f"--compiler-option needs "
+                              f"KEY=VAL, got {kv!r}"}))
+            return 1
+        COMPILER_OPTIONS[key] = val
 
     devices = init_backend(args.backend_timeout)
     n_dev = len(devices)
@@ -386,7 +410,8 @@ def main() -> int:
                     e for e in range(cfg.total_epochs)
                     if (cfg.use_second_order(e), cfg.use_msl(e)) == k))
                 other = plan.train_steps[k].lower(
-                    st, batch_ep, rep).compile()
+                    st, batch_ep, rep).compile(
+                        compiler_options=COMPILER_OPTIONS or None)
                 rate = measure_rate(other, st, batch_ep, rep,
                                     batch_size=cfg.batch_size,
                                     n_dev=n_dev,

@@ -44,3 +44,17 @@ def test_bench_quick_emits_full_capture_contract():
     assert last["strict_b8_tasks_per_sec_per_chip"] > 0
     for key, val in first.items():
         assert last.get(key) == val, f"superset violated at {key}"
+
+
+def test_bench_rejects_malformed_compiler_option():
+    """--compiler-option must be KEY=VAL; malformed input fails fast
+    (before backend init) with a JSON error line and rc=1."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--compiler-option", "no_equals_sign"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, MAML_JAX_PLATFORM="cpu"), cwd=REPO)
+    assert r.returncode == 1, (r.returncode, r.stdout, r.stderr[-500:])
+    err = json.loads([ln for ln in r.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert "compiler-option" in err["error"]
