@@ -19,6 +19,7 @@ TPU-first notes:
 
 from __future__ import annotations
 
+import logging
 import signal
 import sys
 import threading
@@ -81,11 +82,15 @@ class ExperimentBuilder:
         eff_mb = cfg.effective_task_microbatches(
             int(np.prod(cfg.mesh_shape)))
         if eff_mb != cfg.task_microbatches:
-            warnings.warn(
+            msg = (
                 f"task_microbatches {cfg.task_microbatches} clamped to "
                 f"{eff_mb} for this batch/mesh geometry (see "
                 f"MAMLConfig.effective_task_microbatches); the recorded "
                 f"config reflects what actually runs")
+            warnings.warn(msg)
+            # Driver/batch jobs routinely swallow Python warnings; the
+            # geometry change must reach their logs too (ADVICE r4).
+            logging.getLogger(__name__).warning(msg)
             cfg = cfg.replace(task_microbatches=eff_mb)
         self.cfg = cfg
         # Recorded config reflects what actually runs (incl. any fallback).

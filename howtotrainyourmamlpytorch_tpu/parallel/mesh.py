@@ -32,6 +32,7 @@ scaling axes that exist are tasks (sharded here) and inner-loop depth
 from __future__ import annotations
 
 import functools
+import logging
 import warnings
 from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
 
@@ -118,19 +119,40 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
             f"divisible by mesh size {mesh.size}")
     eff = cfg.effective_task_microbatches(mesh.size)
     if eff != cfg.task_microbatches:
+        local = cfg.batch_size // mesh.size
+        if eff == 1 and cfg.task_microbatches > 1 and local > 1:
+            # ADVICE r4: a value that degrades to gcd 1 at a multi-task
+            # shard shares NO factor with the geometry — it was never a
+            # sweep winner here and the clamp would silently discard all
+            # accumulation benefit. Fail loudly; callers that want the
+            # degradation must pre-resolve explicitly
+            # (MAMLConfig.effective_task_microbatches, as bench.py's
+            # load_workload and ExperimentBuilder do).
+            raise ValueError(
+                f"task_microbatches {cfg.task_microbatches} shares no "
+                f"factor with the per-device task count {local} "
+                f"(= batch_size {cfg.batch_size} / mesh size "
+                f"{mesh.size}); clamping would silently run mb=1. Pick "
+                f"a divisor of {local}, or pre-resolve via "
+                f"cfg.effective_task_microbatches(mesh_size) to accept "
+                f"the degradation.")
         # Shipped values are sweep winners at the shipped batch/mesh
-        # geometry; degrade to the bit-equivalent gcd rather than abort
-        # (rationale in MAMLConfig.effective_task_microbatches).
-        # ExperimentBuilder pre-resolves through the same helper so its
-        # recorded config.json matches what executes; this warning fires
-        # for direct API callers.
-        warnings.warn(
+        # geometry; a partial mismatch (shared factor survives) degrades
+        # to the numerics-equivalent gcd rather than aborting (rationale
+        # in MAMLConfig.effective_task_microbatches). ExperimentBuilder
+        # pre-resolves through the same helper so its recorded
+        # config.json matches what executes; this fires for direct API
+        # callers — via logging TOO, since batch/driver jobs routinely
+        # swallow Python warnings (ADVICE r4).
+        msg = (
             f"task_microbatches {cfg.task_microbatches} does not divide "
-            f"the per-device task count {cfg.batch_size // mesh.size} "
+            f"the per-device task count {local} "
             f"(= batch_size {cfg.batch_size} / mesh size {mesh.size}); "
             f"clamping to gcd {eff}. The shipped value is a measured "
             f"winner at the shipped batch/mesh geometry — re-sweep at "
             f"this one to tune.")
+        warnings.warn(msg)
+        logging.getLogger(__name__).warning(msg)
         cfg = cfg.replace(task_microbatches=eff)
     repl = replicated_sharding(mesh)
     bsh = batch_sharding(mesh)

@@ -1,0 +1,397 @@
+"""Scan-trip-expanded FLOP accounting for compiled XLA executables.
+
+Why this exists (VERDICT r4 weak #1): XLA's
+``compiled.cost_analysis()["flops"]`` counts the body of a
+``lax.scan``/``while`` loop ONCE, not per trip. Every scanned axis in
+the train step — the K inner adaptation steps and the
+``task_microbatches`` accumulation loop — therefore vanishes from the
+aggregate count: an identical program at mb=4 reports ~1/4 the flops of
+mb=1, and BENCH_r04's ``flops_per_task``/``mfu`` keys were ~12x
+under-counted at the shipped mb=12 operating point.
+
+The fix has two ingredients, combined in :func:`executable_flops`:
+
+1. **HLO walk with trip expansion** (shared with
+   ``scripts/perf_ceiling.py``, which imports its parser from here):
+   parse the optimized per-device HLO text, recurse from the entry
+   computation, multiply while-loop bodies by the trip count read from
+   the loop condition's largest integer constant (verified against the
+   known K; override via ``PERF_CEILING_TRIPS=name:count,...``), and sum
+   convolution/dot FLOPs — including inside fusions.
+2. **Calibration against XLA's own count.** The parser only prices
+   conv/dot (elementwise flops and exotic conv encodings — e.g. the
+   dilated-conv form of vmapped grouped convs — are XLA's to count), so
+   the parsed total is scaled by ``xla_flat / parsed_flat``, both
+   counting every loop body once.  The ratio transfers XLA's
+   authoritative per-visit pricing onto the trip-expanded walk.  Because
+   nearly all work lives inside the scanned bodies, the ratio is
+   insensitive to the microbatch count — making the expanded total
+   invariant to ``task_microbatches`` (pinned by
+   ``tests/test_perf_tooling.py::test_expanded_flops_microbatch_invariant``).
+
+This is HARDWARE flops — remat recompute included, because the
+executable really performs it — which is the honest numerator for a
+"how busy is the MXU" utilization figure (unlike a paper model-FLOPs
+count that would credit recomputation as free).
+
+Reference anchor: the reference publishes no FLOPs/utilization numbers
+at all (SURVEY.md §6); this module exists to make the build's
+throughput claim absolute rather than relative to an estimated baseline.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(
+    r"(pred|bf16|f16|f32|f64|s8|u8|s16|u16|s32|u32|s64|u64|c64|c128)"
+    r"\[([0-9,]*)\]"
+    r"(\{[^}]*\})?")
+
+# Instructions that cost nothing at runtime (metadata / aliasing only).
+_FREE_OPS = {
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "after-all", "partition-id", "replica-id", "iota",
+}
+
+_NAME_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _shape_bytes(text: str, physical: bool) -> tuple[int, int]:
+    """(bytes, flop-elements) summed over every array shape in `text`.
+
+    physical=True applies the layout's tile padding: for a `T(8,128)`
+    tile the minormost dim pads to a multiple of 128 and the next to a
+    multiple of 8 (the `(2,1)` bf16 sub-tile changes packing, not the
+    padded element count at this granularity).
+    """
+    total = 0
+    elems = 0
+    for m in _SHAPE_RE.finditer(text):
+        dtype, dims_s, layout = m.group(1), m.group(2), m.group(3)
+        dims = [int(d) for d in dims_s.split(",") if d] if dims_s else []
+        n = int(np.prod(dims)) if dims else 1
+        elems += n
+        if physical and layout and dims:
+            tile = re.search(r"T\((\d+),(\d+)\)", layout)
+            mtm = re.match(r"\{([0-9,]+)", layout)
+            if tile and mtm:
+                order = [int(d) for d in mtm.group(1).split(",")]
+                padded = list(dims)
+                if len(order) == len(dims) and len(order) >= 1:
+                    t_sub, t_lane = int(tile.group(1)), int(tile.group(2))
+                    lane_dim = order[0]
+                    padded[lane_dim] = -(-padded[lane_dim] // t_lane) * t_lane
+                    if len(order) >= 2:
+                        sub_dim = order[1]
+                        padded[sub_dim] = (-(-padded[sub_dim] // t_sub)
+                                           * t_sub)
+                n = int(np.prod(padded))
+        total += n * _DTYPE_BYTES[dtype]
+    return total, elems
+
+
+def _split_computations(hlo: str) -> dict[str, list[str]]:
+    """computation name -> its instruction lines (entry included under
+    its own name; the ENTRY marker is recorded at key ``__entry__``)."""
+    comps: dict[str, list[str]] = {}
+    entry_name = None
+    cur = None
+    for line in hlo.splitlines():
+        stripped = line.strip()
+        m = re.match(r"(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*\{", stripped)
+        if m and not stripped.startswith("//"):
+            cur = m.group(2)
+            comps[cur] = []
+            if m.group(1):
+                entry_name = cur
+            continue
+        if stripped == "}":
+            cur = None
+            continue
+        if cur is not None and "=" in stripped:
+            comps[cur].append(stripped)
+    if entry_name is None:
+        raise ValueError("no ENTRY computation found in HLO text")
+    comps["__entry__"] = [entry_name]
+    return comps
+
+
+def _parse_instr(line: str):
+    """-> (opcode, out_text, operand_text, attr_text) or None."""
+    eq = line.find(" = ")
+    if eq < 0:
+        return None
+    rhs = line[eq + 3:]
+    # Output shape: balanced parens for tuples, else up to first space.
+    if rhs.startswith("("):
+        depth, i = 0, 0
+        for i, ch in enumerate(rhs):
+            depth += ch == "("
+            depth -= ch == ")"
+            if depth == 0:
+                break
+        out_text, rest = rhs[:i + 1], rhs[i + 1:].lstrip()
+    else:
+        sp = rhs.find(" ")
+        out_text, rest = rhs[:sp], rhs[sp + 1:]
+    m = re.match(r"([\w\-]+)\(", rest)
+    if not m:
+        return None
+    opcode = m.group(1)
+    depth, start = 0, rest.find("(")
+    i = start
+    for i in range(start, len(rest)):
+        depth += rest[i] == "("
+        depth -= rest[i] == ")"
+        if depth == 0:
+            break
+    return opcode, out_text, rest[start + 1:i], rest[i + 1:]
+
+
+def _conv_flops(out_text: str, operand_text: str, attrs: str) -> float:
+    """2 * out_elems * kh * kw * Cin / groups, parsed from shapes."""
+    _, out_elems = _shape_bytes(out_text, physical=False)
+    shapes = _SHAPE_RE.findall(operand_text)
+    if len(shapes) < 2:
+        return 0.0
+    kdims = [int(d) for d in shapes[1][1].split(",") if d]
+    dl = re.search(r"dim_labels=\w+_(\w+)->", attrs)
+    if dl and len(dl.group(1)) == len(kdims):
+        # Kernel dim labels, e.g. "01io": spatial..., i, o. The kernel's
+        # 'i' extent is already input_features/group_count, so the
+        # per-output-element work is just the kernel volume sans 'o'.
+        per_out = 1
+        for ch, d in zip(dl.group(1), kdims):
+            if ch != "o":
+                per_out *= d
+        return 2.0 * out_elems * per_out
+    per_out = int(np.prod(kdims[:-1])) if kdims else 1
+    return 2.0 * out_elems * per_out
+
+
+def _dot_flops(out_text: str, operand_text: str, attrs: str) -> float:
+    _, out_elems = _shape_bytes(out_text, physical=False)
+    shapes = _SHAPE_RE.findall(operand_text)
+    if not shapes:
+        return 0.0
+    ldims = [int(d) for d in shapes[0][1].split(",") if d]
+    m = re.search(r"lhs_contracting_dims=\{([0-9,]*)\}", attrs)
+    k = 1
+    if m and m.group(1):
+        for d in m.group(1).split(","):
+            if int(d) < len(ldims):
+                k *= ldims[int(d)]
+    return 2.0 * out_elems * k
+
+
+class HloFlopsCounter:
+    """Conv/dot FLOPs of an optimized HLO module, walked from the entry
+    computation with while-loop bodies multiplied by their trip counts.
+
+    ``total(expand_trips=False)`` reproduces XLA-cost-analysis-style
+    counting (every loop body priced once) for the calibration ratio in
+    :func:`executable_flops`; ``expand_trips=True`` is the real count.
+    """
+
+    def __init__(self, hlo: str):
+        self.comps = _split_computations(hlo)
+        self.entry = self.comps["__entry__"][0]
+        self.trip_counts: dict[str, int] = {}
+        # name -> output shape text, per computation: optimized dumps
+        # print operands WITHOUT shapes, so reads resolve through the
+        # defining instruction (parameters appear as explicit
+        # `parameter(N)` instructions with full shapes).
+        self.symtab: dict[str, dict[str, str]] = {}
+        for cname, lines in self.comps.items():
+            if cname == "__entry__":
+                continue
+            tab = {}
+            for line in lines:
+                p = _parse_instr(line)
+                if p:
+                    m = re.match(r"(?:ROOT\s+)?%?([\w.\-]+)\s+=",
+                                 line.strip())
+                    if m:
+                        tab[m.group(1)] = p[1]
+            self.symtab[cname] = tab
+
+    def _operand_shapes(self, comp: str, ops_t: str) -> list[str]:
+        if _SHAPE_RE.search(ops_t):
+            return [m.group(0) for m in _SHAPE_RE.finditer(ops_t)]
+        tab = self.symtab.get(comp, {})
+        return [tab[n] for n in _NAME_RE.findall(ops_t) if n in tab]
+
+    def trip_count(self, cond_name: str) -> int:
+        """Largest integer constant in the loop condition — the scan
+        bound for counted loops (verified against the known K; override
+        via PERF_CEILING_TRIPS=name:count,... if a loop ever isn't)."""
+        best = 1
+        for line in self.comps.get(cond_name, []):
+            for m in re.finditer(r"constant\((\d+)\)", line):
+                best = max(best, int(m.group(1)))
+        env = os.environ.get("PERF_CEILING_TRIPS", "")
+        for part in env.split(","):
+            if ":" in part:
+                n, c = part.split(":", 1)
+                if n == cond_name:
+                    try:
+                        best = int(c)
+                    except ValueError:
+                        # Malformed override must fail LOUDLY and
+                        # identically in every consumer (bench.py's
+                        # fail-soft wrapper surfaces it as a visible
+                        # parse_error key, never a silent flat count).
+                        raise ValueError(
+                            f"PERF_CEILING_TRIPS entry {part!r}: count "
+                            f"{c!r} is not an integer") from None
+        self.trip_counts[cond_name] = best
+        return best
+
+    def _fusion_flops(self, name: str, seen=None) -> float:
+        """conv/dot flops inside a (fusion-called) computation tree."""
+        seen = seen or set()
+        if name in seen or name not in self.comps:
+            return 0.0
+        seen.add(name)
+        total = 0.0
+        for line in self.comps.get(name, []):
+            p = _parse_instr(line)
+            if not p:
+                continue
+            opcode, out_t, ops_t, attrs = p
+            # Shape resolution is regex work over the symbol table; only
+            # the conv/dot branches consume it, so only they pay for it
+            # (~99% of instructions are neither on real programs).
+            if opcode == "convolution":
+                resolved = " ".join(self._operand_shapes(name, ops_t))
+                total += _conv_flops(out_t, resolved, attrs)
+            elif opcode == "dot":
+                resolved = " ".join(self._operand_shapes(name, ops_t))
+                total += _dot_flops(out_t, resolved, attrs)
+            for c in re.findall(r"(?:calls|to_apply)=%?([\w.\-]+)", attrs):
+                total += self._fusion_flops(c, seen)
+        return total
+
+    def _comp_total(self, name: str, mult: float, expand: bool) -> float:
+        total = 0.0
+        for line in self.comps.get(name, []):
+            p = _parse_instr(line)
+            if not p:
+                continue
+            opcode, out_t, ops_t, attrs = p
+            if opcode in _FREE_OPS:
+                continue
+            if opcode == "while":
+                m_b = re.search(r"body=%?([\w.\-]+)", attrs)
+                m_c = re.search(r"condition=%?([\w.\-]+)", attrs)
+                if m_b and m_c:
+                    trips = self.trip_count(m_c.group(1)) if expand else 1
+                    total += self._comp_total(m_b.group(1), mult * trips,
+                                              expand)
+                continue
+            if opcode == "call":
+                for c in re.findall(r"(?:to_apply|calls)=%?([\w.\-]+)",
+                                    attrs):
+                    total += self._comp_total(c, mult, expand)
+                continue
+            if opcode == "conditional":
+                # Branches via true_computation=/false_computation=/
+                # branch_computations={...}. Exactly ONE executes per
+                # visit; which is data-dependent, so price the MAX
+                # branch. (The time-ceiling model in perf_ceiling sums
+                # them as a deliberate upper bound; a utilization
+                # numerator must not over-credit never-executed work.)
+                branches = re.findall(
+                    r"(?:true_computation|false_computation)"
+                    r"=%?([\w.\-]+)", attrs)
+                m = re.search(r"branch_computations=\{([^}]*)\}", attrs)
+                if m:
+                    branches += _NAME_RE.findall(m.group(1))
+                if branches:
+                    total += max(self._comp_total(c, mult, expand)
+                                 for c in branches)
+                continue
+            if opcode == "convolution":
+                resolved = " ".join(self._operand_shapes(name, ops_t))
+                total += _conv_flops(out_t, resolved, attrs) * mult
+            elif opcode == "dot":
+                resolved = " ".join(self._operand_shapes(name, ops_t))
+                total += _dot_flops(out_t, resolved, attrs) * mult
+            elif opcode == "fusion":
+                m = re.search(r"calls=%?([\w.\-]+)", attrs)
+                if m:
+                    total += self._fusion_flops(m.group(1)) * mult
+        return total
+
+    def total(self, expand_trips: bool = True) -> float:
+        return self._comp_total(self.entry, 1.0, expand_trips)
+
+
+def xla_flat_flops(compiled) -> float:
+    """XLA-counted FLOPs of the compiled executable's PER-DEVICE module
+    (cost analysis reports the post-SPMD-partitioning program, i.e. the
+    work one chip does for its batch/n_devices shard) — with every
+    while/scan body counted ONCE. Returns 0.0 when the backend exposes
+    no cost analysis (e.g. some PJRT plugins)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def executable_flops(compiled) -> dict:
+    """Scan-trip-expanded hardware FLOPs of one execution of `compiled`.
+
+    Returns ``{"flops", "source", "xla_flat_flops", "parsed_flat_flops",
+    "parsed_expanded_flops", "trip_counts"}``; ``flops`` is 0.0 only when
+    neither the HLO text nor cost analysis is available.
+    """
+    xla_flat = xla_flat_flops(compiled)
+    parsed_exp = parsed_flat = 0.0
+    trips: dict[str, int] = {}
+    parse_error = None
+    try:
+        counter = HloFlopsCounter(compiled.as_text())
+        parsed_exp = counter.total(expand_trips=True)
+        parsed_flat = counter.total(expand_trips=False)
+        trips = dict(counter.trip_counts)
+    except Exception as e:  # noqa: BLE001 — bench must survive a parse
+        # failure, but NEVER silently: falling back to the flat XLA
+        # count re-introduces the ~12x under-count this module exists to
+        # fix, so the error rides the result for the artifact to show.
+        parse_error = f"{type(e).__name__}: {e}"
+    if parsed_exp > 0 and parsed_flat > 0 and xla_flat > 0:
+        flops = parsed_exp * (xla_flat / parsed_flat)
+        source = "hlo_trip_expanded_xla_calibrated"
+    elif parsed_exp > 0:
+        flops = parsed_exp
+        source = "hlo_trip_expanded_convdot_only"
+    elif xla_flat > 0:
+        # Known under-count when the program contains counted loops —
+        # better than nothing, and the source key says so.
+        flops = xla_flat
+        source = "xla_cost_analysis_flat"
+    else:
+        flops = 0.0
+        source = "unavailable"
+    out = {"flops": flops, "source": source,
+           "xla_flat_flops": xla_flat,
+           "parsed_flat_flops": parsed_flat,
+           "parsed_expanded_flops": parsed_exp,
+           "trip_counts": trips}
+    if parse_error is not None:
+        out["parse_error"] = parse_error
+    return out

@@ -354,3 +354,50 @@ def test_pre_k_plus_1_lslr_checkpoint_migrates():
     assert np.isfinite(float(m.loss))
     # Current-format states pass through untouched.
     assert migrate_lslr_rows(CFG, state) is state
+
+
+def test_train_step_persists_task_mean_bn_state():
+    """KNOWN DEVIATION from the reference, asserted here so the shipped
+    semantics cannot drift silently (VERDICT r4 weak #4; MOUNT-AUDIT
+    #15; docs/PARITY.md § Known deviations): the reference backs up and
+    RESTORES BN running stats around every TRAINING task
+    (few_shot_learning_system.py § forward -> restore_backup_stats per
+    SURVEY.md §3.2), i.e. running stats never evolve during training.
+    This build instead persists the task-MEAN of the post-task stats
+    (meta/outer.py § batch_loss). Behaviorally inert — stats are
+    tracked but never normalize (models/layers.py § batch_norm_apply
+    always uses batch statistics, train AND eval, exactly like the
+    reference) — but checkpoint bytes differ from a faithful port's."""
+    cfg = CFG.replace(batch_size=2, per_step_bn_statistics=True)
+    init, apply = make_model(cfg)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    train_step = jax.jit(
+        functools.partial(make_train_step(cfg, apply),
+                          second_order=False, use_msl=False))
+    batch = _synthetic_batch(jax.random.PRNGKey(7), cfg, 2)
+    new_state, _ = train_step(state, batch, jnp.float32(0))
+
+    # Expected: the mean over tasks of each task's own post-adaptation
+    # bn_state, computed directly through task_forward.
+    from howtotrainyourmamlpytorch_tpu.meta.inner import task_forward
+    res = jax.vmap(lambda ep: task_forward(
+        cfg, apply, state.params, state.lslr, state.bn_state, ep,
+        num_steps=cfg.number_of_training_steps_per_iter,
+        second_order=False, use_msl=False, msl_weights=None))(batch)
+    expected = jax.tree.map(lambda a: jnp.mean(a, axis=0), res.bn_state)
+
+    changed = False
+    for got, exp, old in zip(jax.tree.leaves(new_state.bn_state),
+                             jax.tree.leaves(expected),
+                             jax.tree.leaves(state.bn_state)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(exp),
+                                   rtol=1e-5, atol=1e-6)
+        changed = changed or not np.allclose(np.asarray(got),
+                                             np.asarray(old))
+    # The stats genuinely evolve (the reference's restore semantics
+    # would leave them at init) — this is the observable deviation.
+    assert changed
+
+    # Eval, by contrast, matches the reference: state untouched.
+    eval_step = jax.jit(make_eval_step(cfg, apply))
+    eval_step(new_state, batch)  # returns results only; nothing persisted

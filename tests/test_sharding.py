@@ -202,6 +202,26 @@ def test_microbatch_clamped_to_local_shard():
         make_sharded_steps(cfg_small, apply,
                            make_mesh(cfg_small, jax.devices()[:1]))
 
+    # ADVICE r4: a value sharing NO factor with a multi-task shard
+    # (mb=7 against local 16) was never legal at any geometry this
+    # config describes — clamping would silently run mb=1 and lose all
+    # accumulation benefit, so the plan must refuse instead. Callers
+    # that want the degradation pre-resolve via
+    # effective_task_microbatches (as bench.load_workload and
+    # ExperimentBuilder do).
+    cfg_bad = CFG.replace(mesh_shape=(1, 1), batch_size=16,
+                          task_microbatches=7)
+    with pytest.raises(ValueError, match="shares no factor"):
+        make_sharded_steps(cfg_bad, apply,
+                           make_mesh(cfg_bad, jax.devices()[:1]))
+    # ...but a 1-task-per-device shard (local == 1) keeps clamping:
+    # mb accumulation is meaningless there, not misconfigured.
+    cfg_dp = CFG.replace(mesh_shape=(1, 8), batch_size=8,
+                         task_microbatches=4)
+    with pytest.warns(UserWarning, match="clamping to gcd 1"):
+        make_sharded_steps(cfg_dp, apply,
+                           make_mesh(cfg_dp, jax.devices()[:8]))
+
 
 def test_resnet12_trains_on_sharded_mesh():
     """Regression (r2): resnet12's 1x1 skip projections, vmapped over
