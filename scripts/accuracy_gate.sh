@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# One-command REAL-DATA accuracy gate (VERDICT r4 next #2): full shipped
+# schedule -> 600-episode top-5-ensemble test -> JSON pass/fail vs the
+# BASELINE.md MAML++ paper table. Refuses synthetic data; a missing
+# dataset directory fails onto maybe_unzip_dataset's instructions.
+#
+#   bash scripts/accuracy_gate.sh \
+#       --config experiment_config/mini-imagenet_maml++_5-way_5-shot_DA.json
+#
+# Exit: 0 pass, 2 accuracy below gate, 1 error. See scripts/accuracy_gate.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python scripts/accuracy_gate.py "$@"

@@ -1,0 +1,212 @@
+"""On-chip bf16-vs-f32 drift bound at the FLAGSHIP geometry (VERDICT r4
+next #3).
+
+The torch-oracle parity suite runs on CPU in f32, so the one numerics
+risk it cannot see is what the SHIPPED step (compute_dtype=bfloat16 +
+bn_fast_math folded statistics) does to logits, meta-gradients and a
+training trajectory at the real 84x84x3 / 48-filter / K=5 geometry on
+the real chip. This script measures exactly that, against the f32
+reference path (compute_dtype=float32, bn_fast_math=False — the
+bit-compatible-with-torch configuration the parity tests pin), with
+params held in f32 in BOTH variants (param_dtype is always float32; only
+conv/matmul compute and the BN statistics path differ).
+
+Measured quantities, each printed as a JSON line:
+
+1. eval-path adapted logits at a fresh init: max/mean abs diff and the
+   argmax (prediction) agreement rate over B*N*T predictions — the
+   metric accuracy actually depends on;
+2. one train step: |loss_bf16 - loss_f32| and per-parameter-group
+   relative L2 drift of the POST-UPDATE parameters (meta-gradient drift
+   as Adam actually consumes it);
+3. a --steps N trajectory (default 50) driven from the same init on the
+   same episode stream: per-step loss gap plus final-parameter relative
+   drift — how the one-step drift compounds.
+
+Results are recorded in docs/PARITY.md § Flagship-geometry parity, with
+the tolerance argument. Usage:
+
+    python scripts/bf16_drift.py [--steps 50] [--batch 12]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bench
+from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+from howtotrainyourmamlpytorch_tpu.meta.outer import (
+    make_eval_step, make_train_step)
+from howtotrainyourmamlpytorch_tpu.models import make_model
+
+
+def rel_l2(a, b) -> float:
+    a = np.asarray(a, np.float64).ravel()
+    b = np.asarray(b, np.float64).ravel()
+    denom = np.linalg.norm(b)
+    return float(np.linalg.norm(a - b) / (denom or 1.0))
+
+
+def group_drift(params_a, params_b) -> dict:
+    out = {}
+    for name in params_a:
+        for leaf in params_a[name]:
+            out[f"{name}.{leaf}"] = rel_l2(params_a[name][leaf],
+                                           params_b[name][leaf])
+    return out
+
+
+def separable_batch(cfg, seed: int):
+    """Learnable episodes (class i pixels ~ N(i/N, 0.3)): both dtype
+    variants can actually converge, so END-STATE prediction agreement
+    measures accuracy parity rather than chaos on unlearnable noise."""
+    rng = np.random.RandomState(seed)
+    n, k, t, b = (cfg.num_classes_per_set, cfg.num_samples_per_class,
+                  cfg.num_target_samples, cfg.batch_size)
+    h, w, c = cfg.image_shape
+
+    def gen(per):
+        means = (np.arange(n) / n)[None, :, None, None, None, None]
+        x = rng.randn(b, n, per, h, w, c) * 0.3 + means
+        x = (np.clip(x, 0, 1) * 255).astype(np.uint8)
+        y = np.tile(np.repeat(np.arange(n), per)[None], (b, 1))
+        return x.reshape(b, n * per, h, w, c), y.astype(np.int32)
+
+    sx, sy = gen(k)
+    tx, ty = gen(t)
+    from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+    return Episode(sx, sy, tx, ty)
+
+
+def build(cfg):
+    init, apply = make_model(cfg)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    train = jax.jit(make_train_step(cfg, apply),
+                    static_argnames=("second_order", "use_msl"))
+    ev = jax.jit(make_eval_step(cfg, apply))
+    return state, train, ev
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=0)
+    ap.add_argument("--config", default=None)
+    args = ap.parse_args()
+
+    devices = bench.init_backend()
+    n_dev = len(devices)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    config_path = args.config or os.path.join(
+        repo, "experiment_config",
+        "mini-imagenet_maml++_5-way_5-shot_DA_b12.json")
+    cfg_b = bench.load_workload(config_path, args.batch, n_dev)
+    # mb is a memory lever, not numerics (accumulation is equivalence-
+    # tested); mb=1 keeps the two dtype variants' programs minimal and
+    # identical in structure.
+    cfg_b = cfg_b.replace(task_microbatches=1, mesh_shape=(1, 1),
+                          batch_size=max(cfg_b.batch_size // n_dev, 1))
+    cfg_f = cfg_b.replace(compute_dtype="float32", bn_fast_math=False)
+    steady = max(cfg_b.total_epochs - 1, 0)
+    so, msl = cfg_b.use_second_order(steady), cfg_b.use_msl(steady)
+
+    state_b, train_b, eval_b = build(cfg_b)
+    state_f, train_f, eval_f = build(cfg_f)
+
+    # 1. Eval-path adapted logits at the shared init.
+    ep = bench.synthetic_batch(cfg_b, 123)
+    rb = eval_b(state_b, ep)
+    rf = eval_f(state_f, ep)
+    lb = np.asarray(jax.device_get(rb.target_logits), np.float64)
+    lf = np.asarray(jax.device_get(rf.target_logits), np.float64)
+    agree = float((lb.argmax(-1) == lf.argmax(-1)).mean())
+    print(json.dumps({
+        "probe": "eval_logits", "workload": cfg_b.experiment_name,
+        "batch": cfg_b.batch_size,
+        "max_abs_diff": round(float(np.abs(lb - lf).max()), 5),
+        "mean_abs_diff": round(float(np.abs(lb - lf).mean()), 6),
+        "logit_scale_mean_abs": round(float(np.abs(lf).mean()), 4),
+        "argmax_agreement": agree,
+        "n_predictions": int(lb.shape[0] * lb.shape[1]),
+    }), flush=True)
+
+    # 2. One steady-state train step from the shared init.
+    sb, mb_ = train_b(state_b, ep, jnp.float32(steady),
+                      second_order=so, use_msl=msl)
+    sf, mf_ = train_f(state_f, ep, jnp.float32(steady),
+                      second_order=so, use_msl=msl)
+    drift = group_drift(jax.device_get(sb.params),
+                        jax.device_get(sf.params))
+    print(json.dumps({
+        "probe": "one_step", "second_order": so, "use_msl": msl,
+        "loss_bf16": round(float(jax.device_get(mb_.loss)), 6),
+        "loss_f32": round(float(jax.device_get(mf_.loss)), 6),
+        "post_update_param_rel_l2_max": round(max(drift.values()), 6),
+        "post_update_param_rel_l2": {k: round(v, 6)
+                                     for k, v in sorted(drift.items())},
+    }), flush=True)
+
+    # 3. Trajectories: same stream, both dtypes, from the shared init.
+    # Noise stream = worst-case parameter decoherence (unlearnable, so
+    # trajectories amplify per-step drift chaotically — true of any two
+    # f32 backends as well); separable stream = the accuracy-relevant
+    # question (both converge; do they AGREE where it matters?).
+    for stream, make_batch in (("noise", bench.synthetic_batch),
+                               ("separable", separable_batch)):
+        losses_b, losses_f, acc_b, acc_f = [], [], [], []
+        state_b2, _, _ = build(cfg_b)
+        state_f2, _, _ = build(cfg_f)
+        for t in range(args.steps):
+            ep_t = make_batch(cfg_b, 1000 + t)
+            state_b2, m_b = train_b(state_b2, ep_t, jnp.float32(steady),
+                                    second_order=so, use_msl=msl)
+            state_f2, m_f = train_f(state_f2, ep_t, jnp.float32(steady),
+                                    second_order=so, use_msl=msl)
+            losses_b.append(float(jax.device_get(m_b.loss)))
+            losses_f.append(float(jax.device_get(m_f.loss)))
+            acc_b.append(float(jax.device_get(m_b.accuracy)))
+            acc_f.append(float(jax.device_get(m_f.accuracy)))
+        gaps = np.abs(np.asarray(losses_b) - np.asarray(losses_f))
+        drift_end = group_drift(jax.device_get(state_b2.params),
+                                jax.device_get(state_f2.params))
+        # End-state eval on a HELD-OUT batch of the same stream.
+        ep_h = make_batch(cfg_b, 99)
+        re_b = eval_b(state_b2, ep_h)
+        re_f = eval_f(state_f2, ep_h)
+        lb2 = np.asarray(jax.device_get(re_b.target_logits))
+        lf2 = np.asarray(jax.device_get(re_f.target_logits))
+        labels = np.asarray(ep_h.target_y)
+        print(json.dumps({
+            "probe": "trajectory", "stream": stream, "steps": args.steps,
+            "loss_gap_max": round(float(gaps.max()), 5),
+            "loss_gap_final": round(float(gaps[-1]), 5),
+            "loss_final_bf16": round(losses_b[-1], 5),
+            "loss_final_f32": round(losses_f[-1], 5),
+            "train_acc_final_bf16": round(acc_b[-1], 4),
+            "train_acc_final_f32": round(acc_f[-1], 4),
+            "final_param_rel_l2_max": round(max(drift_end.values()), 5),
+            "final_param_rel_l2_median": round(
+                float(np.median(list(drift_end.values()))), 5),
+            "final_param_rel_l2": {k: round(v, 5)
+                                   for k, v in sorted(drift_end.items())},
+            "end_state_argmax_agreement": round(
+                float((lb2.argmax(-1) == lf2.argmax(-1)).mean()), 4),
+            "end_state_eval_acc_bf16": round(
+                float((lb2.argmax(-1) == labels).mean()), 4),
+            "end_state_eval_acc_f32": round(
+                float((lf2.argmax(-1) == labels).mean()), 4),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

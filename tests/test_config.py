@@ -4,6 +4,9 @@ import pytest
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 
+pytestmark = pytest.mark.core  # <5-min pre-commit gate tier
+
+
 
 def test_defaults_valid():
     cfg = MAMLConfig()

@@ -333,3 +333,61 @@ def test_gdrive_fetcher_direct_stream(tmp_path, monkeypatch):
     dataset_tools.gdrive_fetcher(
         "https://drive.google.com/file/d/abc123/view", dest)
     assert open(dest, "rb").read() == b"bytes"
+
+
+def test_wrong_download_full_walk_no_partial_state(tmp_path, monkeypatch):
+    """VERDICT r4 next #8 — the ENTIRE wrong-download rejection path in
+    one test, through the REAL gdrive fetcher (stubbed HTTP opener, not
+    a lambda): download (interstitial + confirm replay, .part+rename) →
+    extract → class-count tripwire → reject → cleanup. After the
+    failure, NO partial state may survive anywhere the resolution order
+    looks — no dataset dir, no zip, no .part — so a restarted job
+    re-fails identically instead of accepting the rejected bytes via
+    the ready-directory or found-zip short-circuits."""
+    import urllib.request
+
+    from howtotrainyourmamlpytorch_tpu.utils import dataset_tools
+
+    # A real zip whose class counts are WRONG for the packaged dataset
+    # (1 class per split vs EXPECTED_SPLIT_CLASSES's 64/16/20).
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        for split in ("train", "val", "test"):
+            zf.writestr(f"mini_imagenet_full_size/{split}/only_class/"
+                        f"im0.png", _png_bytes())
+    wrong_zip = buf.getvalue()
+    html = (b'<html><form action="https://drive.usercontent.google.com/'
+            b'download"><input type="hidden" name="confirm" value="tok">'
+            b'</form></html>')
+    calls = []
+
+    class Resp(io.BytesIO):
+        def __init__(self, body, ctype):
+            super().__init__(body)
+            self.headers = {"Content-Type": ctype}
+
+    class Opener:
+        def open(self, url, timeout=None):
+            calls.append(url)
+            if len(calls) % 2 == 1:  # every attempt: interstitial first
+                return Resp(html, "text/html; charset=utf-8")
+            return Resp(wrong_zip, "application/zip")
+
+    monkeypatch.setattr(urllib.request, "build_opener",
+                        lambda *a, **k: Opener())
+    cfg = MAMLConfig(dataset_name="mini_imagenet_full_size",
+                     dataset_path=str(tmp_path / "mini_imagenet_full_size"))
+    with pytest.raises(ValueError, match="class directories"):
+        maybe_unzip_dataset(cfg, fetcher=dataset_tools.gdrive_fetcher,
+                            require=True)
+    # The confirm flow really ran (2 HTTP calls) and then everything the
+    # walk created was torn down.
+    assert len(calls) == 2
+    assert os.listdir(tmp_path) == []
+
+    # Restarted job: same failure again (nothing cached), same cleanup.
+    with pytest.raises(ValueError, match="class directories"):
+        maybe_unzip_dataset(cfg, fetcher=dataset_tools.gdrive_fetcher,
+                            require=True)
+    assert len(calls) == 4
+    assert os.listdir(tmp_path) == []

@@ -157,8 +157,10 @@ _RESNET_CFG = _VGG_CFG.replace(
 _EVAL_GATHER_MAX_BYTES = 1 << 20
 
 
-@pytest.mark.parametrize("cfg", [_VGG_CFG, _RESNET_CFG],
-                         ids=["vgg_msl", "resnet12_micro"])
+@pytest.mark.parametrize(
+    "cfg",
+    [pytest.param(_VGG_CFG, marks=pytest.mark.core), _RESNET_CFG],
+    ids=["vgg_msl", "resnet12_micro"])
 def test_collective_inventory(cfg):
     results = _audit(cfg)
 

@@ -5,11 +5,15 @@ autograd oracle (create_graph=False/True) on a tiny linear model."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import torch
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.meta import inner
 from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+
+pytestmark = pytest.mark.core  # <5-min pre-commit gate tier
+
 
 
 def reference_msl_schedule(k, msl_epochs, epoch):

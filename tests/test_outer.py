@@ -6,6 +6,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.meta import (
@@ -95,6 +96,7 @@ def test_first_order_step_runs_and_differs():
     assert max(jax.tree.leaves(d)) > 1e-7
 
 
+@pytest.mark.core
 def test_lslr_frozen_when_not_learnable():
     cfg = CFG.replace(
         learnable_per_layer_per_step_inner_loop_learning_rate=False)
@@ -109,6 +111,7 @@ def test_lslr_frozen_when_not_learnable():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.core
 def test_lslr_updates_when_learnable():
     init, apply = make_model(CFG)
     state = init_train_state(CFG, init, jax.random.PRNGKey(0))
@@ -122,6 +125,7 @@ def test_lslr_updates_when_learnable():
     assert max(diffs) > 0
 
 
+@pytest.mark.core
 def test_bnwb_flags_freeze_gamma_beta():
     """learnable_bn_gamma/beta=False must leave γ/β at their 1/0 init
     (reference: requires_grad flags on MetaBatchNormLayer weight/bias)."""
@@ -155,6 +159,7 @@ def test_eval_steps_exceed_train_steps():
     assert state.lslr["conv0"]["w"].shape == (5,)  # max(train,eval)+1
 
 
+@pytest.mark.core
 def test_cosine_schedule_endpoints():
     sched = meta_lr_schedule(CFG)
     assert abs(float(sched(0)) - CFG.meta_learning_rate) < 1e-9
@@ -224,6 +229,7 @@ def test_block_outs_remat_and_fast_bn_match_default_grads():
                                    rtol=5e-3, atol=1e-5)
 
 
+@pytest.mark.core
 def test_task_microbatch_accumulation_matches_single_shot():
     """Grad accumulation over task micro-batches reproduces the one-shot
     step exactly: same loss/metrics and same post-step state."""
@@ -255,6 +261,7 @@ def test_task_microbatch_accumulation_matches_single_shot():
                                    rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.core
 def test_task_microbatches_must_divide_batch():
     import pytest
     init, apply = make_model(CFG.replace(task_microbatches=3))
@@ -315,6 +322,7 @@ def test_eval_adaptation_gain_on_permuted_tasks():
     assert acc3 > 0.99, acc3              # full adaptation solves the task
 
 
+@pytest.mark.core
 def test_pre_k_plus_1_lslr_checkpoint_migrates():
     """A checkpoint holding the pre-r2 (K,)-row LSLR format must resume:
     migrate_lslr_rows pads the init row + zero Adam moments, and the
@@ -356,6 +364,7 @@ def test_pre_k_plus_1_lslr_checkpoint_migrates():
     assert migrate_lslr_rows(CFG, state) is state
 
 
+@pytest.mark.core
 def test_train_step_persists_task_mean_bn_state():
     """KNOWN DEVIATION from the reference, asserted here so the shipped
     semantics cannot drift silently (VERDICT r4 weak #4; MOUNT-AUDIT

@@ -18,6 +18,9 @@ CFG = MAMLConfig(image_height=8, image_width=8, image_channels=1,
                  number_of_evaluation_steps_per_iter=2,
                  compute_dtype="float32")
 
+pytestmark = pytest.mark.core  # <5-min pre-commit gate tier
+
+
 
 def test_experiment_folder_layout(tmp_path):
     paths = build_experiment_folder(str(tmp_path), "exp1")

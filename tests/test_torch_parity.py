@@ -98,9 +98,10 @@ def torch_forward(params, x_nhwc, step, cfg=CFG, running=None):
     return F.linear(x, w, b)
 
 
-def jax_params_to_torch(params, requires_grad=False):
+def jax_params_to_torch(params, requires_grad=False, cfg=None):
+    cfg = cfg or CFG
     out = {}
-    for i in range(CFG.num_stages):
+    for i in range(cfg.num_stages):
         out[f"conv{i}"] = _to_torch_conv(params[f"conv{i}"])
         out[f"norm{i}_gamma"] = torch.tensor(
             np.asarray(params[f"norm{i}"]["gamma"]))
@@ -123,6 +124,7 @@ def model():
     return apply, params, bn_state
 
 
+@pytest.mark.core
 def test_forward_parity(model):
     apply, params, bn_state = model
     ep = _episode()
@@ -135,6 +137,7 @@ def test_forward_parity(model):
                                rtol=1e-4, atol=2e-4)
 
 
+@pytest.mark.core
 def test_batch_norm_running_stats_match_torch_convention(model):
     """Our running-stat update must follow torch's momentum convention
     (r <- (1-m) r + m batch, unbiased var) at the indexed step row."""
@@ -190,6 +193,7 @@ def _torch_meta_grad(params, bn_state, ep, second_order):
     return float(t_loss.detach()), tp
 
 
+@pytest.mark.core
 @pytest.mark.parametrize("second_order", [False, True])
 def test_meta_gradient_parity(model, second_order):
     """The defining computation: d(target loss after K adapted steps)/dθ0
@@ -226,6 +230,7 @@ def test_meta_gradient_parity(model, second_order):
                                err_msg="linear w meta-grad")
 
 
+@pytest.mark.core
 def test_lslr_gradient_parity(model):
     """Meta-gradient wrt the per-step inner learning rates (the LSLR
     feature's trainable quantity). Oracle: per-(layer,step) scalar lr
@@ -343,7 +348,7 @@ def _torch_trajectory(cfg, params0, bn0, batches):
     task batch; one Adam step at the per-epoch cosine LR."""
     k_inner = cfg.number_of_training_steps_per_iter
     fast_keys = [f"conv{i}" for i in range(cfg.num_stages)] + ["linear"]
-    tp = jax_params_to_torch(params0, requires_grad=True)
+    tp = jax_params_to_torch(params0, requires_grad=True, cfg=cfg)
     lslr = {(key, leaf): torch.full((cfg.lslr_num_steps,),
                                     cfg.task_learning_rate,
                                     requires_grad=True)
