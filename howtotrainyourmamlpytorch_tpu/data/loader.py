@@ -24,12 +24,15 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.data.sampler import EpisodeSampler
 from howtotrainyourmamlpytorch_tpu.data.sources import build_source
 from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+from howtotrainyourmamlpytorch_tpu.telemetry.instruments import (
+    FeedStallMeter)
 
 _STOP = object()
 
@@ -42,6 +45,12 @@ class MetaLearningDataLoader:
         self.cfg = cfg
         self.mesh = mesh
         self._samplers = {}
+        # Data-stall telemetry for the TRAIN feed: cumulative consumer
+        # wait (input pipeline not ready) vs dispatch (consumer busy)
+        # seconds. The experiment loop snapshots per epoch; eval sweeps
+        # are not metered — feed_stall_frac diagnoses the training hot
+        # loop (docs/PERF.md § Observability).
+        self.feed = FeedStallMeter()
         # Multi-host: each process samples only the episode positions that
         # land on its own chips (parallel/multihost.py). Deterministic
         # episode streams make this coordination-free.
@@ -125,16 +134,27 @@ class MetaLearningDataLoader:
                 put_bounded(e)
             put_bounded(_STOP)
 
+        # Train-feed stall metering: time blocked in q.get() is input-
+        # pipeline stall; time inside `yield` is the consumer's step
+        # dispatch. The split is what makes "are we input-bound?" a
+        # number instead of a profiler session (telemetry/instruments.py).
+        meter = self.feed if split == "train" else None
         t = threading.Thread(target=worker, daemon=True)
         t.start()
         try:
             while True:
+                t0 = time.perf_counter()
                 item = q.get()
+                if meter is not None:
+                    meter.record_wait(time.perf_counter() - t0)
                 if item is _STOP:
                     break
                 if isinstance(item, Exception):
                     raise item
+                t1 = time.perf_counter()
                 yield item
+                if meter is not None:
+                    meter.record_dispatch(time.perf_counter() - t1)
         finally:
             # Consumer abandoned (error or early break): stop the worker
             # instead of letting it produce the rest of the epoch.

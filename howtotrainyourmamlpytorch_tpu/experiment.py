@@ -43,6 +43,9 @@ from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
     agree_int_from_main, any_process_true, barrier)
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
     LATEST, CheckpointManager)
+from howtotrainyourmamlpytorch_tpu.telemetry import (
+    FeedStallMeter, MetricsRegistry, device_memory_stats, emit_heartbeat)
+from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
 from howtotrainyourmamlpytorch_tpu.utils.storage import (
     build_experiment_folder, save_statistics, save_to_json)
 from howtotrainyourmamlpytorch_tpu.utils.tracing import (
@@ -113,6 +116,17 @@ class ExperimentBuilder:
 
         self.jsonl = JsonlLogger(f"{self.paths['logs']}/events.jsonl",
                                  enabled=self.is_main_process)
+        # Telemetry (docs/PERF.md § Observability): every numeric the
+        # run reports goes through the registry, which fans out to
+        # events.jsonl and a Prometheus textfile snapshot. The compile
+        # watcher (None until run) is installed at run_experiment entry
+        # and removed in its finally, so a builder that is constructed
+        # but never run (sweep drivers, failed constructions) cannot
+        # leak the process-wide listener. Same lazy pattern as the
+        # TensorBoard writer below.
+        self.registry = MetricsRegistry()
+        self._compile_watch = None
+        self._feed_prev: Optional[Dict[str, float]] = None
         self._tb = None             # lazy SummaryWriter (_finish_epoch)
         self._tb_disabled = False   # set if tensorboardX import fails
         self.state = init_train_state(cfg, self.model_init,
@@ -410,12 +424,73 @@ class ExperimentBuilder:
         }
         # Timer keys are prefixed: they measure host dispatch intervals
         # (async), distinct from the synced whole-epoch throughput above.
+        tsum = timer.summary(cfg.batch_size, self.mesh.size)
         self.jsonl.log("train_epoch", epoch=epoch, iter=self.current_iter,
                        **stats,
-                       **{f"dispatch_{k}": v for k, v in
-                          timer.summary(cfg.batch_size,
-                                        self.mesh.size).items()})
+                       **{f"dispatch_{k}": v for k, v in tsum.items()})
+        self._emit_epoch_telemetry(epoch, timer, tsum, stats)
         return stats
+
+    def _emit_epoch_telemetry(self, epoch: int, timer: StepTimer,
+                              tsum: Dict[str, float],
+                              stats: Dict[str, float]) -> None:
+        """Per-epoch observability rollup: registry update + one
+        ``telemetry`` row + one fleet ``heartbeat`` row.
+
+        Called by EVERY process at the same loop point — the heartbeat's
+        per-host gather is a collective, and the single-writer JsonlLogger
+        keeps the stream at one row per event fleet-wide. Each fail-soft
+        metric (memory, compile events) degrades to an explicit null the
+        report prints as "unavailable", never to a fake zero.
+        """
+        reg = self.registry
+        for key, value in stats.items():
+            reg.gauge(f"train/{key}").set(value)
+        hist = reg.histogram("step_seconds")
+        for dt in timer.durations:
+            hist.observe(dt)
+
+        # Feed stall: per-epoch delta of the loader's cumulative meters
+        # (the loader outlives epochs; deltas keep epochs comparable).
+        feed_now = self.data.feed.snapshot()
+        feed = FeedStallMeter.delta(feed_now, self._feed_prev)
+        self._feed_prev = feed_now
+        reg.gauge("feed/stall_frac").set(feed["feed_stall_frac"])
+
+        mem = device_memory_stats()  # None on backends without stats
+        if mem is not None:
+            reg.gauge("memory/live_bytes_total").set(
+                mem["live_bytes_total"])
+            reg.gauge("memory/peak_bytes_max_device").set(
+                mem["peak_bytes_max_device"])
+
+        # "Installed but never saw a compile" also degrades to null: a
+        # real run compiles at least one executable before its first
+        # telemetry row, so a permanently-unseen event key (renamed by a
+        # jax upgrade) must read as unavailable, not a measured zero.
+        watch = self._compile_watch
+        have_compiles = (watch is not None and watch.installed
+                         and watch.saw_compile)
+        self.jsonl.log(
+            "telemetry", epoch=epoch, iter=self.current_iter,
+            step_seconds_p50=tsum.get("p50_step_seconds"),
+            step_seconds_p95=tsum.get("p95_step_seconds"),
+            step_seconds_mean=tsum.get("mean_step_seconds"),
+            meta_tasks_per_sec_per_chip=stats.get(
+                "meta_tasks_per_sec_per_chip"),
+            compile_count_total=(watch.count if have_compiles else None),
+            compile_seconds_total=(watch.seconds if have_compiles
+                                   else None),
+            feed_wait_seconds=feed["feed_wait_seconds"],
+            feed_dispatch_seconds=feed["feed_dispatch_seconds"],
+            feed_stall_frac=feed["feed_stall_frac"],
+            memory=mem)
+        # Straggler visibility: every host contributes its local dispatch
+        # mean; the row carries the per-host vector + skew_frac.
+        emit_heartbeat(self.jsonl, epoch=epoch,
+                       iteration=self.current_iter,
+                       local_mean_step_seconds=tsum.get(
+                           "mean_step_seconds", 0.0))
 
     def _eval_batches(self, split: str) -> Iterable:
         """The split's fixed evaluation batches, device-cached after the
@@ -455,9 +530,20 @@ class ExperimentBuilder:
 
     # ------------------------------------------------------------------
     def run_experiment(self) -> Dict[str, Any]:
+        # The compile listener counts EVERY in-process XLA compile while
+        # the run is live — expected ones (phase executables) and
+        # unexpected ones (a shape change silently retracing every
+        # epoch), which is the point. Installed here, not in __init__,
+        # so a builder that is never run cannot leak the process-wide
+        # listener.
+        self._compile_watch = instrument_compiles(self.registry)
         try:
             return self._run_experiment()
         finally:
+            # Detach the process-wide compile listener (a sweep driver
+            # may build many ExperimentBuilders; each should count only
+            # its own compiles).
+            self._compile_watch.uninstall()
             if self._tb is not None:
                 # Release the async writer thread + event-file handle (a
                 # sweep driver may build many ExperimentBuilders).
@@ -515,6 +601,17 @@ class ExperimentBuilder:
         self.jsonl.log("validation", epoch=epoch,
                        val_loss=val_stats["loss"],
                        val_accuracy=val_stats["accuracy"])
+        # The printed line below is sourced from the registry's view:
+        # every number a human sees is also a scraped/reported metric.
+        self.registry.gauge("val/loss").set(val_stats["loss"])
+        self.registry.gauge("val/accuracy").set(val_stats["accuracy"])
+        self.registry.gauge("progress/epoch").set(epoch)
+        self.registry.flush_jsonl(self.jsonl, epoch=epoch)
+        if self.is_main_process:
+            # Prometheus textfile snapshot (node-exporter sidecar
+            # format), one atomic rewrite per epoch.
+            self.registry.write_prometheus(
+                f"{self.paths['logs']}/metrics.prom")
         if (self.cfg.use_tensorboard and self.is_main_process
                 and not self._tb_disabled):
             # Created lazily at first scalar write: an __init__-time
@@ -609,6 +706,16 @@ class ExperimentBuilder:
         self.jsonl.log("test_protocol", **{
             k: v for k, v in result.items() if k != "per_model_accuracy"},
             per_model_accuracy=per_model_acc)
+        # Test protocol prints route through the registry like the epoch
+        # loop's: the final snapshot lands in metrics.prom + events.jsonl.
+        self.registry.gauge("test/accuracy_mean").set(
+            result["test_accuracy_mean"])
+        self.registry.gauge("test/accuracy_std").set(
+            result["test_accuracy_std"])
+        self.registry.flush_jsonl(self.jsonl, phase="test_protocol")
+        if self.is_main_process:
+            self.registry.write_prometheus(
+                f"{self.paths['logs']}/metrics.prom")
         print(f"test: {result['test_accuracy_mean']:.4f} "
               f"± {result['test_accuracy_std']:.4f} "
               f"({result['num_models']}-model ensemble, "

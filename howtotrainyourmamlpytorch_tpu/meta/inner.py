@@ -197,20 +197,27 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
         batched_msl = False
 
     def inner_step(carry, step):
+        # named_scope labels reach the lowered HLO's op metadata: a
+        # trace capture then splits the step profile into support
+        # forward/grad vs LSLR update vs MSL target forward instead of
+        # one anonymous while-loop body (docs/PERF.md § Observability).
         fast, bn = carry
 
         def support_loss_fn(f):
-            logits, bn2 = apply_fn(merge_fast_slow(f, slow), bn,
-                                   episode.support_x, step, True)
-            return cross_entropy(logits, episode.support_y), bn2
+            with jax.named_scope("inner_support_forward"):
+                logits, bn2 = apply_fn(merge_fast_slow(f, slow), bn,
+                                       episode.support_x, step, True)
+                return cross_entropy(logits, episode.support_y), bn2
 
-        (s_loss, bn), grads = jax.value_and_grad(
-            support_loss_fn, has_aux=True)(fast)
+        with jax.named_scope("inner_support_grad"):
+            (s_loss, bn), grads = jax.value_and_grad(
+                support_loss_fn, has_aux=True)(fast)
         if not second_order:
             # create_graph=False semantics: inner grads are constants to the
             # outer differentiation.
             grads = jax.lax.stop_gradient(grads)
-        fast = _lslr_update(fast, grads, lslr, step)
+        with jax.named_scope("inner_lslr_update"):
+            fast = _lslr_update(fast, grads, lslr, step)
 
         if batched_msl:
             # Post-update fast weights are stacked by the scan; the target
@@ -219,9 +226,10 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
         if use_msl:
             # Reference MSL: target forward *after* the update, at the same
             # per-step BN index as the step just taken.
-            t_logits, bn = apply_fn(merge_fast_slow(fast, slow), bn,
-                                    episode.target_x, step, True)
-            t_loss = cross_entropy(t_logits, episode.target_y)
+            with jax.named_scope("inner_msl_target_forward"):
+                t_logits, bn = apply_fn(merge_fast_slow(fast, slow), bn,
+                                        episode.target_x, step, True)
+                t_loss = cross_entropy(t_logits, episode.target_y)
         else:
             t_logits = jnp.zeros(
                 (episode.target_y.shape[0], cfg.num_classes_per_set),
@@ -267,10 +275,11 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
             loss = jnp.sum(msl_weights[:num_steps] * t_losses)
             final_logits = t_logits_steps[-1]
         else:
-            final_logits, bn = apply_fn(merge_fast_slow(fast, slow), bn,
-                                        episode.target_x,
-                                        jnp.int32(num_steps - 1), True)
-            loss = cross_entropy(final_logits, episode.target_y)
+            with jax.named_scope("final_target_forward"):
+                final_logits, bn = apply_fn(merge_fast_slow(fast, slow), bn,
+                                            episode.target_x,
+                                            jnp.int32(num_steps - 1), True)
+                loss = cross_entropy(final_logits, episode.target_y)
 
     return TaskResult(
         loss=loss,

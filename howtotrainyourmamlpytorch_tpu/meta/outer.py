@@ -216,11 +216,15 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
 
         def batch_loss(trainable, bn_state, chunk):
             def one_task(ep: Episode) -> TaskResult:
-                return task_forward(
-                    cfg, apply_fn, trainable["params"], trainable["lslr"],
-                    bn_state, ep, num_steps=num_steps,
-                    second_order=second_order, use_msl=use_msl,
-                    msl_weights=msl_w)
+                # Scope label survives into the HLO op metadata: trace
+                # captures attribute inner-loop work to "task_adapt"
+                # (telemetry subsystem, docs/PERF.md § Observability).
+                with jax.named_scope("task_adapt"):
+                    return task_forward(
+                        cfg, apply_fn, trainable["params"],
+                        trainable["lslr"], bn_state, ep,
+                        num_steps=num_steps, second_order=second_order,
+                        use_msl=use_msl, msl_weights=msl_w)
             res = jax.vmap(one_task)(chunk)
             # Mean over the task shard; under a mesh XLA turns these means
             # into psums over the tasks axis — the single collective per
@@ -292,9 +296,10 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
             grads["params"] = jax.tree.map(lambda g: jnp.clip(g, -c, c),
                                            grads["params"])
 
-        updates, new_opt_state = optimizer.update(grads, state.opt_state,
-                                                  trainable)
-        new_trainable = optax.apply_updates(trainable, updates)
+        with jax.named_scope("meta_update"):
+            updates, new_opt_state = optimizer.update(
+                grads, state.opt_state, trainable)
+            new_trainable = optax.apply_updates(trainable, updates)
         new_state = MetaTrainState(
             params=new_trainable["params"], lslr=new_trainable["lslr"],
             bn_state=new_bn, opt_state=new_opt_state, step=state.step + 1)

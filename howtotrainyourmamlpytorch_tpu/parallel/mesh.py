@@ -46,6 +46,19 @@ from howtotrainyourmamlpytorch_tpu.meta.outer import (
     make_eval_step, make_train_step)
 
 
+def _shard_map(f, *, mesh: Mesh, in_specs, out_specs, check_vma: bool):
+    """``jax.shard_map`` across the jax versions this repo meets: the
+    public API (jax >= 0.5, ``check_vma``) when present, else the
+    ``jax.experimental.shard_map`` original (``check_rep`` — the same
+    replication check under its pre-stabilization name)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map
+    return shard_map(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check_vma)
+
+
 def make_mesh(cfg: MAMLConfig,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the (dcn, tasks) mesh. ``mesh_shape`` must multiply to the
@@ -163,7 +176,7 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
     train_steps = {}
     for so in (False, True):
         for msl in (False, True):
-            smapped = jax.shard_map(
+            smapped = _shard_map(
                 functools.partial(train_step, second_order=so, use_msl=msl),
                 mesh=mesh,
                 in_specs=(P(), batch_spec, P()),
@@ -180,7 +193,7 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
             )
 
     eval_step = jax.jit(
-        jax.shard_map(
+        _shard_map(
             make_eval_step(cfg, apply_fn, gather_axes=axes),
             mesh=mesh,
             in_specs=(P(), batch_spec),

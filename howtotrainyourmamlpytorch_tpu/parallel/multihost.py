@@ -118,6 +118,23 @@ def agree_int_from_main(value: int) -> int:
         np.asarray([int(value)]))[0])
 
 
+def gather_host_floats(value: float) -> List[float]:
+    """All-gather one host-level float per process, ordered by process
+    index (single-process: ``[value]``). The telemetry heartbeat's
+    transport: every host contributes its local step-time mean and every
+    host sees the full per-host vector, so process 0 can log straggler
+    skew while the others (disabled single-writer loggers) compute the
+    identical row. A collective — every process must call it at the same
+    program point, like :func:`any_process_true`.
+    """
+    if jax.process_count() <= 1:
+        return [float(value)]
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(
+        np.asarray([float(value)], dtype=np.float64))
+    return [float(v) for v in np.asarray(gathered).reshape(-1)]
+
+
 def barrier(tag: str) -> None:
     """Cross-process barrier (no-op single-process).
 

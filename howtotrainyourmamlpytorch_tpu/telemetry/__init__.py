@@ -1,0 +1,42 @@
+"""Telemetry subsystem: metrics registry, instrumentation, aggregation.
+
+Upgrades ``utils/tracing.py``'s wall-clock-only view into a real
+observability layer (step times, XLA compiles, device memory, feed
+stalls, per-host skew) flushed to the existing ``events.jsonl`` stream
+and a Prometheus textfile snapshot. ``scripts/telemetry_report.py`` is
+the reader; docs/PERF.md § Observability explains each metric.
+"""
+
+from howtotrainyourmamlpytorch_tpu.telemetry.aggregate import (
+    emit_heartbeat,
+    heartbeat_rows,
+    host_step_skew,
+)
+from howtotrainyourmamlpytorch_tpu.telemetry.instruments import (
+    COMPILE_COUNT,
+    COMPILE_SECONDS,
+    CompileWatcher,
+    FeedStallMeter,
+    device_memory_stats,
+)
+from howtotrainyourmamlpytorch_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    exponential_buckets,
+)
+from howtotrainyourmamlpytorch_tpu.telemetry.report import (
+    SCHEMA,
+    UNAVAILABLE,
+    format_table,
+    summarize_events,
+)
+
+__all__ = [
+    "COMPILE_COUNT", "COMPILE_SECONDS", "CompileWatcher", "Counter",
+    "FeedStallMeter", "Gauge", "Histogram", "MetricsRegistry", "SCHEMA",
+    "UNAVAILABLE", "device_memory_stats", "emit_heartbeat",
+    "exponential_buckets", "format_table", "heartbeat_rows",
+    "host_step_skew", "summarize_events",
+]

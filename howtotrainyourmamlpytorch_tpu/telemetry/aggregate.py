@@ -1,0 +1,68 @@
+"""Multi-host telemetry aggregation: heartbeats and straggler skew.
+
+On a pod, per-host observability is the difference between "the run is
+slow" and "host 3 is slow". Every process computes its local step-time
+mean; :func:`host_step_skew` all-gathers the per-host vector (over the
+existing ``parallel/multihost.py`` collectives, so it composes with the
+repo's SPMD discipline), and :func:`emit_heartbeat` logs ONE row per
+heartbeat under the established single-writer rule — every process calls
+it at the same program point (the gather is a collective), every process
+builds the identical row, and only the process whose ``JsonlLogger`` is
+``enabled`` (process 0) writes it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
+    gather_host_floats)
+from howtotrainyourmamlpytorch_tpu.utils.tracing import JsonlLogger
+
+HEARTBEAT_EVENT = "heartbeat"
+
+
+def host_step_skew(local_mean_step_seconds: float) -> Dict[str, Any]:
+    """Per-host step-time vector + straggler summary.
+
+    COLLECTIVE: every process must call this at the same program point
+    (it rides ``process_allgather``). ``skew_frac`` is
+    ``(max - mean) / mean`` over hosts — 0.0 when perfectly balanced;
+    0.2 means the slowest host (which paces every collective) runs 20%
+    behind the fleet average.
+    """
+    values = gather_host_floats(local_mean_step_seconds)
+    finite = [v for v in values if v > 0]
+    if not finite:
+        return {"hosts": len(values), "host_mean_step_seconds": values,
+                "skew_frac": 0.0, "slowest_host": 0}
+    mean = sum(finite) / len(finite)
+    worst = max(values)
+    return {
+        "hosts": len(values),
+        "host_mean_step_seconds": values,
+        "skew_frac": (worst - mean) / mean if mean > 0 else 0.0,
+        "slowest_host": int(values.index(worst)),
+    }
+
+
+def emit_heartbeat(jsonl: JsonlLogger, *, epoch: int, iteration: int,
+                   local_mean_step_seconds: float,
+                   process_index: Optional[int] = None,
+                   **extra: Any) -> Dict[str, Any]:
+    """One heartbeat row per call ACROSS the fleet (not one per host).
+
+    Collective (see :func:`host_step_skew`); the returned row is the
+    same on every process. Extra payload (memory stats, feed stall) is
+    merged into the row.
+    """
+    if process_index is None:
+        import jax
+        process_index = jax.process_index()
+    skew = host_step_skew(local_mean_step_seconds)
+    return jsonl.log(HEARTBEAT_EVENT, epoch=epoch, iter=iteration,
+                     process_index=process_index, **skew, **extra)
+
+
+def heartbeat_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return [e for e in events if e.get("event") == HEARTBEAT_EVENT]

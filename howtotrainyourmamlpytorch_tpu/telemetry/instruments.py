@@ -1,0 +1,181 @@
+"""Instrumentation hooks: XLA compiles, device memory, input-pipeline stalls.
+
+Three measurements BENCH_r05's MFU 0.039 cannot currently explain,
+each fail-soft (observability must never abort training):
+
+* :class:`CompileWatcher` — every XLA backend compile in-process, counted
+  via ``jax.monitoring``'s duration-event stream (the channel XLA itself
+  reports ``backend_compile`` timings on). Catches compiles the code did
+  NOT expect — an inner-loop shape change silently retracing every epoch
+  shows up as a rising ``compile/count`` instead of a mystery slowdown.
+* :func:`device_memory_stats` — live/peak HBM bytes per device via
+  ``Device.memory_stats()``; backends without allocator stats (CPU, some
+  tunneled PJRT clients) yield ``None`` and the report prints an explicit
+  "unavailable" marker rather than a fake zero.
+* :class:`FeedStallMeter` — consumer-side wait-vs-dispatch split of the
+  training feed (data/loader.py): the fraction of loop wall-clock spent
+  blocked on the next batch. This is the host-feed-bound diagnostic
+  (docs/PERF.md § Host-feed bound) made always-on.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+from howtotrainyourmamlpytorch_tpu.telemetry.registry import MetricsRegistry
+
+# The jax.monitoring duration-event key XLA reports backend compiles on
+# (jax 0.4.x: "/jax/core/compile/backend_compile_duration").
+_COMPILE_KEY_SUFFIX = "backend_compile_duration"
+
+COMPILE_COUNT = "compile/count"
+COMPILE_SECONDS = "compile/seconds"
+
+
+class CompileWatcher:
+    """Counts XLA backend compiles (count + seconds) into a registry.
+
+    Uses ``jax.monitoring.register_event_duration_secs_listener`` — the
+    only hook that sees EVERY compile in the process, including the
+    implicit first-call jit compiles the experiment loop relies on (no
+    explicit ``.lower().compile()`` site to wrap there). Fail-soft both
+    ways: a jax without the monitoring API degrades to
+    ``installed=False``, and one that RENAMED the event key leaves
+    ``saw_compile`` False forever — consumers report compile stats as
+    unavailable in either case rather than a fake zero.
+    """
+
+    def __init__(self, registry: MetricsRegistry):
+        self.registry = registry
+        self.installed = False
+        # True once a matching compile event has fired. Install success
+        # alone cannot prove the event KEY still exists (a jax upgrade
+        # could rename it and we would report a measured-looking zero
+        # forever) — consumers treat "installed but never saw a compile"
+        # as unavailable, since any real run compiles at least one
+        # executable before its first telemetry row.
+        self.saw_compile = False
+        self._listener = None
+
+    @classmethod
+    def install(cls, registry: MetricsRegistry) -> "CompileWatcher":
+        self = cls(registry)
+
+        def listener(key: str, seconds: float, **_kw: Any) -> None:
+            if key.endswith(_COMPILE_KEY_SUFFIX):
+                self.saw_compile = True
+                registry.counter(COMPILE_COUNT).inc()
+                registry.counter(COMPILE_SECONDS).inc(float(seconds))
+
+        try:
+            import jax.monitoring
+            jax.monitoring.register_event_duration_secs_listener(listener)
+        except Exception:
+            return self  # fail-soft: no compile telemetry on this jax
+        self._listener = listener
+        self.installed = True
+        return self
+
+    def uninstall(self) -> None:
+        """Best-effort listener removal (the public API has no unregister;
+        the private helper exists on every jax this repo supports). A
+        leaked listener is harmless — it only touches this registry."""
+        if not self.installed:
+            return
+        try:
+            from jax._src import monitoring as _m
+            _m._unregister_event_duration_listener_by_callback(
+                self._listener)
+        except Exception:
+            pass
+        self.installed = False
+
+    @property
+    def count(self) -> int:
+        return int(self.registry.counter(COMPILE_COUNT).value)
+
+    @property
+    def seconds(self) -> float:
+        return float(self.registry.counter(COMPILE_SECONDS).value)
+
+
+def device_memory_stats(
+        devices: Optional[Iterable[Any]] = None) -> Optional[Dict[str, int]]:
+    """Aggregate allocator stats over ``devices`` (default: the local
+    addressable devices): total live bytes, max per-device live and peak
+    bytes. Returns ``None`` when NO device reports stats (CPU backend,
+    PJRT clients without allocator introspection) — callers print an
+    explicit "unavailable" marker, never a fake zero.
+    """
+    try:
+        if devices is None:
+            import jax
+            devices = jax.local_devices()
+        live_total = 0
+        live_max = 0
+        peak_max = 0
+        reported = False
+        for d in devices:
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            live = int(stats.get("bytes_in_use", 0))
+            peak = int(stats.get("peak_bytes_in_use", live))
+            reported = True
+            live_total += live
+            live_max = max(live_max, live)
+            peak_max = max(peak_max, peak)
+        if not reported:
+            return None
+        return {"live_bytes_total": live_total,
+                "live_bytes_max_device": live_max,
+                "peak_bytes_max_device": peak_max}
+    except Exception:
+        return None  # diagnostics never abort training
+
+
+class FeedStallMeter:
+    """Wait-vs-dispatch wall-clock split of a batch consumer loop.
+
+    The loader's consumer records ``record_wait`` around its blocking
+    queue get (input pipeline not ready = a stall) and
+    ``record_dispatch`` for the time the consumer spent processing the
+    yielded batch (the training step dispatch). The stall fraction
+    ``wait / (wait + dispatch)`` is the canonical "are we input-bound"
+    number. Counters are CUMULATIVE over the loader's life; per-epoch
+    views subtract snapshots (:meth:`snapshot` / :func:`delta`).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.wait_seconds = 0.0
+        self.dispatch_seconds = 0.0
+        self.batches = 0
+
+    def record_wait(self, seconds: float) -> None:
+        with self._lock:
+            self.wait_seconds += seconds
+            self.batches += 1
+
+    def record_dispatch(self, seconds: float) -> None:
+        with self._lock:
+            self.dispatch_seconds += seconds
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {"feed_wait_seconds": self.wait_seconds,
+                    "feed_dispatch_seconds": self.dispatch_seconds,
+                    "feed_batches": float(self.batches)}
+
+    @staticmethod
+    def delta(now: Dict[str, float],
+              before: Optional[Dict[str, float]]) -> Dict[str, float]:
+        """Per-window view between two snapshots, with the derived
+        ``feed_stall_frac`` (None-safe: no time observed → frac 0.0)."""
+        before = before or {}
+        d = {k: now[k] - before.get(k, 0.0) for k in now}
+        busy = d["feed_wait_seconds"] + d["feed_dispatch_seconds"]
+        d["feed_stall_frac"] = (d["feed_wait_seconds"] / busy
+                                if busy > 0 else 0.0)
+        return d

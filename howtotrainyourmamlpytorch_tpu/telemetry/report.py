@@ -1,0 +1,151 @@
+"""Run-summary computation over an ``events.jsonl`` stream.
+
+The analysis half of the telemetry subsystem: pure functions from a list
+of parsed JSONL rows (``utils.tracing.read_jsonl``) to a run summary —
+used by ``scripts/telemetry_report.py`` (human table + CI JSON) and unit
+tests. Every fail-soft metric that never reported (CPU memory stats, a
+jax without compile events, a log predating this subsystem) summarizes
+to the explicit string ``"unavailable"`` — a report must distinguish
+"measured zero" from "not measured" or it will hide the exact failure
+modes it exists to surface.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Union
+
+SCHEMA = "maml_tpu_telemetry_report_v1"
+UNAVAILABLE = "unavailable"
+
+Metric = Union[float, int, str]
+
+
+def _finite(values: List[Optional[float]]) -> List[float]:
+    return [float(v) for v in values
+            if isinstance(v, (int, float)) and math.isfinite(float(v))]
+
+
+def _median(values: List[float]) -> Optional[float]:
+    if not values:
+        return None
+    s = sorted(values)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate a run's events into the report schema.
+
+    Tolerant by design: rows are duck-typed on their ``event`` field and
+    missing keys degrade the affected metric to ``"unavailable"`` —
+    the CLI must be able to read last year's logs and half-written logs
+    from a live run it is tailing.
+    """
+    train = [e for e in events if e.get("event") == "train_epoch"]
+    telemetry = [e for e in events if e.get("event") == "telemetry"]
+    beats = [e for e in events if e.get("event") == "heartbeat"]
+
+    # Step-time percentiles: per-epoch dispatch-interval quantiles from
+    # the train loop's StepTimer; the cross-epoch summary is the median
+    # epoch (robust to a slow first epoch that paid the compile).
+    p50s = _finite([e.get("dispatch_p50_step_seconds") for e in train]
+                   + [e.get("step_seconds_p50") for e in telemetry])
+    p95s = _finite([e.get("dispatch_p95_step_seconds") for e in train]
+                   + [e.get("step_seconds_p95") for e in telemetry])
+    rates = _finite([e.get("meta_tasks_per_sec_per_chip") for e in train])
+    steps = sum(int(e.get("dispatch_steps") or 0) for e in train)
+
+    # Compile totals are cumulative counters: the LAST row wins. Both
+    # per-epoch "telemetry" rows and registry-flush "metrics" rows carry
+    # them; the final registry flush (after the test protocol) is the
+    # most complete, and events are scanned in log order.
+    compile_count: Metric = UNAVAILABLE
+    compile_seconds: Metric = UNAVAILABLE
+    for e in events:
+        if (e.get("event") == "telemetry"
+                and e.get("compile_count_total") is not None):
+            compile_count = int(e["compile_count_total"])
+            compile_seconds = round(
+                float(e.get("compile_seconds_total") or 0.0), 3)
+        elif e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if m.get("compile/count") is not None:
+                compile_count = int(m["compile/count"])
+                compile_seconds = round(
+                    float(m.get("compile/seconds") or 0.0), 3)
+
+    # Feed stall: re-derived from per-epoch second totals (not a mean of
+    # per-epoch fractions — epochs with more batches must weigh more).
+    waits = _finite([e.get("feed_wait_seconds") for e in telemetry])
+    dispatches = _finite([e.get("feed_dispatch_seconds")
+                          for e in telemetry])
+    feed_stall: Metric = UNAVAILABLE
+    if waits or dispatches:
+        busy = sum(waits) + sum(dispatches)
+        feed_stall = round(sum(waits) / busy, 4) if busy > 0 else 0.0
+
+    peaks = _finite([(e.get("memory") or {}).get("peak_bytes_max_device")
+                     for e in telemetry])
+    lives = _finite([(e.get("memory") or {}).get("live_bytes_total")
+                     for e in telemetry])
+
+    skews = _finite([e.get("skew_frac") for e in beats])
+    hosts = [int(e.get("hosts") or 1) for e in beats]
+    host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
+    if beats:
+        host_skew = {
+            "hosts": max(hosts) if hosts else 1,
+            "heartbeats": len(beats),
+            "max_skew_frac": round(max(skews), 4) if skews else 0.0,
+            "median_skew_frac": round(_median(skews) or 0.0, 4),
+        }
+
+    def _r(v: Optional[float], nd: int = 6) -> Metric:
+        return UNAVAILABLE if v is None else round(v, nd)
+
+    return {
+        "schema": SCHEMA,
+        "events": len(events),
+        "epochs": len(train),
+        "steps": steps,
+        "step_seconds_p50": _r(_median(p50s)),
+        "step_seconds_p95": _r(_median(p95s)),
+        "meta_tasks_per_sec_per_chip": _r(_median(rates), 3),
+        "compile_count": compile_count,
+        "compile_seconds": compile_seconds,
+        "feed_stall_frac": feed_stall,
+        "peak_memory_bytes": (int(max(peaks)) if peaks else UNAVAILABLE),
+        "live_memory_bytes": (int(max(lives)) if lives else UNAVAILABLE),
+        "host_skew": host_skew,
+    }
+
+
+def _fmt(value: Any) -> str:
+    if isinstance(value, float):
+        return f"{value:.6g}"
+    if isinstance(value, dict):
+        return ", ".join(f"{k}={_fmt(v)}" for k, v in value.items())
+    return str(value)
+
+
+def format_table(summary: Dict[str, Any]) -> str:
+    """Fixed-width two-column table of the summary (human half of the
+    CLI; the JSON line is the machine half)."""
+    rows = [
+        ("epochs", summary["epochs"]),
+        ("steps (dispatch-timed)", summary["steps"]),
+        ("step seconds p50", summary["step_seconds_p50"]),
+        ("step seconds p95", summary["step_seconds_p95"]),
+        ("meta tasks/sec/chip", summary["meta_tasks_per_sec_per_chip"]),
+        ("XLA compiles", summary["compile_count"]),
+        ("XLA compile seconds", summary["compile_seconds"]),
+        ("feed stall fraction", summary["feed_stall_frac"]),
+        ("peak memory bytes/device", summary["peak_memory_bytes"]),
+        ("live memory bytes total", summary["live_memory_bytes"]),
+        ("per-host step skew", summary["host_skew"]),
+    ]
+    width = max(len(label) for label, _ in rows)
+    lines = [f"telemetry report ({summary['events']} events)"]
+    lines += [f"  {label:<{width}}  {_fmt(value)}" for label, value in rows]
+    return "\n".join(lines)

@@ -111,6 +111,39 @@ def maybe_enable_compilation_cache() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
 
 
+def instrument_compiles(registry):
+    """Install process-wide XLA compile counters (count + seconds) into
+    ``registry`` — the backend-layer entry point for compile telemetry,
+    so every tool that already calls :func:`init_backend` can opt in with
+    one line. Returns the watcher (``.uninstall()`` to detach, e.g. a
+    sweep driver building many ExperimentBuilders). Fail-soft: a jax
+    without the monitoring hook yields ``watcher.installed == False`` and
+    compile stats report "unavailable" downstream."""
+    from howtotrainyourmamlpytorch_tpu.telemetry.instruments import (
+        CompileWatcher)
+    return CompileWatcher.install(registry)
+
+
+def timed_compile(lowered, registry=None, compiler_options=None):
+    """Compile a ``jax.stages.Lowered`` and record wall-clock compile
+    seconds. The explicit-AOT counterpart to :func:`instrument_compiles`
+    (which also catches implicit first-call jit compiles): bench.py
+    routes every executable build through here so its artifact reports
+    compile cost even when the monitoring hook is unavailable. Records
+    to ``registry`` under the same ``compile/count``/``compile/seconds``
+    metrics — do NOT combine both mechanisms on one registry, the same
+    backend compile would be counted twice."""
+    t0 = time.perf_counter()
+    compiled = lowered.compile(compiler_options=compiler_options or None)
+    dt = time.perf_counter() - t0
+    if registry is not None:
+        from howtotrainyourmamlpytorch_tpu.telemetry.instruments import (
+            COMPILE_COUNT, COMPILE_SECONDS)
+        registry.counter(COMPILE_COUNT).inc()
+        registry.counter(COMPILE_SECONDS).inc(dt)
+    return compiled
+
+
 def init_backend(backend_timeout: float = 600.0):
     """THE backend preamble: MAML_JAX_PLATFORM pin (the config update
     bypasses sitecustomize platform pinning where the env var alone does

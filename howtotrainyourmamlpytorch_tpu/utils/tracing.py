@@ -19,10 +19,11 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import os
 import time
 import warnings
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 
 class JsonlLogger:
@@ -43,7 +44,13 @@ class JsonlLogger:
 
     @staticmethod
     def _coerce(value: Any) -> Any:
-        if isinstance(value, (str, int, float, bool)) or value is None:
+        if isinstance(value, float):
+            # json.dumps writes bare NaN/Infinity tokens — NOT valid
+            # JSON, so one NaN loss would make the whole log unreadable
+            # to strict parsers (incl. read_jsonl). Null is the honest
+            # JSON spelling of "no finite value".
+            return value if math.isfinite(value) else None
+        if isinstance(value, (str, int, bool)) or value is None:
             return value
         if isinstance(value, dict):
             return {k: JsonlLogger._coerce(v) for k, v in value.items()}
@@ -53,7 +60,7 @@ class JsonlLogger:
             try:
                 item = value.item()
                 if isinstance(item, (int, float, bool, str)):
-                    return item
+                    return JsonlLogger._coerce(item)
             except (TypeError, ValueError):
                 pass
         return str(value)
@@ -70,6 +77,19 @@ class JsonlLogger:
 def read_jsonl(path: str) -> List[Dict[str, Any]]:
     with open(path) as f:
         return [json.loads(line) for line in f if line.strip()]
+
+
+def nearest_rank(sorted_values: Sequence[float], q: float) -> float:
+    """Nearest-rank quantile of an ascending-sorted sequence: the
+    ``ceil(q*n)``-th smallest value (1-based). The previous p95 indexed
+    ``min(n-1, int(q*n))`` — off by one rank whenever ``q*n`` is integral
+    (n=20 picked the 20th value, the max, as p95) and ambiguous with the
+    textbook definition elsewhere; this is the standard estimator."""
+    if not sorted_values:
+        raise ValueError("nearest_rank of an empty sequence")
+    if not 0 < q <= 1:
+        raise ValueError(f"quantile {q} outside (0, 1]")
+    return sorted_values[max(0, math.ceil(q * len(sorted_values)) - 1)]
 
 
 class StepTimer:
@@ -98,6 +118,12 @@ class StepTimer:
     def num_steps(self) -> int:
         return len(self._durations)
 
+    @property
+    def durations(self) -> List[float]:
+        """Per-step dispatch intervals (copy) — telemetry consumers feed
+        these into registry histograms without reaching into privates."""
+        return list(self._durations)
+
     def summary(self, tasks_per_step: int,
                 n_chips: int = 1) -> Dict[str, float]:
         if not self._durations:
@@ -108,8 +134,8 @@ class StepTimer:
         return {
             "steps": n,
             "mean_step_seconds": total / n,
-            "p50_step_seconds": d[n // 2],
-            "p95_step_seconds": d[min(n - 1, int(0.95 * n))],
+            "p50_step_seconds": nearest_rank(d, 0.5),
+            "p95_step_seconds": nearest_rank(d, 0.95),
             "meta_tasks_per_sec": tasks_per_step * n / total,
             "meta_tasks_per_sec_per_chip":
                 tasks_per_step * n / total / n_chips,

@@ -34,6 +34,14 @@ def test_bench_quick_emits_full_capture_contract():
         assert key in first, key
     assert first["metric"] == "meta_tasks_per_sec_per_chip"
     assert first["value"] > 0
+    # Observability keys (ISSUE 1): additive to the artifact, frozen at
+    # first print like every headline key. bench routes every AOT build
+    # through timed_compile into its registry, so compile stats are
+    # always measured (never null) — a wiring regression must fail here.
+    assert first["compile_count"] > 0
+    assert first["compile_seconds"] > 0
+    assert first["feed_stall_frac"] == 0.0  # synthetic device-resident
+    #                                         batch: no host feed to stall
     # The authoritative LAST line is a strict superset with all three
     # measurement groups.
     for key in ("value", "run_weighted_tasks_per_sec_per_chip",

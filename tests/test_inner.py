@@ -100,7 +100,12 @@ def _torch_maml_grads(w0, b0, sx, sy, tx, ty, lr, num_steps, second_order):
 def _jax_maml_grads(cfg, w0, b0, sx, sy, tx, ty, second_order):
     """Meta-grads in float64 (second-order in f32 amplifies rounding; the
     parity claim is about *semantics*, so compare at high precision)."""
-    with jax.enable_x64(True):
+    # jax >= 0.5 exposes enable_x64 at top level; 0.4.x only under
+    # jax.experimental (same context manager either way).
+    enable_x64 = getattr(jax, "enable_x64", None)
+    if enable_x64 is None:
+        from jax.experimental import enable_x64
+    with enable_x64(True):
         params = {"lin": {"w": jnp.asarray(w0, jnp.float64),
                           "b": jnp.asarray(b0, jnp.float64)}}
         fast0, _ = inner.split_fast_slow(cfg, params)
@@ -214,6 +219,7 @@ def test_msl_loss_is_weighted_sum_of_per_step_losses():
     np.testing.assert_allclose(float(res.loss), expect, rtol=1e-6)
 
 
+@pytest.mark.slow  # compiles serial + K-wide batched MSL (~30s)
 def test_msl_batched_target_path_equals_serial():
     """The batched-MSL execution strategy (msl_target_batching='on':
     target forwards pulled out of the scan and vmapped over steps) must be

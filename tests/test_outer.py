@@ -170,6 +170,9 @@ def test_cosine_schedule_endpoints():
     assert float(sched(0)) > float(sched(CFG.total_iter_per_epoch))
 
 
+@pytest.mark.slow  # two full train-step compiles (~25s, 1 core);
+#                    the clamped trajectory-parity variant also
+#                    covers clamp semantics in the full pyramid
 def test_grad_clamp_applied():
     """A huge clamp is a no-op; a tight clamp changes the update (the
     reference clamps per-parameter grads to ±10 for *ImageNet runs)."""
@@ -269,6 +272,7 @@ def test_task_microbatches_must_divide_batch():
         make_train_step(CFG.replace(task_microbatches=3), apply)
 
 
+@pytest.mark.slow  # multi-step-count eval compiles (~25s, 1 core)
 def test_eval_adaptation_gain_on_permuted_tasks():
     """The few-shot mechanism itself: with a random per-episode class-label
     permutation the initialization alone cannot classify (the mapping

@@ -147,6 +147,7 @@ def test_kernel_leaky_and_identity_slopes(data, slope):
                                rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow  # two deep-backbone compiles (~20s, 1 core)
 def test_resnet12_pallas_backend_matches_composite():
     """resnet12 with bn_backend='pallas' (fused leaky/identity norms) must
     match the fast_math composite model."""

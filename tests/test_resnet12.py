@@ -45,6 +45,7 @@ def test_resnet12_norms_are_slow():
     assert "linear" in fast
 
 
+@pytest.mark.slow  # pod-workload backbone meta-train (~70s, 1 core)
 def test_resnet12_meta_trains():
     init, apply = make_model(CFG)
     state = init_train_state(CFG, init, jax.random.PRNGKey(0))

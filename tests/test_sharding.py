@@ -106,6 +106,7 @@ def test_mesh_validation_errors():
         make_sharded_steps(cfg, apply, make_mesh(cfg, jax.devices()))
 
 
+@pytest.mark.slow  # pod-scale system dry run (~100s on the 1-core box)
 def test_graft_dryrun_multichip():
     import __graft_entry__ as g
     g.dryrun_multichip(8)
@@ -223,6 +224,7 @@ def test_microbatch_clamped_to_local_shard():
                            make_mesh(cfg_dp, jax.devices()[:8]))
 
 
+@pytest.mark.slow  # pod-workload backbone on an 8-way mesh (~70s, 1 core)
 def test_resnet12_trains_on_sharded_mesh():
     """Regression (r2): resnet12's 1x1 skip projections, vmapped over
     per-task fast kernels, used to lower to feature-grouped convs that the

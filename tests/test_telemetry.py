@@ -1,0 +1,275 @@
+"""Telemetry subsystem unit tests: registry, instruments, aggregation.
+
+Covers the multi-host single-writer contract (ISSUE 1 satellite): a
+disabled logger still returns coerced rows but writes nothing, and the
+process-0 aggregation path produces ONE line per heartbeat fleet-wide,
+not one per host.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.telemetry import (
+    COMPILE_COUNT, COMPILE_SECONDS, CompileWatcher, FeedStallMeter,
+    MetricsRegistry, device_memory_stats, emit_heartbeat,
+    exponential_buckets, host_step_skew)
+from howtotrainyourmamlpytorch_tpu.utils.tracing import (
+    JsonlLogger, read_jsonl)
+
+
+# -- registry -------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("compile/count")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    assert reg.counter("compile/count") is c  # get-or-create
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("val/accuracy")
+    assert g.value is None
+    g.set(0.5)
+    g.set(0.25)  # gauges overwrite
+    assert g.value == 0.25
+
+
+def test_registry_rejects_type_confusion():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_exponential_buckets_spacing():
+    b = exponential_buckets(0.001, 2.0, 5)
+    assert b == (0.001, 0.002, 0.004, 0.008, 0.016)
+    with pytest.raises(ValueError):
+        exponential_buckets(0, 2, 5)
+
+
+def test_histogram_observe_and_quantiles():
+    reg = MetricsRegistry()
+    h = reg.histogram("t", buckets=[1.0, 2.0, 4.0, 8.0])
+    for v in [0.5, 1.5, 1.5, 3.0, 9.0]:
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(15.5)
+    # nearest-rank(0.5) of 5 obs = 3rd smallest (1.5) -> bucket bound 2.0
+    assert h.quantile(0.5) == 2.0
+    # nearest-rank(0.95) = 5th smallest (9.0) -> overflow reports last bound
+    assert h.quantile(0.95) == 8.0
+    h.observe(float("nan"))  # dropped, never corrupts the sum
+    assert h.count == 5
+    snap = h.snapshot()
+    assert snap["count"] == 5 and snap["p50"] == 2.0
+
+
+def test_registry_snapshot_and_jsonl_flush(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("compile/count").inc(3)
+    reg.gauge("val/accuracy").set(0.5)
+    reg.histogram("step_seconds", buckets=[0.1, 1.0]).observe(0.05)
+    log = JsonlLogger(str(tmp_path / "e.jsonl"))
+    reg.flush_jsonl(log, epoch=4)
+    row = read_jsonl(log.path)[0]
+    assert row["event"] == "metrics" and row["epoch"] == 4
+    m = row["metrics"]
+    assert m["compile/count"] == 3.0
+    assert m["val/accuracy"] == 0.5
+    assert m["step_seconds"]["count"] == 1
+
+
+def test_write_prometheus_textfile(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("compile/seconds").inc(1.25)
+    reg.gauge("val/accuracy").set(0.5)
+    reg.gauge("never/set")  # valueless gauges are omitted
+    h = reg.histogram("step_seconds", buckets=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(5.0)
+    path = str(tmp_path / "metrics.prom")
+    reg.write_prometheus(path)
+    text = open(path).read()
+    assert "# TYPE compile_seconds counter" in text
+    assert "compile_seconds 1.25" in text
+    assert "val_accuracy 0.5" in text
+    assert "never_set" not in text
+    assert 'step_seconds_bucket{le="0.1"} 1' in text
+    assert 'step_seconds_bucket{le="+Inf"} 2' in text
+    assert "step_seconds_count 2" in text
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("metrics.prom.tmp")]  # atomic rename
+
+
+def test_registry_thread_safety_smoke():
+    reg = MetricsRegistry()
+    c = reg.counter("n")
+    h = reg.histogram("h", buckets=[1.0])
+
+    def work():
+        for _ in range(500):
+            c.inc()
+            h.observe(0.5)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 2000
+    assert h.count == 2000
+
+
+# -- instruments ----------------------------------------------------------
+
+def test_compile_watcher_counts_fresh_jit():
+    import jax
+    import jax.numpy as jnp
+    reg = MetricsRegistry()
+    watch = CompileWatcher.install(reg)
+    assert watch.installed, "jax.monitoring hook unavailable on this jax"
+    try:
+        # A never-before-seen shape forces a real backend compile.
+        @jax.jit
+        def f(x):
+            return x * 3 + 1
+        f(jnp.zeros((3, 7, 11)))
+        assert watch.count >= 1
+        assert watch.seconds > 0
+        assert watch.saw_compile  # event-key liveness flag (consumers
+        #            treat installed-but-never-seen as "unavailable")
+        before = watch.count
+    finally:
+        watch.uninstall()
+
+    @jax.jit
+    def g(x):
+        return x - 2
+    g(jnp.zeros((5, 13)))
+    assert reg.counter(COMPILE_COUNT).value == before  # detached
+    assert reg.counter(COMPILE_SECONDS).value > 0
+
+
+def test_device_memory_stats_fail_soft():
+    # The CPU backend reports no allocator stats: the telemetry layer
+    # must yield None (-> "unavailable"), never a fake zero.
+    assert device_memory_stats() is None
+    class Boom:
+        def memory_stats(self):
+            raise RuntimeError("no stats RPC")
+    assert device_memory_stats([Boom()]) is None
+
+
+def test_device_memory_stats_aggregates_fakes():
+    class Dev:
+        def __init__(self, live, peak):
+            self._s = {"bytes_in_use": live, "peak_bytes_in_use": peak}
+        def memory_stats(self):
+            return self._s
+    out = device_memory_stats([Dev(100, 150), Dev(300, 400)])
+    assert out == {"live_bytes_total": 400,
+                   "live_bytes_max_device": 300,
+                   "peak_bytes_max_device": 400}
+
+
+def test_feed_stall_meter_delta():
+    m = FeedStallMeter()
+    m.record_wait(3.0)
+    m.record_dispatch(1.0)
+    snap1 = m.snapshot()
+    d1 = FeedStallMeter.delta(snap1, None)
+    assert d1["feed_stall_frac"] == pytest.approx(0.75)
+    m.record_wait(0.0)
+    m.record_dispatch(4.0)
+    d2 = FeedStallMeter.delta(m.snapshot(), snap1)
+    assert d2["feed_wait_seconds"] == pytest.approx(0.0)
+    assert d2["feed_stall_frac"] == pytest.approx(0.0)
+    # No elapsed time -> 0.0, not a ZeroDivisionError
+    empty = FeedStallMeter()
+    assert FeedStallMeter.delta(empty.snapshot(),
+                                None)["feed_stall_frac"] == 0.0
+
+
+def test_loader_meters_train_feed():
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader)
+    cfg = MAMLConfig(
+        dataset_name="synthetic", image_height=8, image_width=8,
+        image_channels=1, num_classes_per_set=3, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2, num_stages=2)
+    loader = MetaLearningDataLoader(cfg)
+    for _ in loader.get_train_batches(0, 3):
+        pass
+    snap = loader.feed.snapshot()
+    assert snap["feed_batches"] >= 3
+    assert snap["feed_wait_seconds"] > 0
+    # Eval sweeps are not metered (feed_stall_frac diagnoses training).
+    before = loader.feed.snapshot()
+    for _ in loader.get_val_batches():
+        break
+    assert loader.feed.snapshot() == before
+
+
+# -- single-writer + aggregation (ISSUE 1 satellite) ----------------------
+
+def test_disabled_logger_writes_nothing_but_returns_coerced_rows(tmp_path):
+    path = str(tmp_path / "sub" / "events.jsonl")
+    log = JsonlLogger(path, enabled=False)
+    row = log.log("train_epoch", loss=np.float32(0.5),
+                  bad=float("nan"), obj=object())
+    # Row is fully coerced — non-main processes can still compute with it.
+    assert row["loss"] == 0.5
+    assert row["bad"] is None
+    assert isinstance(row["obj"], str)
+    assert not os.path.exists(path)
+    assert not os.path.exists(os.path.dirname(path))  # no dir scaffolding
+
+
+def test_heartbeat_single_line_per_beat_not_per_host(tmp_path):
+    # Two simulated hosts run the same program point: host 0 owns the
+    # enabled logger, host 1 the disabled one. The fleet must emit ONE
+    # line per heartbeat, while every host computes the identical row.
+    path = str(tmp_path / "events.jsonl")
+    loggers = [JsonlLogger(path, enabled=True),
+               JsonlLogger(path, enabled=False)]
+    for beat in range(3):
+        rows = [emit_heartbeat(lg, epoch=0, iteration=beat,
+                               local_mean_step_seconds=0.125,
+                               process_index=i)
+                for i, lg in enumerate(loggers)]
+        assert rows[0]["hosts"] == rows[1]["hosts"] == 1
+        assert rows[0]["skew_frac"] == rows[1]["skew_frac"]
+    lines = read_jsonl(path)
+    assert len(lines) == 3  # one per heartbeat, NOT one per host
+    assert all(e["event"] == "heartbeat" for e in lines)
+    assert lines[-1]["iter"] == 2
+
+
+def test_host_step_skew_single_process():
+    skew = host_step_skew(0.25)
+    assert skew["hosts"] == 1
+    assert skew["host_mean_step_seconds"] == [0.25]
+    assert skew["skew_frac"] == 0.0
+    assert skew["slowest_host"] == 0
+    # Degenerate (no positive step time yet) stays well-defined.
+    zero = host_step_skew(0.0)
+    assert zero["skew_frac"] == 0.0
+
+
+def test_heartbeat_payload_round_trips_json(tmp_path):
+    log = JsonlLogger(str(tmp_path / "e.jsonl"))
+    emit_heartbeat(log, epoch=2, iteration=10,
+                   local_mean_step_seconds=0.5, process_index=0,
+                   memory=None, feed_stall_frac=0.1)
+    row = read_jsonl(log.path)[0]
+    assert row["epoch"] == 2 and row["iter"] == 10
+    assert row["memory"] is None
+    assert row["feed_stall_frac"] == 0.1
+    json.dumps(row)  # strictly serializable

@@ -1,0 +1,176 @@
+"""telemetry_report CLI contract: fixture-driven schema smoke (tier-1,
+so the CLI can't silently rot) plus a real 2-epoch CPU training run
+driven through the full pipeline (the ISSUE 1 acceptance scenario).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.telemetry.report import (
+    SCHEMA, UNAVAILABLE, format_table, summarize_events)
+from howtotrainyourmamlpytorch_tpu.utils.tracing import JsonlLogger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
+
+# Every key the CI consumer may rely on (the acceptance list: step-time
+# percentiles, tasks/sec/chip, compile count/seconds, feed-stall
+# fraction, peak memory, per-host skew).
+SCHEMA_KEYS = {
+    "schema", "events", "epochs", "steps", "step_seconds_p50",
+    "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
+    "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
+    "live_memory_bytes", "host_skew",
+}
+
+
+def write_fixture_events(path, *, with_failsoft=True):
+    """A synthetic 2-epoch run's event stream, as the experiment loop
+    writes it (train_epoch + telemetry + heartbeat per epoch)."""
+    log = JsonlLogger(str(path))
+    for epoch, (p50, p95, rate) in enumerate([(0.10, 0.50, 40.0),
+                                              (0.08, 0.12, 50.0)]):
+        log.log("train_epoch", epoch=epoch, iter=(epoch + 1) * 10,
+                train_loss=1.0, meta_tasks_per_sec_per_chip=rate,
+                dispatch_steps=10, dispatch_p50_step_seconds=p50,
+                dispatch_p95_step_seconds=p95)
+        log.log("telemetry", epoch=epoch, iter=(epoch + 1) * 10,
+                step_seconds_p50=p50, step_seconds_p95=p95,
+                meta_tasks_per_sec_per_chip=rate,
+                compile_count_total=(4 if with_failsoft else None),
+                compile_seconds_total=(12.5 if with_failsoft else None),
+                feed_wait_seconds=1.0, feed_dispatch_seconds=9.0,
+                feed_stall_frac=0.1,
+                memory=({"live_bytes_total": 1000,
+                         "live_bytes_max_device": 800,
+                         "peak_bytes_max_device": 2000 + epoch}
+                        if with_failsoft else None))
+        log.log("heartbeat", epoch=epoch, iter=(epoch + 1) * 10,
+                process_index=0, hosts=4,
+                host_mean_step_seconds=[0.1, 0.1, 0.1, 0.14],
+                skew_frac=0.05 * (epoch + 1), slowest_host=3)
+    return log.path
+
+
+def test_summarize_events_fixture(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    path = write_fixture_events(tmp_path / "events.jsonl")
+    s = summarize_events(read_jsonl(path))
+    assert set(s) == SCHEMA_KEYS
+    assert s["schema"] == SCHEMA
+    assert s["epochs"] == 2 and s["steps"] == 20
+    assert s["step_seconds_p50"] == pytest.approx(0.09)  # median of epochs
+    assert s["step_seconds_p95"] == pytest.approx(0.31)
+    assert s["meta_tasks_per_sec_per_chip"] == pytest.approx(45.0)
+    assert s["compile_count"] == 4
+    assert s["compile_seconds"] == 12.5
+    # Feed stall re-derived from second totals (2.0 wait / 20.0 busy).
+    assert s["feed_stall_frac"] == pytest.approx(0.1)
+    assert s["peak_memory_bytes"] == 2001
+    assert s["host_skew"]["hosts"] == 4
+    assert s["host_skew"]["max_skew_frac"] == pytest.approx(0.1)
+    # The table renders every row without raising.
+    table = format_table(s)
+    assert "feed stall fraction" in table and "0.1" in table
+
+
+def test_summarize_events_failsoft_markers(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    path = write_fixture_events(tmp_path / "events.jsonl",
+                                with_failsoft=False)
+    s = summarize_events(read_jsonl(path))
+    # Metrics that never reported say so EXPLICITLY — "unavailable", not 0.
+    assert s["compile_count"] == UNAVAILABLE
+    assert s["compile_seconds"] == UNAVAILABLE
+    assert s["peak_memory_bytes"] == UNAVAILABLE
+    assert UNAVAILABLE in format_table(s)
+
+
+def test_cli_smoke_fixture_schema(tmp_path):
+    """Tier-1 CLI rot guard: subprocess run over a fixture, JSON schema
+    asserted on the LAST stdout line (the bench.py artifact contract)."""
+    write_fixture_events(tmp_path / "events.jsonl")
+    r = subprocess.run([sys.executable, CLI, str(tmp_path)],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-1000:]
+    lines = r.stdout.strip().splitlines()
+    summary = json.loads(lines[-1])
+    assert set(summary) == SCHEMA_KEYS
+    assert summary["epochs"] == 2
+    assert "telemetry report" in lines[0]  # human table precedes JSON
+    # --json mode: machine line only.
+    rj = subprocess.run([sys.executable, CLI, "--json",
+                        str(tmp_path / "events.jsonl")],
+                        capture_output=True, text=True, timeout=120,
+                        cwd=REPO)
+    assert rj.returncode == 0
+    assert json.loads(rj.stdout.strip()) == summary
+
+
+def test_cli_errors_are_json(tmp_path):
+    r = subprocess.run([sys.executable, CLI,
+                        str(tmp_path / "missing.jsonl")],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == 1
+    assert "error" in json.loads(r.stdout.strip().splitlines()[-1])
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    r2 = subprocess.run([sys.executable, CLI, str(empty)],
+                        capture_output=True, text=True, timeout=120,
+                        cwd=REPO)
+    assert r2.returncode == 1
+    assert "empty" in json.loads(r2.stdout.strip().splitlines()[-1])["error"]
+
+
+@pytest.mark.slow  # real 2-epoch training run (~20s, 1 core); the
+#                    fixture-driven CLI smoke above stays tier-1
+def test_report_on_real_two_epoch_cpu_run(tmp_path):
+    """THE acceptance scenario: a 2-epoch CPU smoke run, then the CLI
+    reports step-time percentiles, compile count/seconds, feed-stall
+    fraction and peak memory (explicitly 'unavailable' on CPU)."""
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    cfg = MAMLConfig(
+        experiment_name="telemetry_e2e",
+        experiment_root=str(tmp_path),
+        dataset_name="synthetic",
+        image_height=12, image_width=12, image_channels=1,
+        num_classes_per_set=3, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2,
+        cnn_num_filters=4, num_stages=2,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        second_order=False, use_multi_step_loss_optimization=False,
+        total_epochs=2, total_iter_per_epoch=2,
+        num_evaluation_tasks=2, max_models_to_save=2)
+    ExperimentBuilder(cfg).run_experiment()
+
+    exp_dir = os.path.join(str(tmp_path), "telemetry_e2e")
+    r = subprocess.run([sys.executable, CLI, "--json", exp_dir],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-1000:]
+    s = json.loads(r.stdout.strip())
+    assert s["epochs"] == 2
+    assert s["steps"] == 4
+    assert s["step_seconds_p50"] > 0
+    assert s["step_seconds_p95"] >= s["step_seconds_p50"]
+    assert s["meta_tasks_per_sec_per_chip"] > 0
+    # In-process jit compiles were counted by the monitoring listener.
+    assert isinstance(s["compile_count"], int) and s["compile_count"] > 0
+    assert s["compile_seconds"] > 0
+    assert isinstance(s["feed_stall_frac"], float)
+    # CPU backend has no allocator stats: explicit marker, never fake 0.
+    assert s["peak_memory_bytes"] == UNAVAILABLE
+    assert s["host_skew"]["hosts"] == 1
+    # The Prometheus textfile snapshot landed next to the JSONL stream.
+    prom = open(os.path.join(exp_dir, "logs", "metrics.prom")).read()
+    assert "# TYPE compile_count counter" in prom
+    assert "test_accuracy_mean" in prom

@@ -432,6 +432,7 @@ def _torch_trajectory(cfg, params0, bn0, batches):
     return losses, tp, lslr, running
 
 
+@pytest.mark.slow  # 50 torch+jax outer steps/variant (~90s, 1 core)
 @pytest.mark.parametrize(
     "variant", ["first_order", "da_second_order", "clamped"])
 def test_trajectory_parity(variant):

@@ -163,6 +163,7 @@ def _torch_meta_grad(params, ep, second_order):
     return float(t_loss.detach()), tp
 
 
+@pytest.mark.slow  # deep-backbone compile x2 orders (~60s, 1 core)
 @pytest.mark.parametrize("second_order", [False, True])
 def test_resnet12_meta_gradient_parity(model, second_order):
     """d(target loss after K adapted steps)/dθ0 through the residual

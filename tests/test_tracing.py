@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from howtotrainyourmamlpytorch_tpu.utils.tracing import (
-    JsonlLogger, StepTimer, profile_trace, read_jsonl)
+    JsonlLogger, StepTimer, nearest_rank, profile_trace, read_jsonl)
 
 
 def test_jsonl_logger_roundtrip(tmp_path):
@@ -51,6 +51,55 @@ def test_step_timer_summary():
         s["meta_tasks_per_sec"] / 2)
     t.reset()
     assert t.summary(1) == {}
+
+
+def test_jsonl_logger_nonfinite_floats_stay_parseable(tmp_path):
+    """A NaN loss must not corrupt the log: json.dumps would write bare
+    NaN/Infinity tokens (invalid JSON); the logger coerces them to null
+    and the stream round-trips through read_jsonl (ISSUE 1 satellite)."""
+    log = JsonlLogger(str(tmp_path / "e.jsonl"))
+    row = log.log("train_epoch", loss=float("nan"), lr=float("inf"),
+                  acc=np.float32("nan"), neg=float("-inf"),
+                  nested={"a": float("nan")}, seq=[1.0, float("inf")],
+                  fine=0.5)
+    parsed = read_jsonl(log.path)  # must parse under strict JSON rules
+    assert parsed[0]["loss"] is None
+    assert parsed[0]["lr"] is None
+    assert parsed[0]["acc"] is None
+    assert parsed[0]["neg"] is None
+    assert parsed[0]["nested"]["a"] is None
+    assert parsed[0]["seq"] == [1.0, None]
+    assert parsed[0]["fine"] == 0.5
+    assert row["loss"] is None  # returned row matches what was written
+
+
+def test_step_timer_quantiles_nearest_rank():
+    """Quantiles pinned on known sequences: nearest-rank, i.e. the
+    ceil(q*n)-th smallest (ISSUE 1 satellite — the old p95 indexed
+    int(0.95*n), off by one whole rank when 0.95*n is integral)."""
+    t = StepTimer()
+    t._durations = [float(v) for v in range(1, 21)]  # 1..20
+    s = t.summary(tasks_per_step=1)
+    assert s["p95_step_seconds"] == 19.0  # ceil(19)=19th; old code said 20
+    assert s["p50_step_seconds"] == 10.0
+    t._durations = [5.0, 1.0, 3.0, 2.0, 4.0]  # unsorted on purpose
+    s = t.summary(tasks_per_step=1)
+    assert s["p95_step_seconds"] == 5.0  # ceil(4.75)=5th smallest
+    assert s["p50_step_seconds"] == 3.0
+    t._durations = [7.5]
+    s = t.summary(tasks_per_step=1)
+    assert s["p95_step_seconds"] == 7.5
+    assert s["p50_step_seconds"] == 7.5
+
+
+def test_nearest_rank_helper_contract():
+    assert nearest_rank([1.0, 2.0, 3.0, 4.0], 1.0) == 4.0
+    assert nearest_rank([1.0, 2.0, 3.0, 4.0], 0.25) == 1.0
+    assert nearest_rank([1.0, 2.0], 0.5) == 1.0
+    with pytest.raises(ValueError):
+        nearest_rank([], 0.5)
+    with pytest.raises(ValueError):
+        nearest_rank([1.0], 0.0)
 
 
 def test_profile_trace_noop_without_dir():
