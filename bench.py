@@ -395,6 +395,15 @@ def main() -> int:
             registry.counter(COMPILE_SECONDS).value, 3),
         "compile_count": int(registry.counter(COMPILE_COUNT).value),
         "feed_stall_frac": 0.0,
+        # Serving keys (serve/ subsystem): part of the artifact schema
+        # so one consumer reads train and serve captures uniformly, but
+        # this tool benches the TRAIN step — always null here. The
+        # non-null producer is scripts/serve_bench.py (same key names,
+        # same last-JSON-line contract). The meta_tasks_per_sec_per_chip
+        # contract above is unchanged.
+        "serve_latency_p50_ms": None,
+        "serve_latency_p95_ms": None,
+        "serve_cache_hit_frac": None,
     }
     # Utilization anchor (VERDICT r1): FLOPs of the timed executable vs
     # the chip's peak bf16 rate — makes the throughput claim absolute

@@ -16,6 +16,9 @@ Layer map (ours → reference):
   utils/               → utils/storage.py
   experiment.py        → experiment_builder.py
   train_maml_system.py → train_maml_system.py
+  serve/               → (no reference equivalent: adaptation-as-a-
+                          service for batched few-shot inference —
+                          docs/SERVING.md)
 """
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig

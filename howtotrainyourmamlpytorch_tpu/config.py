@@ -16,10 +16,10 @@ backbone shape — are plain Python values here, never traced).
 from __future__ import annotations
 
 import dataclasses
+import difflib
 import json
 import math
 import os
-import warnings
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 # Reference keys that configure CUDA/worker plumbing with no TPU equivalent.
@@ -222,6 +222,32 @@ class MAMLConfig:
     profile_epoch: int = 0                 # epoch whose first steps to trace
     profile_num_steps: int = 5             # steps to trace at that epoch
 
+    # ---- serving (serve/ subsystem, docs/SERVING.md) -------------------
+    serve_batch_tasks: int = 8             # tasks per compiled adapt/predict
+                                           # step (global; must divide by the
+                                           # mesh size — a pod slice serves
+                                           # serve_batch_tasks/mesh tasks per
+                                           # chip per step)
+    serve_buckets: Tuple[Tuple[int, int], ...] = ()
+                                           # static (support, query) shape
+                                           # buckets requests are padded to;
+                                           # () = one bucket at the dataset
+                                           # geometry (N*K support, N*T
+                                           # query). Steady-state serving
+                                           # never compiles outside this set.
+    serve_max_queue_depth: int = 64        # backpressure: submits beyond
+                                           # this depth are rejected
+    serve_default_deadline_ms: float = 1000.0
+                                           # per-request deadline for
+                                           # requests that don't carry one
+                                           # (0 = no deadline)
+    serve_cache_capacity: int = 128        # adapted-params LRU entries
+                                           # (0 disables the cache)
+    serve_adapt_steps: int = 0             # inner steps per served request
+                                           # (0 = the eval step count; must
+                                           # stay within the checkpoint's
+                                           # LSLR/BN per-step rows)
+
     # Keys found in a loaded JSON that we accepted-and-ignored (for logging).
     ignored_keys: Tuple[str, ...] = ()
 
@@ -271,6 +297,30 @@ class MAMLConfig:
         if (self.image_norm_std is not None
                 and any(s == 0 for s in self.image_norm_std)):
             raise ValueError("image_norm_std entries must be non-zero")
+        if self.serve_batch_tasks < 1:
+            raise ValueError("serve_batch_tasks must be >= 1")
+        if self.serve_max_queue_depth < 1:
+            raise ValueError("serve_max_queue_depth must be >= 1")
+        if self.serve_cache_capacity < 0:
+            raise ValueError("serve_cache_capacity must be >= 0")
+        if self.serve_default_deadline_ms < 0:
+            raise ValueError("serve_default_deadline_ms must be >= 0")
+        for bucket in self.serve_buckets:
+            if (len(bucket) != 2
+                    or any(int(v) < 1 for v in bucket)):
+                raise ValueError(
+                    f"serve_buckets entries must be (support, query) "
+                    f"pairs of positive ints, got {bucket}")
+        # Per-step LSLR/BN rows exist only up to max(train, eval) steps;
+        # serving beyond them would silently clip into the last row.
+        max_steps = max(self.number_of_training_steps_per_iter,
+                        self.number_of_evaluation_steps_per_iter)
+        if self.serve_adapt_steps < 0 or self.serve_adapt_steps > max_steps:
+            raise ValueError(
+                f"serve_adapt_steps must be in [0, {max_steps}] (0 = the "
+                f"eval step count; the checkpoint's per-step LSLR/BN rows "
+                f"cover at most {max_steps} steps), got "
+                f"{self.serve_adapt_steps}")
 
     # ---- derived values -------------------------------------------------
     @property
@@ -411,6 +461,24 @@ class MAMLConfig:
         local = max(self.batch_size // max(mesh_size, 1), 1)
         return math.gcd(self.task_microbatches, local)
 
+    @property
+    def effective_serve_adapt_steps(self) -> int:
+        """Inner steps per served request: the explicit override, else the
+        evaluation step count (serving IS evaluation-style adaptation —
+        first-order, final-step prediction)."""
+        return (self.serve_adapt_steps or
+                self.number_of_evaluation_steps_per_iter)
+
+    @property
+    def serve_bucket_shapes(self) -> Tuple[Tuple[int, int], ...]:
+        """Resolved static (support, query) shape buckets, sorted by
+        padding cost (support major): the batcher picks the FIRST bucket
+        that fits a request. Default: one bucket at the dataset geometry."""
+        if self.serve_buckets:
+            return tuple(sorted((int(s), int(q))
+                                for s, q in self.serve_buckets))
+        return ((self.num_support_per_task, self.num_target_per_task),)
+
     def use_second_order(self, epoch: int) -> bool:
         """Derivative-order annealing (reference:
         ``few_shot_learning_system.py § forward`` — second order iff the
@@ -428,22 +496,34 @@ class MAMLConfig:
     def from_dict(cls, d: Dict[str, Any]) -> "MAMLConfig":
         """Build a config from a dict using the reference JSON schema.
 
-        Unknown keys are collected into ``ignored_keys`` rather than raising,
-        so reference configs (and future reference versions) load cleanly.
+        Known GPU/worker plumbing keys from the reference schema are
+        accepted-and-ignored (collected into ``ignored_keys``). Any OTHER
+        unknown key raises with a did-you-mean suggestion: the serving
+        subsystem keeps adding config keys, and a typo'd knob that
+        silently falls back to its default (a serving config whose
+        ``serve_cache_capacty`` quietly serves uncached) is exactly the
+        failure mode a config system exists to prevent.
         """
         field_names = {f.name for f in dataclasses.fields(cls)}
         kwargs: Dict[str, Any] = {}
         ignored: List[str] = []
+        unknown: List[str] = []
         for key, value in d.items():
             if key in field_names and key != "ignored_keys":
                 kwargs[key] = value
-            else:
+            elif key in _IGNORED_REFERENCE_KEYS or key == "ignored_keys":
                 ignored.append(key)
-                if key not in _IGNORED_REFERENCE_KEYS:
-                    # Likely a typo or an unknown reference key — loud but
-                    # non-fatal so newer reference configs still load.
-                    warnings.warn(f"MAMLConfig: unrecognized config key "
-                                  f"{key!r} ignored", stacklevel=2)
+            else:
+                unknown.append(key)
+        if unknown:
+            parts = []
+            for key in sorted(unknown):
+                match = difflib.get_close_matches(
+                    key, sorted(field_names - {"ignored_keys"}), n=1)
+                parts.append(f"{key!r}" + (f" (did you mean {match[0]!r}?)"
+                                           if match else ""))
+            raise ValueError(
+                "MAMLConfig: unknown config key(s) " + ", ".join(parts))
         # Reference behavior: Mini/Tiered-ImageNet runs clamp per-parameter
         # meta-gradients to ±10 (``few_shot_learning_system.py §
         # meta_update``). Reproduce when the JSON doesn't say otherwise.
@@ -457,6 +537,9 @@ class MAMLConfig:
                           "image_norm_mean", "image_norm_std"):
             if tup_field in kwargs and isinstance(kwargs[tup_field], list):
                 kwargs[tup_field] = tuple(kwargs[tup_field])
+        if isinstance(kwargs.get("serve_buckets"), list):
+            kwargs["serve_buckets"] = tuple(
+                tuple(b) for b in kwargs["serve_buckets"])
         kwargs["ignored_keys"] = tuple(sorted(ignored))
         return cls(**kwargs)
 

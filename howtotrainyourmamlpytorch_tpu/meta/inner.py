@@ -37,7 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
-from howtotrainyourmamlpytorch_tpu.ops.losses import accuracy, cross_entropy
+from howtotrainyourmamlpytorch_tpu.ops.losses import (
+    accuracy, cross_entropy, weighted_cross_entropy)
 
 Params = Dict[str, Any]
 State = Dict[str, Any]
@@ -149,6 +150,49 @@ def _lslr_update(fast: Params, grads: Params, lslr: Params,
         lambda w, g, lr: w - jnp.take(lr, step) * g, fast, grads, lslr)
 
 
+def support_adapt_step(cfg: MAMLConfig, apply_fn, slow: Params,
+                       lslr: Params, support_x: jax.Array,
+                       support_y: jax.Array, fast: Params, bn: State,
+                       step: jax.Array, *, second_order: bool,
+                       support_w: Optional[jax.Array] = None
+                       ) -> Tuple[Params, State, jax.Array]:
+    """ONE inner support step: forward → grad wrt fast weights → LSLR
+    update. The single definition of the adaptation update, shared by the
+    training inner loop (:func:`task_forward`'s scan body) and the
+    serving adapt-only path (serve/adapt.py) so the two cannot drift —
+    tests/test_inner.py § test_adapt_only_parity pins the equivalence.
+
+    ``support_w`` (static None in training) enables the serving batcher's
+    support-row padding: a per-example weight vector where pad rows carry
+    0. With weights of all ones the weighted mean equals the plain mean
+    (``sum(1·l)/sum(1) == sum(l)/n`` — bitwise inside a compiled step),
+    so the weighted formulation on an exact-fit request IS the training
+    math. (Zero-weight pad rows mask the loss only; their effect on
+    batch_norm's transductive batch statistics is the batcher's
+    documented bucket-fit trade — serve/batcher.py.)
+    """
+
+    def support_loss_fn(f):
+        with jax.named_scope("inner_support_forward"):
+            logits, bn2 = apply_fn(merge_fast_slow(f, slow), bn,
+                                   support_x, step, True)
+            if support_w is None:
+                return cross_entropy(logits, support_y), bn2
+            return weighted_cross_entropy(logits, support_y,
+                                          support_w), bn2
+
+    with jax.named_scope("inner_support_grad"):
+        (s_loss, bn), grads = jax.value_and_grad(
+            support_loss_fn, has_aux=True)(fast)
+    if not second_order:
+        # create_graph=False semantics: inner grads are constants to the
+        # outer differentiation.
+        grads = jax.lax.stop_gradient(grads)
+    with jax.named_scope("inner_lslr_update"):
+        fast = _lslr_update(fast, grads, lslr, step)
+    return fast, bn, s_loss
+
+
 def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
                  bn_state: State, episode: Episode, *, num_steps: int,
                  second_order: bool, use_msl: bool,
@@ -202,22 +246,9 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
         # forward/grad vs LSLR update vs MSL target forward instead of
         # one anonymous while-loop body (docs/PERF.md § Observability).
         fast, bn = carry
-
-        def support_loss_fn(f):
-            with jax.named_scope("inner_support_forward"):
-                logits, bn2 = apply_fn(merge_fast_slow(f, slow), bn,
-                                       episode.support_x, step, True)
-                return cross_entropy(logits, episode.support_y), bn2
-
-        with jax.named_scope("inner_support_grad"):
-            (s_loss, bn), grads = jax.value_and_grad(
-                support_loss_fn, has_aux=True)(fast)
-        if not second_order:
-            # create_graph=False semantics: inner grads are constants to the
-            # outer differentiation.
-            grads = jax.lax.stop_gradient(grads)
-        with jax.named_scope("inner_lslr_update"):
-            fast = _lslr_update(fast, grads, lslr, step)
+        fast, bn, s_loss = support_adapt_step(
+            cfg, apply_fn, slow, lslr, episode.support_x,
+            episode.support_y, fast, bn, step, second_order=second_order)
 
         if batched_msl:
             # Post-update fast weights are stacked by the scan; the target

@@ -19,26 +19,32 @@ import jax.numpy as jnp
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 
 
-def normalize_episode(cfg: MAMLConfig, ep):
+def normalize_images(cfg: MAMLConfig, x):
+    """uint8 wire-format pixels -> normalized f32 (f32 passes through).
+
+    The single decode definition for every device-side consumer — the
+    train/eval episode path below and the serving adapt/predict paths
+    (serve/adapt.py) — so a served request sees exactly the pixels a
+    training episode would.
+    """
+    if x.dtype != jnp.uint8:
+        return x  # host-normalized f32 path
     mean, inv_std, identity = cfg.image_norm_resolved
-    mean_arr = jnp.asarray(mean, jnp.float32)
-    inv_std_arr = jnp.asarray(inv_std, jnp.float32)
+    xf = x.astype(jnp.float32) / 255.0
+    if cfg.reverse_channels:
+        xf = xf[..., ::-1]
+    if not identity:
+        xf = ((xf - jnp.asarray(mean, jnp.float32))
+              * jnp.asarray(inv_std, jnp.float32))
+    return xf
 
-    def norm(x):
-        if x.dtype != jnp.uint8:
-            return x  # host-normalized f32 path
-        xf = x.astype(jnp.float32) / 255.0
-        if cfg.reverse_channels:
-            xf = xf[..., ::-1]
-        if not identity:
-            xf = (xf - mean_arr) * inv_std_arr
-        return xf
 
+def normalize_episode(cfg: MAMLConfig, ep):
     # named_scope threads a profiler/HLO-metadata label through the
     # traced ops — an xprof/trace capture attributes the decode cost to
     # "episode_normalize" instead of an anonymous convert/mul chain.
     with jax.named_scope("episode_normalize"):
         # Episode is a NamedTuple; _replace keeps the pytree type without
         # importing meta.inner (which imports from ops).
-        return ep._replace(support_x=norm(ep.support_x),
-                           target_x=norm(ep.target_x))
+        return ep._replace(support_x=normalize_images(cfg, ep.support_x),
+                           target_x=normalize_images(cfg, ep.target_x))

@@ -18,6 +18,27 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
         optax.softmax_cross_entropy_with_integer_labels(logits, labels))
 
 
+def weighted_cross_entropy(logits: jax.Array, labels: jax.Array,
+                           weights: jax.Array) -> jax.Array:
+    """Weighted-mean softmax cross-entropy: ``sum(w·l) / sum(w)``.
+
+    The serving batcher pads variable-size support sets up to a static
+    bucket shape with zero-weight rows; with all-ones weights this is
+    the plain :func:`cross_entropy` (``sum(1·l)/sum(1) == mean`` —
+    bitwise inside a compiled step, where XLA canonicalizes both forms
+    identically; tests/test_inner.py's adapt parity test pins that, and
+    tests/test_serve.py pins the zero-weight-row loss invisibility).
+    Note the weights mask the LOSS only — whether pad rows are invisible
+    to the whole forward depends on the norm layer (batch_norm's batch
+    statistics see them; serve/batcher.py module docstring).
+    """
+    logits = logits.astype(jnp.promote_types(logits.dtype, jnp.float32))
+    per_example = optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels)
+    weights = weights.astype(per_example.dtype)
+    return jnp.sum(weights * per_example) / jnp.sum(weights)
+
+
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels)
                     .astype(jnp.float32))

@@ -1,0 +1,48 @@
+"""Adaptation-as-a-service: batched few-shot inference over the mesh.
+
+The path from a trained MAML++ meta-initialization to answering a live
+few-shot request — the whole point of meta-learning at deployment time
+(MAML, Finn et al. 2017): a request is a support set (N-way K-shot
+images + labels) plus query images; the response is query predictions
+from parameters adapted via the SAME inner-loop update training uses
+(meta/inner.py § support_adapt_step — one definition, zero drift).
+
+Pieces (docs/SERVING.md has the full lifecycle):
+
+* serve/adapt.py — the adapt-only + batched-predict executables,
+  ``jit(shard_map(...))`` over the training mesh (parallel/mesh.py), so
+  a pod slice serves ``serve_batch_tasks / mesh.size`` tasks per chip
+  per step; first-order, no outer grad, no MSL weighting, donated
+  request buffers.
+* serve/batcher.py — pads/buckets requests to the static
+  ``serve_buckets`` shapes (steady-state serving never recompiles),
+  queue-depth backpressure, per-request deadlines.
+* serve/cache.py — adapted-params LRU keyed by a support-set
+  fingerprint: repeat tasks skip re-adaptation entirely.
+* serve/engine.py — ``ServingEngine``: checkpoint load
+  (utils/checkpoint.py) → batcher → cache → adapt → predict, metrics
+  through the telemetry registry (PR 1).
+* scripts/serve_bench.py — synthetic open-loop load generator emitting
+  a latency/throughput artifact.
+"""
+
+from howtotrainyourmamlpytorch_tpu.serve.batcher import (
+    BucketError,
+    FewShotRequest,
+    QueueFullError,
+    RequestBatcher,
+)
+from howtotrainyourmamlpytorch_tpu.serve.cache import (
+    AdaptedParamsLRU,
+    support_fingerprint,
+)
+from howtotrainyourmamlpytorch_tpu.serve.engine import (
+    FewShotResponse,
+    ServingEngine,
+)
+
+__all__ = [
+    "AdaptedParamsLRU", "BucketError", "FewShotRequest",
+    "FewShotResponse", "QueueFullError", "RequestBatcher",
+    "ServingEngine", "support_fingerprint",
+]

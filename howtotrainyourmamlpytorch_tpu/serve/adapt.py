@@ -1,0 +1,171 @@
+"""Serving executables: adapt-only inner loop + batched query predict.
+
+The adapt-only path is :func:`meta.inner.support_adapt_step` — the SAME
+per-step update the training inner loop scans over — run first-order
+with no outer differentiation, no MSL target forwards and no meta-loss:
+serving never backpropagates through adaptation, so the whole K-step
+loop is one cheap forward-mode scan (no remat needed — there is no
+outer backward to rematerialize for).
+
+Both executables are ``jit(shard_map(...))`` over the training mesh
+(parallel/mesh.py's (dcn, tasks) axes) exactly like the eval step: the
+request batch is task-sharded, model state replicated, per-task results
+``all_gather``-ed back so every host can fulfill responses. The
+``_shard_map`` compat shim in parallel/mesh.py (jax-0.4.37
+``check_rep``/``check_vma``) applies to this path too — serving rides
+the identical formulation, so the partitioner never sees the per-task
+grouped convs (docs/SERVING.md).
+
+The incoming request buffers are DONATED on the f32 wire path: a
+serving process redispatches the adapt step continuously and the padded
+support/query/weight arrays are dead the moment the step consumes them
+— donation hands their HBM back instead of holding a second copy per
+in-flight batch. (The default uint8 wire skips donation: XLA realizes
+donation through input-output aliasing and uint8 pixels can never alias
+the f32 outputs, so it would warn per executable with zero benefit.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta.inner import (
+    merge_fast_slow, split_fast_slow, support_adapt_step)
+from howtotrainyourmamlpytorch_tpu.ops.episode import normalize_images
+from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+    _shard_map, batch_sharding, replicated_sharding)
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+
+class AdaptedTask(NamedTuple):
+    """Per-task adaptation result (leaves carry a leading task axis when
+    produced by the batched step). ``fast`` holds ONLY the inner-adapted
+    leaves — the slow (meta-only) leaves stay replicated in the engine's
+    train state and are merged back at predict time, so the LRU cache
+    never duplicates them per task."""
+    fast: Params
+    bn_state: State
+    support_loss: jax.Array
+
+
+def adapt_task(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
+               bn_state: State, support_x: jax.Array, support_y: jax.Array,
+               support_w: jax.Array, *, num_steps: int) -> AdaptedTask:
+    """Adapt to ONE task: K first-order support steps, nothing else.
+
+    Exactly the training inner loop's support chain (the scan body is
+    :func:`support_adapt_step`, shared with ``task_forward``), minus
+    everything serving doesn't need: no outer grad (first-order by
+    construction — there is no outer loss), no MSL target forwards, no
+    remat. ``support_w`` masks padded support rows (all-ones == the
+    training math bitwise; tests/test_inner.py § test_adapt_only_parity).
+    """
+    support_x = normalize_images(cfg, support_x)
+    fast0, slow = split_fast_slow(cfg, params)
+
+    def body(carry, step):
+        fast, bn = carry
+        fast, bn, s_loss = support_adapt_step(
+            cfg, apply_fn, slow, lslr, support_x, support_y, fast, bn,
+            step, second_order=False, support_w=support_w)
+        return (fast, bn), s_loss
+
+    (fast, bn), s_losses = jax.lax.scan(
+        body, (fast0, bn_state), jnp.arange(num_steps))
+    return AdaptedTask(fast=fast, bn_state=bn,
+                       support_loss=jnp.mean(s_losses))
+
+
+class ServeSteps(NamedTuple):
+    """Compiled serving executables for one (cfg, mesh) pair.
+
+    ``adapt(state_params, lslr, bn_state, support_x, support_y,
+    support_w) -> AdaptedTask`` (stacked over the task axis) and
+    ``predict(state_params, fast_stack, bn_stack, query_x) -> logits``
+    ((B, Q, N), replicated). Both jit-cache per static request shape, so
+    warming each configured bucket once makes steady-state serving
+    compile-free (the acceptance guarantee; tests/test_serve.py).
+    """
+    adapt: Callable[..., AdaptedTask]
+    predict: Callable[..., jax.Array]
+    mesh: Any
+
+
+def make_serve_steps(cfg: MAMLConfig, apply_fn, mesh) -> ServeSteps:
+    """Build the sharded adapt-only and batched-predict executables.
+
+    Same formulation as make_sharded_steps: ``jit(shard_map(step))``,
+    state replicated, the request batch task-sharded over every mesh
+    axis, outputs all-gathered/replicated. The global task batch is
+    ``cfg.serve_batch_tasks`` (validated to divide the mesh size);
+    per-task adaptation compiles device-local, and serving issues
+    exactly ONE collective per step — the trailing tiled all_gather of
+    the per-task results.
+    """
+    if cfg.serve_batch_tasks % mesh.size != 0:
+        raise ValueError(
+            f"serve_batch_tasks {cfg.serve_batch_tasks} not divisible by "
+            f"mesh size {mesh.size}")
+    num_steps = cfg.effective_serve_adapt_steps
+    axes = tuple(mesh.axis_names)
+    batch_spec = jax.sharding.PartitionSpec(axes)
+    P = jax.sharding.PartitionSpec
+    repl = replicated_sharding(mesh)
+    bsh = batch_sharding(mesh)
+    # Request buffers are single-use; donation hands their HBM back the
+    # moment a step consumes them. Only the f32 wire path donates: XLA
+    # realizes donation through input-output aliasing, and the uint8
+    # wire's pixel buffers (and int32 labels) can never alias the f32
+    # outputs — the donation would be rejected with a per-executable
+    # warning and zero benefit.
+    f32_wire = not cfg.transfer_images_uint8
+
+    def adapt_shard(params, lslr, bn_state, sx, sy, sw):
+        def one(sx1, sy1, sw1):
+            with jax.named_scope("serve_adapt"):
+                return adapt_task(cfg, apply_fn, params, lslr, bn_state,
+                                  sx1, sy1, sw1, num_steps=num_steps)
+        out = jax.vmap(one)(sx, sy, sw)
+        return jax.lax.all_gather(out, axis_name=axes, axis=0, tiled=True)
+
+    adapt = jax.jit(
+        _shard_map(adapt_shard, mesh=mesh,
+                   in_specs=(P(), P(), P(), batch_spec, batch_spec,
+                             batch_spec),
+                   out_specs=P(),
+                   check_vma=False),
+        in_shardings=(repl, repl, repl, bsh, bsh, bsh),
+        out_shardings=repl,
+        donate_argnums=(3, 5) if f32_wire else (),
+    )
+
+    def predict_shard(params, fast_stack, bn_stack, qx):
+        _, slow = split_fast_slow(cfg, params)
+
+        def one(fast1, bn1, qx1):
+            with jax.named_scope("serve_predict"):
+                logits, _ = apply_fn(
+                    merge_fast_slow(fast1, slow), bn1,
+                    normalize_images(cfg, qx1),
+                    jnp.int32(num_steps - 1), True)
+            return logits
+        logits = jax.vmap(one)(fast_stack, bn_stack, qx)
+        return jax.lax.all_gather(logits, axis_name=axes, axis=0,
+                                  tiled=True)
+
+    predict = jax.jit(
+        _shard_map(predict_shard, mesh=mesh,
+                   in_specs=(P(), batch_spec, batch_spec, batch_spec),
+                   out_specs=P(),
+                   check_vma=False),
+        in_shardings=(repl, bsh, bsh, bsh),
+        out_shardings=repl,
+        donate_argnums=(3,) if f32_wire else (),
+    )
+    return ServeSteps(adapt=adapt, predict=predict, mesh=mesh)

@@ -1,0 +1,282 @@
+"""Request batcher: pad/bucket to static shapes, backpressure, deadlines.
+
+Steady-state serving must never recompile: XLA executables are compiled
+per static shape, so a request stream with arbitrary (support, query)
+sizes would retrace on every novel geometry. The batcher maps every
+request onto a SMALL fixed set of shape buckets (``cfg.serve_buckets``):
+
+* the support set is padded up to the bucket's support size with
+  zero-WEIGHT rows (the adapt loss is a weighted mean — pad rows
+  contribute nothing to the loss or its gradients; ops/losses.py §
+  weighted_cross_entropy);
+* the query set is padded up to the bucket's query size (pad query rows
+  cost compute but their predictions are sliced off before the
+  response);
+* a partially-filled batch is padded up to ``serve_batch_tasks`` by
+  replicating a real task (its outputs are discarded; tasks are
+  vmapped, so batch neighbors never affect each other's results) — the
+  occupancy histogram records the waste.
+
+Padding EXACTNESS depends on the norm layer. Under ``layer_norm``
+(per-example normalization) pad rows are fully invisible: a padded
+request adapts and predicts identically to an unpadded one (pinned in
+tests/test_serve.py). Under ``batch_norm`` — the default, and the
+reference's semantics — normalization uses the BATCH statistics of the
+whole support (resp. query) set, transductively, so zero pad rows
+shift the mean/var every real row is normalized with: a request that
+exactly fills its bucket is exact (the tests/test_inner.py parity
+test), a smaller one is a controlled approximation — the same
+transductive batch-composition sensitivity the reference model itself
+has. Deployments that need exactness for several geometries configure
+one bucket per served (support, query) size; ``bucket_for`` picks the
+smallest fit, so exact-size buckets win automatically
+(docs/SERVING.md § Bucketing).
+
+Admission control is queue-depth backpressure (``QueueFullError`` at
+``serve_max_queue_depth`` — the caller sheds load instead of the queue
+growing unboundedly) plus per-request deadlines: a request whose
+deadline passes while queued is dropped at dequeue time and answered
+with a ``deadline_exceeded`` error response (adapting for a caller
+that already gave up wastes a batch slot someone else could use).
+
+Pure host-side code (numpy only) — unit-testable without compiles.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class QueueFullError(RuntimeError):
+    """Backpressure: the request queue is at serve_max_queue_depth."""
+
+
+class BucketError(ValueError):
+    """The request fits no configured shape bucket (or violates the
+    deployment's wire dtype)."""
+
+
+_ids = itertools.count()
+
+
+@dataclass
+class FewShotRequest:
+    """One few-shot task: support set + query images.
+
+    ``support_x``: (S, H, W, C) uint8 or f32; ``support_y``: (S,) int in
+    [0, N-way); ``query_x``: (Q, H, W, C). ``deadline`` is an ABSOLUTE
+    ``time.monotonic()`` instant (None = the engine applies the config
+    default). ``arrival_time`` defaults to construction time so latency
+    measurements include queueing.
+    """
+    support_x: np.ndarray
+    support_y: np.ndarray
+    query_x: np.ndarray
+    deadline: Optional[float] = None
+    request_id: int = field(default_factory=lambda: next(_ids))
+    arrival_time: float = field(default_factory=time.monotonic)
+
+    def __post_init__(self) -> None:
+        self.support_x = np.asarray(self.support_x)
+        self.support_y = np.asarray(self.support_y)
+        self.query_x = np.asarray(self.query_x)
+        if self.support_x.ndim != 4 or self.query_x.ndim != 4:
+            raise ValueError(
+                f"support_x/query_x must be (n, H, W, C), got "
+                f"{self.support_x.shape} / {self.query_x.shape}")
+        if self.support_y.shape != (self.support_x.shape[0],):
+            raise ValueError(
+                f"support_y shape {self.support_y.shape} does not match "
+                f"support_x count {self.support_x.shape[0]}")
+
+    @property
+    def num_support(self) -> int:
+        return int(self.support_x.shape[0])
+
+    @property
+    def num_query(self) -> int:
+        return int(self.query_x.shape[0])
+
+
+class RequestBatcher:
+    """FIFO queue of requests, grouped by shape bucket at dequeue time.
+
+    ``submit`` is O(1) and thread-safe (a frontend thread enqueues while
+    the engine loop dequeues). ``next_group`` returns up to
+    ``max_tasks`` queued requests sharing the HEAD-of-line request's
+    bucket (strict-FIFO head start, so no bucket starves) plus the
+    expired requests it skipped over.
+    """
+
+    def __init__(self, buckets: Sequence[Tuple[int, int]],
+                 max_queue_depth: int,
+                 default_deadline_ms: float = 0.0,
+                 wire_dtype: Optional[np.dtype] = None,
+                 image_shape: Optional[Tuple[int, int, int]] = None,
+                 num_classes: Optional[int] = None):
+        if not buckets:
+            raise ValueError("need at least one shape bucket")
+        self.buckets: Tuple[Tuple[int, int], ...] = tuple(
+            sorted((int(s), int(q)) for s, q in buckets))
+        self.max_queue_depth = int(max_queue_depth)
+        self.default_deadline_ms = float(default_deadline_ms)
+        # Admission-control contracts (None = unchecked). Everything the
+        # compiled steps assume about a request is validated at submit,
+        # where a violation is one rejected request — not at batch
+        # assembly, where it would crash the engine loop and lose the
+        # whole dequeued group:
+        # * wire_dtype — the image dtype is part of the executables'
+        #   signature (off-dtype traffic would recompile) and of batch
+        #   assembly (a mixed-dtype group would silently numpy-cast the
+        #   minority request's pixels);
+        # * image_shape — (H, W, C) of the deployment;
+        # * num_classes — labels must lie in [0, N): out-of-range labels
+        #   don't error under jit (the gather clamps), they silently
+        #   corrupt the adaptation AND the cache entry for that support
+        #   set.
+        self.wire_dtype = None if wire_dtype is None else np.dtype(
+            wire_dtype)
+        self.image_shape = (None if image_shape is None
+                            else tuple(int(v) for v in image_shape))
+        self.num_classes = None if num_classes is None else int(num_classes)
+        self._queue: Deque[Tuple[FewShotRequest, Tuple[int, int]]] = deque()
+        self._lock = threading.Lock()
+
+    def bucket_for(self, num_support: int,
+                   num_query: int) -> Tuple[int, int]:
+        """Smallest configured bucket that fits (support-major order —
+        support padding costs adaptation compute on every inner step,
+        query padding only one forward)."""
+        for s, q in self.buckets:
+            if num_support <= s and num_query <= q:
+                return (s, q)
+        raise BucketError(
+            f"no serve bucket fits a request with {num_support} support "
+            f"/ {num_query} query examples (buckets: {self.buckets})")
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, req: FewShotRequest,
+               now: Optional[float] = None) -> Tuple[int, int]:
+        """Enqueue; returns the bucket the request resolved to. Raises
+        :class:`BucketError` (no fitting shape) or
+        :class:`QueueFullError` (backpressure) — both BEFORE the request
+        enters the queue, so a rejected submit has no side effects."""
+        for name, arr in (("support_x", req.support_x),
+                          ("query_x", req.query_x)):
+            if (self.wire_dtype is not None
+                    and arr.dtype != self.wire_dtype):
+                raise BucketError(
+                    f"request {name} dtype {arr.dtype} does not match "
+                    f"the serving wire dtype {self.wire_dtype} (the "
+                    f"image dtype is part of the compiled executable "
+                    f"signature and of batch assembly)")
+            if (self.image_shape is not None
+                    and tuple(arr.shape[1:]) != self.image_shape):
+                raise BucketError(
+                    f"request {name} images are {tuple(arr.shape[1:])} "
+                    f"but this deployment serves {self.image_shape}")
+        if self.num_classes is not None and req.support_y.size:
+            lo, hi = int(req.support_y.min()), int(req.support_y.max())
+            if lo < 0 or hi >= self.num_classes:
+                raise BucketError(
+                    f"support_y labels span [{lo}, {hi}] but this "
+                    f"deployment is {self.num_classes}-way (labels must "
+                    f"lie in [0, {self.num_classes})); out-of-range "
+                    f"labels would silently corrupt the adaptation)")
+        bucket = self.bucket_for(req.num_support, req.num_query)
+        stamp_deadline = (req.deadline is None
+                          and self.default_deadline_ms > 0)
+        with self._lock:
+            if len(self._queue) >= self.max_queue_depth:
+                raise QueueFullError(
+                    f"serve queue at max depth {self.max_queue_depth}")
+            if stamp_deadline:
+                # Stamped only once admission is certain: a rejected
+                # submit must leave the request untouched (the caller
+                # may retry it later, and the deadline clock must not
+                # have been running while it was never queued).
+                now = time.monotonic() if now is None else now
+                req.deadline = now + self.default_deadline_ms / 1e3
+            self._queue.append((req, bucket))
+        return bucket
+
+    def next_group(self, max_tasks: int, now: Optional[float] = None
+                   ) -> Tuple[Tuple[int, int],
+                              List[FewShotRequest],
+                              List[FewShotRequest]]:
+        """Dequeue up to ``max_tasks`` same-bucket requests.
+
+        Returns ``(bucket, group, expired)``. The bucket is the oldest
+        live request's; younger requests of OTHER buckets stay queued in
+        order (they'll head the next group). Expired requests — from any
+        bucket encountered while scanning — are removed and returned
+        separately for error responses + the deadline-miss metric.
+        """
+        now = time.monotonic() if now is None else now
+        group: List[FewShotRequest] = []
+        expired: List[FewShotRequest] = []
+        with self._lock:
+            kept: Deque[Tuple[FewShotRequest, Tuple[int, int]]] = deque()
+            bucket: Optional[Tuple[int, int]] = None
+            for req, b in self._queue:
+                if req.deadline is not None and now > req.deadline:
+                    expired.append(req)
+                    continue
+                if bucket is None and len(group) == 0:
+                    bucket = b
+                if b == bucket and len(group) < max_tasks:
+                    group.append(req)
+                else:
+                    kept.append((req, b))
+            self._queue = kept
+        return (bucket or self.buckets[0]), group, expired
+
+
+def pad_group(group: Sequence[FewShotRequest], bucket: Tuple[int, int],
+              batch_tasks: int, image_shape: Tuple[int, int, int]
+              ) -> Dict[str, np.ndarray]:
+    """Assemble a group into the static (batch_tasks, bucket) arrays.
+
+    Support rows are padded with zeros at WEIGHT 0 (invisible to the
+    weighted adapt loss; exactness under batch_norm's transductive
+    statistics is bucket-fit-dependent — module docstring); query rows
+    with zeros (their predictions are sliced off); missing TASKS
+    replicate task 0 (their outputs are discarded). Returns
+    support_x/support_y/support_w/query_x plus ``occupancy`` (real
+    tasks / batch slots).
+    """
+    if not group:
+        raise ValueError("empty group")
+    if len(group) > batch_tasks:
+        raise ValueError(f"group of {len(group)} exceeds batch_tasks "
+                         f"{batch_tasks}")
+    s_b, q_b = bucket
+    h, w, c = image_shape
+    x_dtype = group[0].support_x.dtype
+    sx = np.zeros((batch_tasks, s_b, h, w, c), x_dtype)
+    sy = np.zeros((batch_tasks, s_b), np.int32)
+    sw = np.zeros((batch_tasks, s_b), np.float32)
+    qx = np.zeros((batch_tasks, q_b, h, w, c), x_dtype)
+    for i, req in enumerate(group):
+        s, q = req.num_support, req.num_query
+        sx[i, :s] = req.support_x
+        sy[i, :s] = req.support_y
+        sw[i, :s] = 1.0
+        qx[i, :q] = req.query_x
+    for i in range(len(group), batch_tasks):
+        # Replica of task 0, NOT zero-weight rows: an all-zero weight
+        # vector would divide by zero inside the weighted loss.
+        sx[i], sy[i], sw[i], qx[i] = sx[0], sy[0], sw[0], qx[0]
+    return {"support_x": sx, "support_y": sy, "support_w": sw,
+            "query_x": qx,
+            "occupancy": len(group) / float(batch_tasks)}

@@ -1,0 +1,87 @@
+"""Adapted-params LRU cache keyed by a support-set fingerprint.
+
+Few-shot serving traffic repeats tasks: the same user/tenant sends the
+same support set with fresh queries (the "adapt once, predict many"
+pattern). Adaptation is the expensive half of a request (K inner
+forward+grad steps vs one predict forward), so a repeat task should skip
+it entirely — the cache stores the adapted fast params + norm state per
+support-set fingerprint and the engine goes straight to predict on a
+hit (asserted by a counter in tests/test_serve.py, the tier-1
+acceptance check).
+
+The fingerprint is a sha256 over the support arrays' CONTENT (bytes +
+shape + dtype, C-contiguous so memory layout never aliases two equal
+sets apart) plus the adaptation geometry (step count) and a caller
+context string (the engine passes the checkpoint fingerprint: a cache
+entry must die with the weights that produced it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Optional
+
+import numpy as np
+
+
+def support_fingerprint(support_x, support_y, num_steps: int,
+                        context: str = "") -> str:
+    """Content fingerprint of one support set + adaptation geometry."""
+    h = hashlib.sha256()
+    for arr in (support_x, support_y):
+        arr = np.ascontiguousarray(arr)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    h.update(f"steps={num_steps};{context}".encode())
+    return h.hexdigest()
+
+
+class AdaptedParamsLRU:
+    """Thread-safe LRU of fingerprint -> adapted (fast params, bn state).
+
+    ``get`` refreshes recency; ``put`` evicts the least-recently-used
+    entry past ``capacity``. Capacity 0 disables caching (every get
+    misses, puts are dropped) — the engine stays cache-agnostic.
+    Hit/miss/eviction counts are plain attributes; the engine mirrors
+    them into telemetry counters after each step.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity < 0:
+            raise ValueError(f"capacity must be >= 0, got {capacity}")
+        self.capacity = int(capacity)
+        self._entries: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return self._entries[key]
+            self.misses += 1
+            return None
+
+    def put(self, key: str, value: Any) -> None:
+        if self.capacity == 0:
+            return
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+            self._entries[key] = value
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()

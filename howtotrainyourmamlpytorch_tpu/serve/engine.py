@@ -1,0 +1,354 @@
+"""ServingEngine: checkpoint → batcher → cache → adapt → predict.
+
+The request lifecycle (docs/SERVING.md):
+
+1. ``submit`` buckets the request (BucketError if nothing fits) and
+   enqueues it (QueueFullError past ``serve_max_queue_depth``).
+2. ``step`` dequeues one same-bucket group, dropping requests whose
+   deadline already passed (answered with an error — adapting for a
+   caller that gave up wastes a batch slot).
+3. Each request's support set is fingerprinted; cache hits skip
+   adaptation entirely. Misses are padded into ONE static-shape batch
+   and adapted by the compiled adapt-only step (meta/inner.py's update,
+   first-order, no outer grad), then cached.
+4. One compiled batched predict over the whole group (hits + fresh)
+   produces query logits; per-request padding is sliced off and
+   responses carry argmax predictions + logits.
+
+Every stage records into the PR-1 telemetry registry (queue depth,
+batch occupancy, adapt/predict/end-to-end latency histograms, cache
+hit/miss/eviction, deadline misses); ``flush_metrics`` lands one
+``metrics`` row in events.jsonl that scripts/telemetry_report.py
+renders as the "serving" section.
+
+Single-process by design: serving replicates the (frozen) train state
+over the local mesh; multi-host serving would shard the mesh's ``dcn``
+axis exactly like training, but the queue/cache are per-process.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta.outer import (
+    MetaTrainState, init_train_state, migrate_lslr_rows,
+    reconcile_loaded_shapes, state_leaf_shapes)
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+    make_mesh, replicated_sharding)
+from howtotrainyourmamlpytorch_tpu.serve.adapt import (
+    AdaptedTask, make_serve_steps)
+from howtotrainyourmamlpytorch_tpu.serve.batcher import (
+    FewShotRequest, QueueFullError, RequestBatcher, pad_group)
+from howtotrainyourmamlpytorch_tpu.serve.cache import (
+    AdaptedParamsLRU, support_fingerprint)
+from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+    LATEST, CheckpointManager)
+from howtotrainyourmamlpytorch_tpu.utils.tracing import JsonlLogger
+
+# Batch occupancy lives in [1/B, 1]; the registry's default exponential
+# buckets would dump every observation into two slots.
+_OCCUPANCY_BUCKETS = tuple(i / 16 for i in range(1, 17))
+
+
+@dataclass
+class FewShotResponse:
+    """Per-request result. ``predictions`` are argmax class ids over the
+    request's REAL query rows (padding sliced off); ``logits`` the
+    matching (Q, N) array. ``error`` is set (and the arrays None) for
+    deadline misses."""
+    request_id: int
+    predictions: Optional[np.ndarray]
+    logits: Optional[np.ndarray]
+    cache_hit: bool
+    latency_seconds: float
+    error: Optional[str] = None
+
+
+class ServingEngine:
+    """Batched few-shot inference from a trained meta-initialization."""
+
+    def __init__(self, cfg: MAMLConfig, state: MetaTrainState,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 state_context: str = ""):
+        self.cfg = cfg
+        devices = list(devices if devices is not None else jax.devices())
+        n_mesh = int(math.prod(cfg.mesh_shape))
+        if n_mesh > len(devices):
+            raise ValueError(
+                f"mesh_shape {cfg.mesh_shape} needs {n_mesh} devices, "
+                f"got {len(devices)}")
+        self.model_init, self.model_apply = make_model(cfg)
+        self.mesh = make_mesh(cfg, devices[:n_mesh])
+        self.steps = make_serve_steps(cfg, self.model_apply, self.mesh)
+        self.num_adapt_steps = cfg.effective_serve_adapt_steps
+        self.state = jax.device_put(state, replicated_sharding(self.mesh))
+        # Cache entries must die with the weights that produced them:
+        # the fingerprint folds in this context (checkpoint fingerprint
+        # when loaded via from_checkpoint).
+        self._fp_context = state_context
+        self.batcher = RequestBatcher(
+            cfg.serve_bucket_shapes,
+            max_queue_depth=cfg.serve_max_queue_depth,
+            default_deadline_ms=cfg.serve_default_deadline_ms,
+            # Admission contracts mirror what the compiled steps assume
+            # (wire dtype matches warmup so steady state can never meet
+            # an uncompiled signature; geometry/labels are checked where
+            # a violation rejects ONE request instead of crashing a
+            # dequeued group at batch assembly).
+            wire_dtype=(np.uint8 if cfg.transfer_images_uint8
+                        else np.float32),
+            image_shape=cfg.image_shape,
+            num_classes=cfg.num_classes_per_set)
+        self.cache = AdaptedParamsLRU(cfg.serve_cache_capacity)
+        self.registry = registry if registry is not None else (
+            MetricsRegistry())
+        # Steady-state no-recompile guarantee is OBSERVABLE, not hoped:
+        # the process-wide compile listener counts every XLA compile
+        # into this registry; after warmup() the counter must go flat
+        # (tests/test_serve.py § slow no-recompile test).
+        self._compile_watch = instrument_compiles(self.registry)
+        # Python-side adapt counter: the tier-1 cache-hit acceptance
+        # check ("a hit returns without invoking the adapt step")
+        # asserts on this, independent of registry wiring.
+        self.adapt_invocations = 0
+        self._cache_mirrored = (0, 0, 0)  # hits, misses, evictions
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, cfg: MAMLConfig,
+                        directory: Optional[str] = None, tag=LATEST,
+                        devices: Optional[Sequence[jax.Device]] = None,
+                        registry: Optional[MetricsRegistry] = None
+                        ) -> "ServingEngine":
+        """Load a trained state via CheckpointManager (the training-side
+        writer): template from a fresh init, then the same
+        migrate/reconcile chain ExperimentBuilder resumes through, so
+        any checkpoint a run can resume from can also be served."""
+        if directory is None:
+            directory = os.path.join(cfg.experiment_root,
+                                     cfg.experiment_name, "saved_models")
+        ckpt = CheckpointManager(directory,
+                                 max_to_keep=cfg.max_models_to_save)
+        model_init, _ = make_model(cfg)
+        template = init_train_state(cfg, model_init,
+                                    jax.random.PRNGKey(cfg.seed))
+        template_shapes = state_leaf_shapes(template)
+        state, _meta = ckpt.load(template, tag)
+        state = migrate_lslr_rows(cfg, state)
+        state = reconcile_loaded_shapes(cfg, state, template_shapes)
+        return cls(cfg, state, devices=devices, registry=registry,
+                   state_context=f"ckpt:{tag}:{ckpt.fingerprint(tag)}")
+
+    def close(self) -> None:
+        """Detach the process-wide compile listener (a test or driver
+        may build many engines; each should count only its own)."""
+        self._compile_watch.uninstall()
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- request path ----------------------------------------------------
+    def submit(self, req: FewShotRequest,
+               now: Optional[float] = None) -> Tuple[int, int]:
+        """Enqueue one request; returns its shape bucket. Raises
+        BucketError/QueueFullError before any side effect (the caller
+        sheds load); both rejections are counted."""
+        reg = self.registry
+        try:
+            bucket = self.batcher.submit(req, now=now)
+        except (QueueFullError, ValueError):
+            reg.counter("serve/rejected_total").inc()
+            raise
+        reg.counter("serve/requests_total").inc()
+        reg.gauge("serve/queue_depth").set(self.batcher.depth)
+        return bucket
+
+    def warmup(self) -> None:
+        """Compile every configured bucket's adapt + predict executable
+        on synthetic zero requests (wire dtype from
+        ``transfer_images_uint8``, matching what real traffic ships).
+        After this, steady-state serving over the configured buckets
+        adds ZERO compiles — the acceptance guarantee."""
+        h, w, c = self.cfg.image_shape
+        dtype = (np.uint8 if self.cfg.transfer_images_uint8
+                 else np.float32)
+        for s_b, q_b in self.batcher.buckets:
+            req = FewShotRequest(
+                support_x=np.zeros((s_b, h, w, c), dtype),
+                support_y=np.zeros((s_b,), np.int32),
+                query_x=np.zeros((q_b, h, w, c), dtype),
+                deadline=float("inf"))
+            batch = pad_group([req], (s_b, q_b),
+                              self.cfg.serve_batch_tasks,
+                              self.cfg.image_shape)
+            # record=False: the first call per bucket is dominated by
+            # the XLA compile — letting it into the adapt/predict
+            # histograms (or the adapt counters) would misreport
+            # steady-state serving cost.
+            adapted = self._run_adapt(batch, record=False)
+            entry = jax.tree.map(lambda x: x[0], adapted)
+            self._run_predict([entry], [req], (s_b, q_b),
+                              record=False)
+
+    def step(self, now: Optional[float] = None) -> List[FewShotResponse]:
+        """Serve ONE batch: dequeue a same-bucket group, answer expired
+        requests with errors, adapt the cache misses (one compiled
+        batch), predict for everyone, respond. Returns [] when idle."""
+        reg = self.registry
+        bucket, group, expired = self.batcher.next_group(
+            self.cfg.serve_batch_tasks, now=now)
+        responses: List[FewShotResponse] = []
+        t_now = time.monotonic() if now is None else now
+        for req in expired:
+            reg.counter("serve/deadline_misses").inc()
+            responses.append(FewShotResponse(
+                request_id=req.request_id, predictions=None, logits=None,
+                cache_hit=False,
+                latency_seconds=t_now - req.arrival_time,
+                error="deadline_exceeded"))
+        reg.gauge("serve/queue_depth").set(self.batcher.depth)
+        if not group:
+            return responses
+
+        # Cache lookup per request (hits skip adaptation entirely).
+        keys = [support_fingerprint(r.support_x, r.support_y,
+                                    self.num_adapt_steps,
+                                    context=self._fp_context)
+                for r in group]
+        entries: Dict[int, Any] = {}
+        hit_flags: List[bool] = []
+        misses: List[int] = []
+        for i, key in enumerate(keys):
+            cached = self.cache.get(key)
+            hit_flags.append(cached is not None)
+            if cached is not None:
+                entries[i] = cached
+            else:
+                misses.append(i)
+
+        if misses:
+            batch = pad_group([group[i] for i in misses], bucket,
+                              self.cfg.serve_batch_tasks,
+                              self.cfg.image_shape)
+            reg.histogram("serve/batch_occupancy",
+                          buckets=_OCCUPANCY_BUCKETS).observe(
+                              batch["occupancy"])
+            adapted = self._run_adapt(batch)
+            for j, i in enumerate(misses):
+                entry = jax.tree.map(lambda x, j=j: x[j], adapted)
+                entries[i] = entry
+                self.cache.put(keys[i], entry)
+
+        logits = self._run_predict([entries[i] for i in range(len(group))],
+                                   group, bucket)
+        t_done = time.monotonic()
+        for i, req in enumerate(group):
+            lg = np.asarray(logits[i, :req.num_query])
+            reg.counter("serve/responses_total").inc()
+            reg.histogram("serve/latency_seconds").observe(
+                t_done - req.arrival_time)
+            responses.append(FewShotResponse(
+                request_id=req.request_id,
+                predictions=np.argmax(lg, axis=-1),
+                logits=lg,
+                cache_hit=hit_flags[i],
+                latency_seconds=t_done - req.arrival_time))
+        self._mirror_cache_counters()
+        return responses
+
+    def drain(self) -> List[FewShotResponse]:
+        """Serve until the queue is empty (test/bench convenience; a
+        real frontend calls ``step`` from its own loop)."""
+        out: List[FewShotResponse] = []
+        while self.batcher.depth:
+            out.extend(self.step())
+        return out
+
+    # -- compiled-step wrappers ------------------------------------------
+    def _run_adapt(self, batch: Dict[str, np.ndarray],
+                   record: bool = True) -> AdaptedTask:
+        """One compiled adapt-only step over a padded miss batch; timed
+        with a hard sync so the histogram measures device time, not
+        dispatch time. ``record=False`` (warmup) keeps compile-dominated
+        calls out of the steady-state metrics."""
+        t0 = time.perf_counter()
+        adapted = self.steps.adapt(
+            self.state.params, self.state.lslr, self.state.bn_state,
+            batch["support_x"], batch["support_y"], batch["support_w"])
+        jax.block_until_ready(adapted.support_loss)
+        if record:
+            self.registry.histogram("serve/adapt_seconds").observe(
+                time.perf_counter() - t0)
+            self.registry.counter("serve/adapt_batches").inc()
+            self.adapt_invocations += 1
+        return adapted
+
+    def _run_predict(self, entries: List[Any],
+                     group: List[FewShotRequest],
+                     bucket: Tuple[int, int],
+                     record: bool = True) -> np.ndarray:
+        """One compiled predict step over the group's adapted params
+        (batch padded by replicating entry 0)."""
+        b = self.cfg.serve_batch_tasks
+        q_b = bucket[1]
+        h, w, c = self.cfg.image_shape
+        padded = entries + [entries[0]] * (b - len(entries))
+        fast_stack = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                  *[e.fast for e in padded])
+        bn_stack = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                *[e.bn_state for e in padded])
+        qx = np.zeros((b, q_b, h, w, c), group[0].query_x.dtype)
+        for i, req in enumerate(group):
+            qx[i, :req.num_query] = req.query_x
+        for i in range(len(group), b):
+            qx[i] = qx[0]
+        t0 = time.perf_counter()
+        logits = self.steps.predict(self.state.params, fast_stack,
+                                    bn_stack, qx)
+        logits = np.asarray(jax.device_get(logits))
+        if record:
+            self.registry.histogram("serve/predict_seconds").observe(
+                time.perf_counter() - t0)
+        return logits
+
+    # -- telemetry -------------------------------------------------------
+    def _mirror_cache_counters(self) -> None:
+        """LRU counts -> monotonic registry counters (delta-mirrored:
+        the cache keeps plain ints so it stays registry-agnostic)."""
+        reg = self.registry
+        h, m, e = (self.cache.hits, self.cache.misses,
+                   self.cache.evictions)
+        ph, pm, pe = self._cache_mirrored
+        reg.counter("serve/cache_hits").inc(h - ph)
+        reg.counter("serve/cache_misses").inc(m - pm)
+        reg.counter("serve/cache_evictions").inc(e - pe)
+        self._cache_mirrored = (h, m, e)
+        reg.gauge("serve/cache_size").set(len(self.cache))
+        total = h + m
+        if total:
+            reg.gauge("serve/cache_hit_frac").set(h / total)
+
+    def flush_metrics(self, jsonl: JsonlLogger,
+                      **extra: Any) -> Dict[str, Any]:
+        """One ``metrics`` row carrying the full serve/* snapshot —
+        the row scripts/telemetry_report.py keys its "serving" section
+        on."""
+        self._mirror_cache_counters()
+        self.registry.gauge("serve/queue_depth").set(self.batcher.depth)
+        return self.registry.flush_jsonl(jsonl, **extra)

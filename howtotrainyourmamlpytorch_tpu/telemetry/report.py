@@ -15,7 +15,7 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, Optional, Union
 
-SCHEMA = "maml_tpu_telemetry_report_v1"
+SCHEMA = "maml_tpu_telemetry_report_v2"  # v2: + "serving" section
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -90,6 +90,39 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     lives = _finite([(e.get("memory") or {}).get("live_bytes_total")
                      for e in telemetry])
 
+    # Serving section (serve/ subsystem): serve metrics ride registry
+    # "metrics" rows; counters/gauges are cumulative so the LAST row
+    # carrying serve/* keys wins. Runs that never served summarize the
+    # whole section to "unavailable".
+    serving: Union[Dict[str, Any], str] = UNAVAILABLE
+    for e in events:
+        if e.get("event") != "metrics":
+            continue
+        m = e.get("metrics") or {}
+        if not any(k.startswith("serve/") for k in m):
+            continue
+        latency = m.get("serve/latency_seconds") or {}
+
+        def _ms(v: Any) -> Metric:
+            return (round(float(v) * 1e3, 3)
+                    if isinstance(v, (int, float)) else UNAVAILABLE)
+
+        hits = float(m.get("serve/cache_hits") or 0)
+        misses = float(m.get("serve/cache_misses") or 0)
+        serving = {
+            "requests": int(m.get("serve/requests_total") or 0),
+            "responses": int(m.get("serve/responses_total") or 0),
+            "rejected": int(m.get("serve/rejected_total") or 0),
+            "deadline_misses": int(m.get("serve/deadline_misses") or 0),
+            "cache_hit_frac": (round(hits / (hits + misses), 4)
+                               if hits + misses > 0 else UNAVAILABLE),
+            "latency_p50_ms": _ms(latency.get("p50")),
+            "latency_p95_ms": _ms(latency.get("p95")),
+            "queue_depth": (int(m["serve/queue_depth"])
+                            if m.get("serve/queue_depth") is not None
+                            else UNAVAILABLE),
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -118,6 +151,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "peak_memory_bytes": (int(max(peaks)) if peaks else UNAVAILABLE),
         "live_memory_bytes": (int(max(lives)) if lives else UNAVAILABLE),
         "host_skew": host_skew,
+        "serving": serving,
     }
 
 
@@ -144,6 +178,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("peak memory bytes/device", summary["peak_memory_bytes"]),
         ("live memory bytes total", summary["live_memory_bytes"]),
         ("per-host step skew", summary["host_skew"]),
+        ("serving", summary["serving"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

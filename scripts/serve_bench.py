@@ -1,0 +1,243 @@
+"""Serving benchmark: open-loop synthetic load against ServingEngine.
+
+Drives the serve/ subsystem the way a latency benchmark must be driven:
+OPEN-LOOP — request arrival times are drawn up front from the target
+rate and never wait on completions, so queueing delay under overload is
+measured instead of hidden (closed-loop generators self-throttle and
+report fantasy latencies). Latency is measured from the scheduled
+ARRIVAL instant, so coordinated omission cannot flatter the tail.
+
+The request mix cycles over the configured shape buckets with a
+configurable task-repeat fraction (repeats exercise the adapted-params
+cache exactly like real "adapt once, predict many" tenants).
+
+Artifact contract (bench.py discipline): the LAST stdout JSON line is
+authoritative and carries the serve_latency_p50_ms /
+serve_latency_p95_ms / serve_cache_hit_frac keys that bench.py emits as
+null — one consumer reads train and serve captures uniformly. With
+--events PATH the run also writes an events.jsonl stream
+scripts/telemetry_report.py renders (its "serving" section).
+
+Usage:
+    python scripts/serve_bench.py --quick                 # CI/CPU smoke
+    python scripts/serve_bench.py --requests 200 --rate 20
+    python scripts/serve_bench.py --config experiment_config/x.json \
+        --checkpoint <dir>                                # real weights
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+
+
+def build_config(args):
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    if args.config:
+        cfg = MAMLConfig.from_json_file(args.config)
+    else:
+        cfg = MAMLConfig(
+            experiment_name="serve_bench",
+            dataset_name="synthetic")
+    if args.quick:
+        cfg = cfg.replace(
+            image_height=12, image_width=12, image_channels=1,
+            cnn_num_filters=4, num_stages=2,
+            num_classes_per_set=3, num_samples_per_class=1,
+            num_target_samples=2,
+            number_of_training_steps_per_iter=2,
+            number_of_evaluation_steps_per_iter=2,
+            serve_batch_tasks=2,
+            serve_buckets=((3, 4), (6, 4)))
+    # The bench is single-process; serve on the local device count's
+    # natural mesh only when the config asks for more than we have.
+    import jax
+    n_dev = len(jax.devices())
+    if int(np.prod(cfg.mesh_shape)) > n_dev:
+        cfg = cfg.replace(mesh_shape=(1, 1))
+    # Deadlines off by default here: the artifact measures the latency
+    # DISTRIBUTION; a deadline sweep is a separate experiment (pass
+    # --deadline-ms to run one). --queue-depth is honored as given —
+    # under overload, rejected submits are load-shedding and the
+    # artifact counts them.
+    return cfg.replace(serve_default_deadline_ms=args.deadline_ms,
+                       serve_max_queue_depth=args.queue_depth)
+
+
+def synthetic_request(cfg, bucket, rng, fill, arrival):
+    """One synthetic request at ``fill <= bucket`` occupancy with wire
+    dtype matching the config (uint8 by default, like real traffic)."""
+    from howtotrainyourmamlpytorch_tpu.serve import FewShotRequest
+    s, q = fill
+    h, w, c = cfg.image_shape
+    n = cfg.num_classes_per_set
+    if cfg.transfer_images_uint8:
+        sx = rng.randint(0, 256, (s, h, w, c)).astype(np.uint8)
+        qx = rng.randint(0, 256, (q, h, w, c)).astype(np.uint8)
+    else:
+        sx = rng.randn(s, h, w, c).astype(np.float32)
+        qx = rng.randn(q, h, w, c).astype(np.float32)
+    sy = (np.arange(s) % n).astype(np.int32)
+    req = FewShotRequest(support_x=sx, support_y=sy, query_x=qx)
+    req.arrival_time = arrival  # open-loop: scheduled arrival, not ctor
+    return req
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="Open-loop synthetic load benchmark for serve/.")
+    ap.add_argument("--requests", type=int, default=100,
+                    help="total synthetic requests")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="offered load, requests/s (0 = as fast as the "
+                         "engine drains: a throughput measurement)")
+    ap.add_argument("--repeat-frac", type=float, default=0.3,
+                    help="fraction of requests that repeat an earlier "
+                         "support set (exercises the adapted-params "
+                         "cache)")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="per-request deadline (0 = none)")
+    ap.add_argument("--queue-depth", type=int, default=64)
+    ap.add_argument("--config", default=None, metavar="JSON",
+                    help="experiment_config/*.json to serve")
+    ap.add_argument("--checkpoint", default=None, metavar="DIR",
+                    help="saved_models dir to load weights from "
+                         "(default: a fresh meta-init — throughput/"
+                         "latency are weight-independent)")
+    ap.add_argument("--events", default=None, metavar="PATH",
+                    help="also write an events.jsonl telemetry stream "
+                         "(input for scripts/telemetry_report.py)")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes for CI/CPU sanity")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.serve import ServingEngine
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import JsonlLogger
+
+    cfg = build_config(args)
+    if args.checkpoint:
+        engine = ServingEngine.from_checkpoint(cfg, args.checkpoint)
+    else:
+        model_init, _ = make_model(cfg)
+        state = init_train_state(cfg, model_init,
+                                 jax.random.PRNGKey(cfg.seed))
+        engine = ServingEngine(cfg, state)
+
+    t0 = time.perf_counter()
+    engine.warmup()
+    warmup_seconds = time.perf_counter() - t0
+    compiles_after_warmup = int(
+        engine.registry.counter("compile/count").value)
+
+    # Pre-draw the whole arrival schedule + request mix (open loop).
+    rng = np.random.RandomState(args.seed)
+    buckets = engine.batcher.buckets
+    start = time.monotonic() + 0.01
+    if args.rate > 0:
+        gaps = rng.exponential(1.0 / args.rate, args.requests)
+        arrivals = start + np.cumsum(gaps)
+    else:
+        arrivals = np.full(args.requests, start)
+    requests = []
+    for i in range(args.requests):
+        bucket = buckets[i % len(buckets)]
+        fill = (max(1, bucket[0] - (i % 2)), max(1, bucket[1] - (i % 3)))
+        if requests and rng.rand() < args.repeat_frac:
+            # Repeat an earlier support set with FRESH queries: the
+            # cache-hit path (adapt skipped, predict only).
+            prev = requests[rng.randint(len(requests))]
+            req = synthetic_request(cfg, bucket,
+                                    rng, (prev.num_support,
+                                          prev.num_query),
+                                    arrivals[i])
+            req.support_x = prev.support_x
+            req.support_y = prev.support_y
+        else:
+            req = synthetic_request(cfg, bucket, rng, fill, arrivals[i])
+        requests.append(req)
+
+    # Drive: submit every request whose arrival instant has passed, pump
+    # the engine between arrivals, drain at the end.
+    responses = []
+    next_idx = 0
+    rejected = 0
+    while next_idx < len(requests) or engine.batcher.depth:
+        now = time.monotonic()
+        while next_idx < len(requests) and requests[next_idx].arrival_time <= now:
+            try:
+                engine.submit(requests[next_idx])
+            except Exception:
+                if args.rate > 0:
+                    # Rated open-loop traffic: a full queue sheds the
+                    # request (that IS the backpressure behavior under
+                    # overload; the artifact counts it).
+                    rejected += 1
+                else:
+                    # rate=0 is a backlog/throughput measurement: the
+                    # queue-depth cap throttles submission, it must not
+                    # discard work — retry after the next batch drains.
+                    break
+            next_idx += 1
+        responses.extend(engine.step())
+        if next_idx < len(requests) and not engine.batcher.depth:
+            time.sleep(min(0.005,
+                           max(requests[next_idx].arrival_time
+                               - time.monotonic(), 0.0)))
+    wall = time.monotonic() - start
+
+    ok = [r for r in responses if r.error is None]
+    lat_ms = sorted(r.latency_seconds * 1e3 for r in ok)
+
+    def pct(q):
+        # The repo's one pinned quantile definition (PR-1's p95 fix).
+        from howtotrainyourmamlpytorch_tpu.utils.tracing import (
+            nearest_rank)
+        return round(nearest_rank(lat_ms, q), 3) if lat_ms else None
+
+    hits = engine.cache.hits
+    misses = engine.cache.misses
+    out = {
+        "metric": "serve_requests_per_sec",
+        "value": round(len(ok) / wall, 3) if wall > 0 else None,
+        "unit": "requests/s",
+        "requests": args.requests,
+        "responses": len(ok),
+        "deadline_misses": len(responses) - len(ok),
+        "rejected": rejected,
+        "serve_latency_p50_ms": pct(0.5),
+        "serve_latency_p95_ms": pct(0.95),
+        "serve_cache_hit_frac": (round(hits / (hits + misses), 4)
+                                 if hits + misses else None),
+        "adapt_batches": engine.adapt_invocations,
+        "warmup_seconds": round(warmup_seconds, 3),
+        "compile_count_warmup": compiles_after_warmup,
+        # The steady-state no-recompile guarantee, in the artifact: any
+        # nonzero delta means a request shape escaped the buckets.
+        "compile_count_steady_delta": int(
+            engine.registry.counter("compile/count").value)
+            - compiles_after_warmup,
+        "offered_rate": args.rate or None,
+        "workload": cfg.experiment_name,
+    }
+    if args.events:
+        jsonl = JsonlLogger(args.events)
+        engine.flush_metrics(jsonl, phase="serve_bench")
+    engine.close()
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

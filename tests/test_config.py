@@ -22,15 +22,52 @@ def test_eval_longer_than_train_sizes_per_step_rows():
     assert cfg.lslr_num_steps == 8
 
 
-def test_unknown_key_warns():
-    import warnings as w
-    with w.catch_warnings(record=True) as rec:
-        w.simplefilter("always")
-        cfg = MAMLConfig.from_dict({"second_ordre": True, "gpu_to_use": 1})
-    msgs = [str(r.message) for r in rec]
-    assert any("second_ordre" in m for m in msgs)       # typo: loud
-    assert not any("gpu_to_use" in m for m in msgs)     # known GPU key: quiet
-    assert "second_ordre" in cfg.ignored_keys
+def test_unknown_key_raises_with_did_you_mean():
+    """Serving configs keep adding keys; a typo'd knob that silently
+    falls back to its default is the failure mode the config system
+    exists to prevent (ISSUE 2 satellite) — unknown keys FAIL, with a
+    did-you-mean suggestion. Known GPU plumbing keys from the reference
+    schema stay accepted-and-ignored."""
+    with pytest.raises(ValueError) as exc:
+        MAMLConfig.from_dict({"second_ordre": True, "gpu_to_use": 1})
+    msg = str(exc.value)
+    assert "second_ordre" in msg
+    assert "did you mean 'second_order'?" in msg
+    assert "gpu_to_use" not in msg                      # known GPU key: quiet
+    # The serving-config motivating case: a typo'd serve knob.
+    with pytest.raises(ValueError, match="serve_cache_capacity"):
+        MAMLConfig.from_dict({"serve_cache_capacty": 0})
+    # Every unknown key is reported in ONE error, suggestion or not.
+    with pytest.raises(ValueError) as exc2:
+        MAMLConfig.from_dict({"second_ordre": True,
+                              "zzz_not_a_knob_at_all": 1})
+    assert ("second_ordre" in str(exc2.value)
+            and "zzz_not_a_knob_at_all" in str(exc2.value))
+    # Quiet-ignored keys still land in ignored_keys bookkeeping.
+    cfg = MAMLConfig.from_dict({"gpu_to_use": 1})
+    assert "gpu_to_use" in cfg.ignored_keys
+
+
+def test_serve_config_validation_and_buckets():
+    cfg = MAMLConfig(num_classes_per_set=5, num_samples_per_class=5,
+                     num_target_samples=3)
+    # Default: one bucket at the dataset geometry.
+    assert cfg.serve_bucket_shapes == ((25, 15),)
+    assert cfg.effective_serve_adapt_steps == 5
+    # Explicit buckets come back sorted; JSON lists normalize to tuples.
+    cfg2 = MAMLConfig.from_dict(
+        {"serve_buckets": [[25, 30], [5, 15]], "serve_adapt_steps": 3})
+    assert cfg2.serve_bucket_shapes == ((5, 15), (25, 30))
+    assert cfg2.effective_serve_adapt_steps == 3
+    with pytest.raises(ValueError, match="serve_batch_tasks"):
+        MAMLConfig(serve_batch_tasks=0)
+    with pytest.raises(ValueError, match="serve_buckets"):
+        MAMLConfig(serve_buckets=((0, 4),))
+    # Steps beyond the trained per-step LSLR/BN rows are rejected.
+    with pytest.raises(ValueError, match="serve_adapt_steps"):
+        MAMLConfig(number_of_training_steps_per_iter=5,
+                   number_of_evaluation_steps_per_iter=5,
+                   serve_adapt_steps=6)
 
 
 def test_reference_json_schema_loads(tmp_path):

@@ -275,3 +275,62 @@ def test_msl_batched_target_path_equals_serial():
         res_b.bn_state, res_s.bn_state)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6), gb, gs)
+
+
+def test_adapt_only_parity_with_training_inner_loop():
+    """Serving satellite (ISSUE 2): the serve/ adapt-only path must be
+    numerically IDENTICAL to the training inner loop — for every prefix
+    length k, adapt-only k steps produces exactly the fast params the
+    training scan holds after its first k steps (witnessed bitwise
+    through the support-loss trajectory mean, the final-step target
+    logits and the norm state — each a function of the fast-param
+    trajectory). Both paths share meta/inner.py § support_adapt_step by
+    construction; this test pins that the factoring stays airtight."""
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.serve.adapt import adapt_task
+
+    cfg = MAMLConfig(
+        dataset_name="synthetic_adapt", image_height=10, image_width=10,
+        image_channels=1, num_classes_per_set=3, num_samples_per_class=2,
+        num_target_samples=2, cnn_num_filters=4, num_stages=2,
+        number_of_training_steps_per_iter=3,
+        number_of_evaluation_steps_per_iter=3,
+        per_step_bn_statistics=True, second_order=False,
+        compute_dtype="float32")
+    init, apply = make_model(cfg)
+    params, bn_state = init(jax.random.PRNGKey(3))
+    fast0, slow = inner.split_fast_slow(cfg, params)
+    lslr = inner.lslr_init(cfg, fast0)
+    rng = np.random.default_rng(7)
+    ep = Episode(
+        jnp.asarray(rng.normal(size=(6, 10, 10, 1)), jnp.float32),
+        jnp.asarray(np.repeat(np.arange(3), 2), jnp.int32),
+        jnp.asarray(rng.normal(size=(6, 10, 10, 1)), jnp.float32),
+        jnp.asarray(np.repeat(np.arange(3), 2), jnp.int32))
+
+    for k in (1, 2, 3):
+        train_res = inner.task_forward(
+            cfg, apply, params, lslr, bn_state, ep, num_steps=k,
+            second_order=False, use_msl=False, msl_weights=None)
+        adapted = adapt_task(
+            cfg, apply, params, lslr, bn_state, ep.support_x,
+            ep.support_y, jnp.ones((6,), jnp.float32), num_steps=k)
+        # Same support-loss trajectory (pins every step's PRE-update
+        # fast params)...
+        np.testing.assert_array_equal(
+            np.asarray(adapted.support_loss),
+            np.asarray(train_res.support_loss))
+        # ...and replaying the training path's final target forward FROM
+        # the adapt-only result reproduces its logits AND its post-task
+        # norm state bitwise — which requires the adapted fast params
+        # and the adapted bn state to both equal what the training scan
+        # carried out of its support chain.
+        logits, bn_after = apply(
+            inner.merge_fast_slow(adapted.fast, slow), adapted.bn_state,
+            ep.target_x, jnp.int32(k - 1), True)
+        np.testing.assert_array_equal(np.asarray(logits),
+                                      np.asarray(train_res.target_logits))
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            bn_after, train_res.bn_state)

@@ -19,18 +19,20 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 
 # Every key the CI consumer may rely on (the acceptance list: step-time
 # percentiles, tasks/sec/chip, compile count/seconds, feed-stall
-# fraction, peak memory, per-host skew).
+# fraction, peak memory, per-host skew; v2 adds the serving section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
-    "live_memory_bytes", "host_skew",
+    "live_memory_bytes", "host_skew", "serving",
 }
 
 
-def write_fixture_events(path, *, with_failsoft=True):
+def write_fixture_events(path, *, with_failsoft=True, with_serving=False):
     """A synthetic 2-epoch run's event stream, as the experiment loop
-    writes it (train_epoch + telemetry + heartbeat per epoch)."""
+    writes it (train_epoch + telemetry + heartbeat per epoch); with
+    ``with_serving``, a trailing serve/ registry-flush row as
+    ServingEngine.flush_metrics writes it."""
     log = JsonlLogger(str(path))
     for epoch, (p50, p95, rate) in enumerate([(0.10, 0.50, 40.0),
                                               (0.08, 0.12, 50.0)]):
@@ -53,6 +55,21 @@ def write_fixture_events(path, *, with_failsoft=True):
                 process_index=0, hosts=4,
                 host_mean_step_seconds=[0.1, 0.1, 0.1, 0.14],
                 skew_frac=0.05 * (epoch + 1), slowest_host=3)
+    if with_serving:
+        # Two rows: counters are cumulative, the LAST serve row wins.
+        log.log("metrics", metrics={"serve/requests_total": 10.0,
+                                    "serve/responses_total": 9.0})
+        log.log("metrics", metrics={
+            "serve/requests_total": 40.0,
+            "serve/responses_total": 38.0,
+            "serve/rejected_total": 1.0,
+            "serve/deadline_misses": 1.0,
+            "serve/cache_hits": 12.0,
+            "serve/cache_misses": 28.0,
+            "serve/queue_depth": 0.0,
+            "serve/latency_seconds": {"count": 38, "sum": 3.8,
+                                      "p50": 0.1, "p95": 0.4},
+        })
     return log.path
 
 
@@ -73,9 +90,31 @@ def test_summarize_events_fixture(tmp_path):
     assert s["peak_memory_bytes"] == 2001
     assert s["host_skew"]["hosts"] == 4
     assert s["host_skew"]["max_skew_frac"] == pytest.approx(0.1)
+    # No serve/ rows -> the serving section says so explicitly.
+    assert s["serving"] == UNAVAILABLE
     # The table renders every row without raising.
     table = format_table(s)
     assert "feed stall fraction" in table and "0.1" in table
+
+
+def test_summarize_events_serving_section(tmp_path):
+    """serve/ metric rows (ServingEngine.flush_metrics) render the
+    serving section; cumulative counters mean the LAST row wins."""
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    path = write_fixture_events(tmp_path / "events.jsonl",
+                                with_serving=True)
+    s = summarize_events(read_jsonl(path))
+    assert set(s) == SCHEMA_KEYS
+    serving = s["serving"]
+    assert serving["requests"] == 40 and serving["responses"] == 38
+    assert serving["rejected"] == 1 and serving["deadline_misses"] == 1
+    assert serving["cache_hit_frac"] == pytest.approx(0.3)
+    assert serving["latency_p50_ms"] == pytest.approx(100.0)
+    assert serving["latency_p95_ms"] == pytest.approx(400.0)
+    assert serving["queue_depth"] == 0
+    assert "serving" in format_table(s)
+    # Training metrics are untouched by the serve rows.
+    assert s["epochs"] == 2 and s["compile_count"] == 4
 
 
 def test_summarize_events_failsoft_markers(tmp_path):
