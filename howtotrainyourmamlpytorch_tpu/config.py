@@ -248,6 +248,32 @@ class MAMLConfig:
                                            # stay within the checkpoint's
                                            # LSLR/BN per-step rows)
 
+    # ---- resilience (resilience/ subsystem, docs/RESILIENCE.md) --------
+    divergence_patience: int = 2           # consecutive bad outer-loss
+                                           # observations (NaN/Inf or
+                                           # spike) before rewinding to the
+                                           # last-good epoch checkpoint;
+                                           # 0 disables the guard. Checked
+                                           # at dispatch-sync points only
+                                           # (host-side; zero hot-path
+                                           # cost — detection latency is
+                                           # <= dispatch_sync_every iters)
+    divergence_spike_factor: float = 0.0   # loss > factor * running median
+                                           # of recent good losses counts
+                                           # as bad; 0 = NaN/Inf only
+                                           # (spikes can be legitimate —
+                                           # opt in per workload)
+    divergence_max_rewinds: int = 3        # rewind budget per run: a loss
+                                           # that diverges again after this
+                                           # many rewinds is a real bug and
+                                           # must fail loudly, not loop
+    fault_spec: str = ""                   # deterministic fault injection
+                                           # (resilience/faults.py grammar:
+                                           # "kind@at[:count];..."); the
+                                           # MAML_FAULTS env var overrides.
+                                           # "" = no injection, and every
+                                           # hook is one None-check
+
     # Keys found in a loaded JSON that we accepted-and-ignored (for logging).
     ignored_keys: Tuple[str, ...] = ()
 
@@ -321,6 +347,21 @@ class MAMLConfig:
                 f"eval step count; the checkpoint's per-step LSLR/BN rows "
                 f"cover at most {max_steps} steps), got "
                 f"{self.serve_adapt_steps}")
+        if self.divergence_patience < 0:
+            raise ValueError("divergence_patience must be >= 0 (0 = off)")
+        if (self.divergence_spike_factor != 0.0
+                and self.divergence_spike_factor <= 1.0):
+            raise ValueError(
+                f"divergence_spike_factor must be 0 (off) or > 1, got "
+                f"{self.divergence_spike_factor}")
+        if self.divergence_max_rewinds < 0:
+            raise ValueError("divergence_max_rewinds must be >= 0")
+        if self.fault_spec:
+            # Parse-validate now: a typo'd chaos spec that silently
+            # injects nothing would "prove" recovery that never ran.
+            from howtotrainyourmamlpytorch_tpu.resilience.faults import (
+                FaultPlan)
+            FaultPlan.parse(self.fault_spec)
 
     # ---- derived values -------------------------------------------------
     @property

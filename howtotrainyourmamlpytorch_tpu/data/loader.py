@@ -25,26 +25,43 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import Iterator
+import warnings
+from typing import Iterator, Optional
+
+import numpy as np
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.data.sampler import EpisodeSampler
 from howtotrainyourmamlpytorch_tpu.data.sources import build_source
 from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+from howtotrainyourmamlpytorch_tpu.resilience import faults
 from howtotrainyourmamlpytorch_tpu.telemetry.instruments import (
     FeedStallMeter)
 
 _STOP = object()
+
+# A corrupt episode is replaced by episode index + k * stride (k = 1..3):
+# deterministic (resume-safe), and the prime stride keeps replacements far
+# outside the contiguous index range a real run ever visits.
+_REPLACEMENT_STRIDE = 15_485_863
+_MAX_REPLACEMENTS = 3
+# One divergence rewind shifts the whole TRAIN episode stream by this
+# much, so the re-run of the rewound window draws fresh episodes instead
+# of replaying the batch that produced the NaN (resilience/guard.py).
+_REWIND_SALT_STRIDE = 2 ** 33
 
 
 class MetaLearningDataLoader:
     """Builds per-split samplers and yields (optionally device-placed)
     meta-batches."""
 
-    def __init__(self, cfg: MAMLConfig, mesh=None):
+    def __init__(self, cfg: MAMLConfig, mesh=None, registry=None):
         self.cfg = cfg
         self.mesh = mesh
+        self.registry = registry  # telemetry.MetricsRegistry or None
         self._samplers = {}
+        self._train_salt = 0
+        self._corrupt_warned = False
         # Data-stall telemetry for the TRAIN feed: cumulative consumer
         # wait (input pipeline not ready) vs dispatch (consumer busy)
         # seconds. The experiment loop snapshots per epoch; eval sweeps
@@ -56,6 +73,12 @@ class MetaLearningDataLoader:
         # episode streams make this coordination-free.
         import jax
         self._multihost = mesh is not None and jax.process_count() > 1
+
+    def set_train_salt(self, salt: int) -> None:
+        """Shift the train episode stream (divergence rewinds). Salt is
+        the persisted rewind count (``CheckpointManager.meta['rewinds']``)
+        so resumed runs reproduce the post-rewind stream exactly."""
+        self._train_salt = int(salt)
 
     def sampler(self, split: str) -> EpisodeSampler:
         if split not in self._samplers:
@@ -88,6 +111,41 @@ class MetaLearningDataLoader:
         from howtotrainyourmamlpytorch_tpu.parallel.mesh import shard_batch
         return shard_batch(batch, self.mesh)
 
+    # -- fail-soft episode sampling --------------------------------------
+    def _sample_episode(self, sampler: EpisodeSampler, idx: int) -> Episode:
+        """One episode, skipping corrupt/unreadable ones: a failed sample
+        is replaced by a deterministic alternate index (epoch step count
+        is preserved — the batch stays full) with one warning per run and
+        a ``data/corrupt_episodes`` count per skip. A mid-epoch raise for
+        one bad image file would otherwise kill a pod-scale run."""
+        last: Optional[Exception] = None
+        for attempt in range(_MAX_REPLACEMENTS + 1):
+            j = int(idx) + attempt * _REPLACEMENT_STRIDE
+            try:
+                if attempt == 0 and faults.maybe_fire("episode_corrupt",
+                                                      step=int(idx)):
+                    raise OSError(f"injected corrupt episode at index "
+                                  f"{idx}")
+                return sampler.sample(j)
+            except Exception as e:
+                last = e
+                if self.registry is not None:
+                    self.registry.counter("data/corrupt_episodes").inc()
+                if not self._corrupt_warned:
+                    self._corrupt_warned = True
+                    warnings.warn(
+                        f"corrupt/unreadable episode {j} "
+                        f"({type(e).__name__}: {str(e)[:120]}); drawing a "
+                        f"deterministic replacement (further skips are "
+                        f"counted, not warned)", stacklevel=2)
+        raise last  # replacements exhausted: the split itself is broken
+
+    def _sample_batch(self, sampler: EpisodeSampler, indices) -> Episode:
+        """Stack episodes on the leading task axis, fail-soft per
+        episode (same stacking as ``EpisodeSampler.sample_batch``)."""
+        eps = [self._sample_episode(sampler, i) for i in indices]
+        return Episode(*(np.stack(field) for field in zip(*eps)))
+
     def _batches(self, split: str, start_idx: int,
                  num_batches: int, batch_size: int) -> Iterator[Episode]:
         sampler = self.sampler(split)
@@ -114,21 +172,26 @@ class MetaLearningDataLoader:
                 except queue.Full:
                     pass
 
+        # Divergence rewinds re-seed the TRAIN stream only; the fixed
+        # val/test streams must stay identical across rewinds.
+        salt = (self._train_salt * _REWIND_SALT_STRIDE
+                if split == "train" else 0)
+
         def worker():
             try:
                 for b in range(num_batches):
                     if abandoned.is_set():
                         return
-                    base = (start_idx + b) * batch_size
+                    base = (start_idx + b) * batch_size + salt
                     if self._multihost:
                         batch = assemble_global_batch(
-                            lambda s, e: sampler.sample_batch(
-                                range(base + s, base + e)),
+                            lambda s, e: self._sample_batch(
+                                sampler, range(base + s, base + e)),
                             batch_size, mh_sharding,
                             positions=mh_positions)
                     else:
-                        batch = sampler.sample_batch(
-                            range(base, base + batch_size))
+                        batch = self._sample_batch(
+                            sampler, range(base, base + batch_size))
                     put_bounded(self._place(batch))
             except Exception as e:  # surface in consumer, don't hang
                 put_bounded(e)

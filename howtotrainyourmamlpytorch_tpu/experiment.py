@@ -20,6 +20,7 @@ TPU-first notes:
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import sys
 import threading
@@ -40,9 +41,12 @@ from howtotrainyourmamlpytorch_tpu.models import make_model
 from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
     make_mesh, make_sharded_steps, replicated_sharding)
 from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
-    agree_int_from_main, any_process_true, barrier)
+    abort_all_if_any, agree_int_from_main, any_process_true,
+    any_process_true_each, barrier)
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
     LATEST, CheckpointManager)
+from howtotrainyourmamlpytorch_tpu import resilience
+from howtotrainyourmamlpytorch_tpu.resilience import DivergenceGuard, faults
 from howtotrainyourmamlpytorch_tpu.telemetry import (
     FeedStallMeter, MetricsRegistry, device_memory_stats, emit_heartbeat)
 from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
@@ -60,6 +64,18 @@ class ExperimentBuilder:
         # Multi-host: every process computes, only process 0 writes
         # checkpoints/stats (shared-filesystem single-writer discipline).
         self.is_main_process = jax.process_index() == 0
+        # Telemetry registry first: everything below (storage retries,
+        # fault injection, resume) counts into it. Installing it as the
+        # process-wide resilience registry follows the one-live-run-per-
+        # process discipline (last constructed builder wins — same as a
+        # sweep driver's sequential builders expect).
+        self.registry = MetricsRegistry()
+        resilience.set_registry(self.registry)
+        # Deterministic fault injection (docs/RESILIENCE.md): env wins
+        # over config; the empty default clears any previous plan so a
+        # chaos builder can't leak faults into a later clean builder.
+        faults.configure(os.environ.get(faults.ENV_VAR, "")
+                         or cfg.fault_spec)
         self.paths = build_experiment_folder(cfg.experiment_root,
                                              cfg.experiment_name)
 
@@ -103,7 +119,8 @@ class ExperimentBuilder:
         self.model_init, self.model_apply = make_model(cfg)
         self.mesh = make_mesh(cfg, devices)
         self.plan = make_sharded_steps(cfg, self.model_apply, self.mesh)
-        self.data = MetaLearningDataLoader(cfg, mesh=self.mesh)
+        self.data = MetaLearningDataLoader(cfg, mesh=self.mesh,
+                                           registry=self.registry)
         # Order ANY previous process-0 checkpoint/state writes (epoch
         # saves, the preemption snapshot) before THIS builder's state.json
         # read: without it a non-main process constructing a resuming
@@ -112,19 +129,16 @@ class ExperimentBuilder:
         # e2e test's preempt->resume phase).
         barrier("builder_init")
         self.ckpt = CheckpointManager(self.paths["saved_models"],
-                                      max_to_keep=cfg.max_models_to_save)
+                                      max_to_keep=cfg.max_models_to_save,
+                                      quarantine=self.is_main_process)
 
         self.jsonl = JsonlLogger(f"{self.paths['logs']}/events.jsonl",
                                  enabled=self.is_main_process)
-        # Telemetry (docs/PERF.md § Observability): every numeric the
-        # run reports goes through the registry, which fans out to
-        # events.jsonl and a Prometheus textfile snapshot. The compile
-        # watcher (None until run) is installed at run_experiment entry
-        # and removed in its finally, so a builder that is constructed
-        # but never run (sweep drivers, failed constructions) cannot
-        # leak the process-wide listener. Same lazy pattern as the
-        # TensorBoard writer below.
-        self.registry = MetricsRegistry()
+        # The compile watcher (None until run) is installed at
+        # run_experiment entry and removed in its finally, so a builder
+        # that is constructed but never run (sweep drivers, failed
+        # constructions) cannot leak the process-wide listener. Same
+        # lazy pattern as the TensorBoard writer below.
         self._compile_watch = None
         self._feed_prev: Optional[Dict[str, float]] = None
         self._tb = None             # lazy SummaryWriter (_finish_epoch)
@@ -137,11 +151,22 @@ class ExperimentBuilder:
         # the stop decision is agreed across processes at sync boundaries.
         self._preempted = False
         self._multihost = jax.process_count() > 1
+        # Divergence guard (resilience/guard.py): observes the outer-loss
+        # scalar at dispatch-sync points; a trigger rewinds to the
+        # last-good epoch checkpoint (_perform_rewind).
+        self._guard = (DivergenceGuard(cfg.divergence_patience,
+                                       cfg.divergence_spike_factor)
+                       if cfg.divergence_patience > 0 else None)
+        self._rewind_requested = False
         # Device-resident cache of the fixed (deterministic) val/test
         # batches: transferred once, reused every validation sweep.
         self._eval_cache: Dict[str, List[Any]] = {}
         if cfg.continue_from_epoch != "from_scratch":
             self._resume(cfg.continue_from_epoch)
+        # Post-rewind train streams are salted by the persisted rewind
+        # count, so a rewound-then-preempted run resumes the SAME stream
+        # an uninterrupted post-rewind run would see.
+        self.data.set_train_salt(int(self.ckpt.meta.get("rewinds", 0)))
         self.state = jax.device_put(self.state,
                                     replicated_sharding(self.mesh))
 
@@ -157,15 +182,6 @@ class ExperimentBuilder:
         # rather than stranding peers mid-collective.
         _IS_LATEST = -1
         from_latest = tag == LATEST
-
-        def abort_all_if_any(err: Optional[BaseException],
-                             peer_msg: str) -> None:
-            """Raise on EVERY host when any host captured an error (the
-            failing host re-raises its own; peers get ``peer_msg``), so
-            no host is left stranded inside a later collective."""
-            if any_process_true(err is not None):
-                raise err if err is not None else RuntimeError(
-                    peer_msg + "; aborting resume on all hosts")
 
         # OR-reduce, not process-0 broadcast: if ANY host sees checkpoint
         # files OR on-disk bookkeeping, this is not a fresh run — a
@@ -317,10 +333,13 @@ class ExperimentBuilder:
             threading.Thread(target=warm, daemon=True,
                              name="phase-warmup").start()
 
-    def _train_epoch(self) -> Optional[Dict[str, float]]:
+    def _train_epoch(self):
         """Train to the next epoch boundary (a resumed run mid-epoch does
         only the remainder — the reference's ``continue_from_iter``
-        contract). Returns None if preempted before the boundary."""
+        contract). Returns the epoch's stats dict; None if preempted
+        before the boundary (state snapshotted to 'latest'); the sentinel
+        string ``"rewind"`` if the divergence guard fired (nothing
+        saved — the caller rewinds)."""
         cfg = self.cfg
         epoch = self.epoch
         iters_left = (cfg.total_iter_per_epoch
@@ -369,6 +388,14 @@ class ExperimentBuilder:
                     # breaks at the SAME iteration (a lone host breaking
                     # early would strand the others' collectives).
                     loss_now = float(jax.device_get(metrics.loss))
+                    # Chaos hooks + divergence guard live HERE — in
+                    # host Python at the sync point, on a scalar that is
+                    # being fetched anyway. The compiled step is never
+                    # touched; with no fault plan and no guard these are
+                    # two None/attribute checks per sync.
+                    if faults.maybe_fire("nan_loss",
+                                         step=self.current_iter):
+                        loss_now = float("nan")
                     if live:
                         live_samples.append(
                             (loss_now,
@@ -383,8 +410,24 @@ class ExperimentBuilder:
                             print(f"\r{line}", end="", flush=True)
                         else:
                             print(line, flush=True)
+                    rewind = (self._guard is not None
+                              and self._guard.observe(loss_now,
+                                                      self.current_iter))
+                    if faults.maybe_fire("kill", step=self.current_iter):
+                        # Exercise the REAL preemption path (handler →
+                        # flag → quiesce → snapshot), not a shortcut.
+                        signal.raise_signal(signal.SIGTERM)
                     if self._multihost:
-                        self._preempted = any_process_true(self._preempted)
+                        # ONE combined OR-reduce for both stop decisions
+                        # (the outer loss is a global pmean so hosts see
+                        # the same scalar, but agreement still guards a
+                        # stale host — and a lone host's signal must
+                        # stop everyone at the SAME iteration).
+                        rewind, self._preempted = any_process_true_each(
+                            (rewind, self._preempted))
+                    if rewind:
+                        self._rewind_requested = True
+                        break
                     if self._preempted:
                         break
                 elif self._preempted and not self._multihost:
@@ -396,6 +439,10 @@ class ExperimentBuilder:
         jax.block_until_ready(self.state.params)
         if live_tty and live_samples:
             print("\r\x1b[K", end="")  # clear the in-place progress line
+        if self._rewind_requested:
+            # The poisoned state must NOT be checkpointed; the caller
+            # rewinds to the last-good epoch checkpoint instead.
+            return "rewind"
         if self._preempted:
             # Mid-epoch snapshot to 'latest' only; resume continues at
             # exactly this iteration with the same deterministic batch
@@ -403,6 +450,14 @@ class ExperimentBuilder:
             self.ckpt.save_latest(self.state, self.current_iter,
                                   write=self.is_main_process)
             self.jsonl.log("preempt_checkpoint", iter=self.current_iter)
+            # Final registry snapshot: counters incremented since the
+            # last epoch flush (a rewind in the killed window, IO
+            # retries) must not die with the process — the report reads
+            # them from this row.
+            self.registry.flush_jsonl(self.jsonl, phase="preempt")
+            if self.is_main_process:
+                self.registry.write_prometheus(
+                    f"{self.paths['logs']}/metrics.prom")
             print(f"preempted: saved latest checkpoint at iter "
                   f"{self.current_iter}")
             return None
@@ -558,20 +613,34 @@ class ExperimentBuilder:
         epochs_this_session = 0
         if cfg.precompile_phases and self.current_iter < total_iters:
             self._start_phase_warmup()
-        # Save-on-signal: SIGTERM (cluster preemption notice) checkpoints
-        # 'latest' at the current iteration and exits the loop cleanly;
-        # resume with continue_from_epoch='latest' loses zero iterations.
-        try:
-            prev_handler = signal.signal(
-                signal.SIGTERM, lambda *_: setattr(self, "_preempted", True))
-        except ValueError:       # not the main thread: no handler, the
-            prev_handler = None  # _preempted flag can still be set directly
+        # Eagerly register the resilience counters so every per-epoch
+        # metrics row (and the final Prometheus snapshot) carries them —
+        # a report must show "0 rewinds", not omit the section.
+        for name in ("resilience/rewinds", "resilience/io_retries",
+                     "resilience/faults_injected"):
+            self.registry.counter(name)
+        # Save-on-signal: SIGTERM (cluster preemption notice) and SIGINT
+        # (operator Ctrl-C) checkpoint 'latest' at the current iteration
+        # and exit the loop cleanly; resume with
+        # continue_from_epoch='latest' loses zero iterations, and the CLI
+        # exits with the distinct EXIT_PREEMPTED code (resilience/) so a
+        # scheduler resubmits instead of marking failure.
+        prev_handlers = []
+        handler = lambda *_: setattr(self, "_preempted", True)  # noqa: E731
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers.append((sig, signal.signal(sig, handler)))
+            except ValueError:  # not the main thread: no handler, the
+                pass            # _preempted flag can still be set directly
         try:
             while (self.current_iter < total_iters
                    and epochs_this_session < cfg.total_epochs_before_pause
                    and not self._preempted):
                 epoch = self.epoch
                 train_stats = self._train_epoch()
+                if train_stats == "rewind":  # diverged: rewind, retrain
+                    self._perform_rewind()
+                    continue
                 if train_stats is None:  # preempted mid-epoch, state saved
                     return {"preempted_at_iter": self.current_iter}
                 val_stats = self._evaluate(self._eval_batches("val"),
@@ -584,12 +653,93 @@ class ExperimentBuilder:
                     # hang their first psum.
                     self._preempted = any_process_true(self._preempted)
         finally:
-            if prev_handler is not None:
-                signal.signal(signal.SIGTERM, prev_handler)
+            for sig, prev in prev_handlers:
+                signal.signal(sig, prev)
 
         if self.current_iter >= total_iters:
             return self.run_test_protocol()
+        if self._preempted:
+            # A signal that lands at an epoch boundary (during the val
+            # sweep / _finish_epoch) exits via the while condition with
+            # the epoch checkpoint already saved — it is still a
+            # preemption, and must exit EXIT_PREEMPTED so the scheduler
+            # resubmits instead of marking success.
+            return {"preempted_at_iter": self.current_iter}
         return {"paused_at_iter": self.current_iter}
+
+    def _perform_rewind(self) -> None:
+        """Recover from a diverged outer loss: reload the newest readable
+        epoch checkpoint, discard the poisoned window's bookkeeping, and
+        re-seed the train stream past the batch window that produced the
+        NaN (replaying the identical episodes would re-diverge a
+        data-driven NaN deterministically). The rewind count is persisted
+        in state.json, so a rewound run that is later preempted resumes
+        the SAME post-rewind stream.
+
+        Multi-host: every host performs the identical reload; the target
+        epoch is adopted from process 0 and failures abort every host
+        (the resume-path discipline — a lone host in a different state
+        deadlocks everyone's next collective).
+        """
+        self._rewind_requested = False
+        cfg = self.cfg
+        rewinds = int(self.ckpt.meta.get("rewinds", 0)) + 1
+        err: Optional[BaseException] = None
+        tag = -1
+        try:
+            if rewinds > cfg.divergence_max_rewinds:
+                raise RuntimeError(
+                    f"outer loss diverged again after {rewinds - 1} "
+                    f"rewind(s) (divergence_max_rewinds="
+                    f"{cfg.divergence_max_rewinds}); a loss that keeps "
+                    f"diverging from a good checkpoint is a bug, not a "
+                    f"transient — failing loudly")
+            candidates = sorted(
+                (int(e) for e in self.ckpt.meta["iter_at_epoch"]
+                 if self.ckpt.has_checkpoint(int(e))),
+                key=lambda e: self.ckpt.meta["iter_at_epoch"][str(e)],
+                reverse=True)
+            if not candidates:
+                raise RuntimeError(
+                    "outer loss diverged before any epoch checkpoint "
+                    "exists; nothing to rewind to — fix the config "
+                    "(lr/clip) or seed")
+            tag = candidates[0]
+        except Exception as e:
+            err = e
+        abort_all_if_any(err, "a peer process could not pick a rewind "
+                              "checkpoint")
+        tag = agree_int_from_main(tag)
+        state = meta = None
+        try:
+            template_shapes = state_leaf_shapes(self.state)
+            state, meta = self.ckpt.load(self.state, tag)
+            state = migrate_lslr_rows(cfg, state)
+            state = reconcile_loaded_shapes(cfg, state, template_shapes)
+        except Exception as e:
+            err = e
+        abort_all_if_any(err, f"a peer process could not load the rewind "
+                              f"checkpoint {tag}")
+        self.ckpt.meta["rewinds"] = rewinds
+        # Drop the abandoned window's epochs from the ensemble
+        # bookkeeping and persist (rewind_to writes the whole meta dict,
+        # rewind count included).
+        self.ckpt.rewind_to(tag, write=self.is_main_process)
+        self.state = jax.device_put(state, replicated_sharding(self.mesh))
+        self.current_iter = int(meta["current_iter"])
+        # Rewrite 'latest' to the rewound state NOW: the on-disk latest
+        # still holds the abandoned window's weights, and a hard kill
+        # (SIGKILL — no save-on-signal) before the next epoch save would
+        # otherwise resume those weights under the rewound iteration.
+        self.ckpt.save_latest(self.state, self.current_iter,
+                              write=self.is_main_process)
+        self.data.set_train_salt(rewinds)
+        self.registry.counter("resilience/rewinds").inc()
+        self.jsonl.log("rewind", epoch=tag, iter=self.current_iter,
+                       rewinds=rewinds)
+        print(f"divergence guard: rewound to epoch {tag} checkpoint "
+              f"(iter {self.current_iter}); train stream re-seeded "
+              f"(salt {rewinds})", flush=True)
 
     def _finish_epoch(self, epoch: int, train_stats: Dict[str, float],
                       val_stats: Dict[str, Any]) -> None:

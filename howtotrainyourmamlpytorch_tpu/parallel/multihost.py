@@ -102,6 +102,36 @@ def any_process_true(flag: bool) -> bool:
     return bool(np.any(flags))
 
 
+def any_process_true_each(flags: Sequence[bool]) -> List[bool]:
+    """Element-wise OR-reduce a small vector of host-level booleans in
+    ONE collective (no-op single-process). The train loop's sync point
+    agrees on both stop decisions (divergence rewind, preemption) per
+    call — two separate :func:`any_process_true` rounds would double the
+    host-level allreduce latency paid every ``dispatch_sync_every``
+    iterations for decisions that virtually never fire.
+    """
+    if jax.process_count() <= 1:
+        return [bool(f) for f in flags]
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(
+        np.asarray(list(flags), dtype=np.bool_))
+    return [bool(v) for v in np.any(
+        np.asarray(gathered).reshape(-1, len(flags)), axis=0)]
+
+
+def abort_all_if_any(err, peer_msg: str) -> None:
+    """Raise on EVERY host when any host captured an error — the failing
+    host re-raises its own exception; peers raise ``peer_msg`` — so no
+    host is left stranded inside a later collective. The shared abort
+    idiom for filesystem-dependent recovery decisions (resume fallback,
+    divergence rewind): a host that cannot comply must take everyone down
+    loudly rather than deadlock them in the first mismatched collective.
+    """
+    if any_process_true(err is not None):
+        raise err if err is not None else RuntimeError(
+            peer_msg + "; aborting on all hosts")
+
+
 def agree_int_from_main(value: int) -> int:
     """Adopt process 0's value of a host-level int (no-op single-process).
 

@@ -1,0 +1,76 @@
+"""Resilience subsystem: fault injection, retry/backoff, divergence guard.
+
+On preemptible TPU pods the dominant training failures are *systems*
+failures — preemption, flaky shared storage, one NaN outer step poisoning
+a week-long run — and a production serving process must survive the same
+faults without a human in the loop (docs/RESILIENCE.md). This package
+holds the pieces the rest of the codebase composes:
+
+* :mod:`~.faults` — a deterministic fault-injection registry (env/config
+  driven) that the test suite and ``scripts/chaos_run.py`` use to PROVE
+  recovery rather than hope for it. Zero-cost when disabled: every hook
+  is one module-global ``None`` check in host-side Python between steps —
+  nothing is ever injected into a compiled executable.
+* :mod:`~.retry` — jittered-exponential-backoff retry for storage IO
+  (``utils/storage.py`` / ``utils/checkpoint.py`` decorate through it).
+* :mod:`~.guard` — host-side NaN/Inf + loss-spike detection on the outer
+  loss; the experiment loop rewinds to the last-good checkpoint when it
+  fires (``ExperimentBuilder._perform_rewind``).
+
+Metrics: everything here counts into ONE process-wide registry reference
+(`set_registry`), installed by the component that owns telemetry for the
+process (ExperimentBuilder/ServingEngine install their own registry; the
+last installer wins, matching the one-live-run-per-process discipline).
+Counters are no-ops until a registry is installed, so library use without
+telemetry stays dependency-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# Exit code for "preempted, checkpointed, restart me" — EX_TEMPFAIL, so
+# schedulers/wrappers can distinguish a clean preemption (resubmit with
+# continue_from_epoch='latest') from success (0) and real failure (1).
+EXIT_PREEMPTED = 75
+
+_registry: Optional[Any] = None  # duck-typed telemetry.MetricsRegistry
+
+
+def set_registry(registry: Optional[Any]) -> Optional[Any]:
+    """Install the registry resilience counters record into; returns the
+    previous one (callers with a scoped lifetime restore it)."""
+    global _registry
+    prev = _registry
+    _registry = registry
+    return prev
+
+
+def get_registry() -> Optional[Any]:
+    return _registry
+
+
+def counter_inc(name: str, amount: float = 1.0) -> None:
+    """Increment ``name`` on the installed registry; no-op without one."""
+    reg = _registry
+    if reg is not None:
+        reg.counter(name).inc(amount)
+
+
+from howtotrainyourmamlpytorch_tpu.resilience.faults import (  # noqa: E402
+    FaultPlan,
+    FaultSpec,
+)
+from howtotrainyourmamlpytorch_tpu.resilience.guard import (  # noqa: E402
+    DivergenceGuard,
+)
+from howtotrainyourmamlpytorch_tpu.resilience.retry import (  # noqa: E402
+    backoff_delay,
+    retry_io,
+)
+
+__all__ = [
+    "EXIT_PREEMPTED", "DivergenceGuard", "FaultPlan", "FaultSpec",
+    "backoff_delay", "counter_inc", "get_registry", "retry_io",
+    "set_registry",
+]

@@ -1,0 +1,147 @@
+"""Deterministic fault-injection registry.
+
+A fault plan is a set of ``kind@at[:count]`` specs — e.g.
+``"io_write@1;nan_loss@5;kill@6"`` — parsed from config
+(``MAMLConfig.fault_spec``) or the ``MAML_FAULTS`` env var. Each
+instrumented site asks :func:`maybe_fire` whether to inject; firing is a
+pure function of the plan and the site's step/call index, so a chaos run
+is exactly reproducible.
+
+Two addressing modes, one per kind (the sites choose, not the spec):
+
+* **step-keyed** — the site passes its own step counter (``nan_loss`` and
+  ``kill`` pass the global train iteration; ``episode_corrupt`` passes
+  the episode index). ``kind@7`` fires when that counter is 7.
+* **call-counted** — the site passes no step; the plan counts the kind's
+  calls (1-based) and ``kind@2:3`` fires on calls 2, 3 and 4. IO faults
+  (``io_read``/``io_write``/``ckpt_corrupt``) work this way: a retried
+  attempt advances the counter, so ``io_write@1`` injects one transient
+  write error that the backoff layer then recovers from.
+
+Zero-cost when disabled: the module-level :func:`maybe_fire` is a single
+``None`` check with no plan installed, and every call site lives in
+host-side Python between steps — compiled executables are never touched
+(the ISSUE 3 acceptance constraint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+ENV_VAR = "MAML_FAULTS"
+
+KINDS = (
+    "io_read",          # storage read raises OSError
+    "io_write",         # storage write raises OSError
+    "ckpt_corrupt",     # checkpoint bytes damaged in place after a save
+    "nan_loss",         # outer loss read as NaN at a train iteration
+    "kill",             # SIGTERM raised at a train iteration
+    "episode_corrupt",  # episode sampling raises at an episode index
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One injection: ``kind`` fires at steps ``[at, at + count)``."""
+    kind: str
+    at: int
+    count: int = 1
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: {KINDS}")
+        if self.at < 0 or self.count < 1:
+            raise ValueError(
+                f"fault {self.kind}: need at >= 0 and count >= 1, got "
+                f"@{self.at}:{self.count}")
+
+
+class FaultPlan:
+    """A parsed set of :class:`FaultSpec`; thread-safe (the prefetch
+    worker and the train loop both consult it)."""
+
+    def __init__(self, specs: Sequence[FaultSpec]):
+        self.specs: Tuple[FaultSpec, ...] = tuple(specs)
+        self._calls: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.fired: List[Tuple[str, int]] = []
+        self._seen: set = set()
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        """``"kind@at[:count]"`` items separated by ``;`` or ``,``."""
+        specs = []
+        for item in text.replace(",", ";").split(";"):
+            item = item.strip()
+            if not item:
+                continue
+            if "@" not in item:
+                raise ValueError(
+                    f"fault spec item {item!r} is not 'kind@at[:count]'")
+            kind, _, where = item.partition("@")
+            at, _, count = where.partition(":")
+            try:
+                specs.append(FaultSpec(kind.strip(), int(at),
+                                       int(count) if count else 1))
+            except ValueError as e:
+                raise ValueError(f"bad fault spec item {item!r}: {e}") \
+                    from None
+        return cls(specs)
+
+    def maybe_fire(self, kind: str, step: Optional[int] = None) -> bool:
+        """True iff a spec for ``kind`` covers this step/call. Each
+        ``(kind, step)`` fires AT MOST ONCE per plan: recovery replays
+        the covered window (a rewind revisits the poisoned iteration,
+        a retry re-runs the failed write), and re-injecting the same
+        fault on the replay would make every recovery path "prove"
+        unrecoverability. Records every firing (``self.fired``) and
+        counts it into the resilience registry."""
+        with self._lock:
+            if step is None:
+                self._calls[kind] = self._calls.get(kind, 0) + 1
+                step = self._calls[kind]
+            hit = (any(s.kind == kind and s.at <= step < s.at + s.count
+                       for s in self.specs)
+                   and (kind, int(step)) not in self._seen)
+            if hit:
+                self._seen.add((kind, int(step)))
+                self.fired.append((kind, int(step)))
+        if hit:
+            from howtotrainyourmamlpytorch_tpu import resilience
+            resilience.counter_inc("resilience/faults_injected")
+        return hit
+
+
+_plan: Optional[FaultPlan] = None
+
+
+def configure(spec: str = "") -> Optional[FaultPlan]:
+    """Install a plan from a spec string ('' clears). Returns the plan."""
+    global _plan
+    _plan = FaultPlan.parse(spec) if spec else None
+    return _plan
+
+
+def configure_from_env() -> Optional[FaultPlan]:
+    return configure(os.environ.get(ENV_VAR, ""))
+
+
+def get_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def maybe_fire(kind: str, step: Optional[int] = None) -> bool:
+    """The hook every instrumented site calls. One ``None`` check when no
+    plan is installed — the disabled path costs nothing measurable."""
+    plan = _plan
+    if plan is None:
+        return False
+    return plan.maybe_fire(kind, step)

@@ -1,0 +1,83 @@
+"""Divergence guard: host-side NaN/Inf + loss-spike detection.
+
+MAML++ exists because plain MAML's outer optimization is unstable
+(PAPER.md); at pod scale a single non-finite outer step silently poisons
+every parameter and the run trains garbage for the rest of its lease.
+The guard watches the outer-loss scalar the experiment loop ALREADY
+fetches at its dispatch-sync points (``dispatch_sync_every``), so
+detection adds zero device work and zero hot-path hooks — it is pure
+host Python between steps, with detection latency bounded by the sync
+cadence.
+
+Trigger policy: ``patience`` consecutive bad observations (non-finite
+loss, or — when ``spike_factor`` > 1 — loss above ``spike_factor`` times
+the running median of recent good losses) make :meth:`observe` return
+True; the caller (``ExperimentBuilder._perform_rewind``) rewinds to the
+last-good epoch checkpoint and re-seeds the train stream past the
+poisoned batch window. Patience exists so one transient spike (a hard
+batch) doesn't cost an epoch of progress.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Deque
+
+from howtotrainyourmamlpytorch_tpu import resilience
+
+# Spike detection needs a few good observations before the median means
+# anything; until then only non-finite losses count as bad.
+_MIN_HISTORY = 5
+
+
+class DivergenceGuard:
+    """Decides when the outer loss has diverged. Not thread-safe by
+    design — exactly one train loop feeds it."""
+
+    def __init__(self, patience: int = 2, spike_factor: float = 0.0,
+                 window: int = 32):
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        if spike_factor != 0.0 and spike_factor <= 1.0:
+            raise ValueError(
+                f"spike_factor must be 0 (off) or > 1, got {spike_factor}")
+        self.patience = int(patience)
+        self.spike_factor = float(spike_factor)
+        self._history: Deque[float] = deque(maxlen=int(window))
+        self._bad_streak = 0
+
+    def _is_spike(self, loss: float) -> bool:
+        if not self.spike_factor or len(self._history) < _MIN_HISTORY:
+            return False
+        ordered = sorted(self._history)
+        median = ordered[len(ordered) // 2]
+        return median > 0 and loss > self.spike_factor * median
+
+    def observe(self, loss: float, step: int) -> bool:
+        """Feed one outer-loss scalar; True ⇒ rewind now (and the guard
+        has reset itself for the post-rewind stream)."""
+        loss = float(loss)
+        if not math.isfinite(loss):
+            resilience.counter_inc("resilience/nan_steps")
+            bad = True
+        elif self._is_spike(loss):
+            resilience.counter_inc("resilience/loss_spikes")
+            bad = True
+        else:
+            bad = False
+        if not bad:
+            self._history.append(loss)
+            self._bad_streak = 0
+            return False
+        self._bad_streak += 1
+        if self._bad_streak >= self.patience:
+            self.reset()
+            return True
+        return False
+
+    def reset(self) -> None:
+        """Forget streaks and history (after a rewind the loss scale may
+        legitimately differ — stale medians must not re-trigger)."""
+        self._bad_streak = 0
+        self._history.clear()

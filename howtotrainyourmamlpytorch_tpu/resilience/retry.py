@@ -1,0 +1,104 @@
+"""Jittered exponential backoff for storage IO.
+
+Shared-filesystem IO (gcsfuse, NFS) fails transiently under preemption
+churn; a training run must not die because one ``state.json`` write hit
+a 50ms mount hiccup. ``utils/storage.py`` and ``utils/checkpoint.py``
+decorate their read/write primitives with :func:`retry_io`:
+
+* bounded retries (``MAML_IO_RETRIES``, default 3 — 4 attempts total);
+* exponential backoff with multiplicative jitter so a fleet of hosts
+  retrying the same flaky mount doesn't re-stampede it in lockstep;
+* ``FileNotFoundError`` gives up immediately by default — a missing file
+  is control flow (fallback/fresh-run detection), not a transient fault;
+* every retry counts ``resilience/io_retries`` and every exhaustion
+  counts ``resilience/io_giveups`` in the installed telemetry registry.
+
+The delay math lives in :func:`backoff_delay`, a pure function pinned by
+tier-1 tests. Retries are NOT applied to append-style writes
+(``save_statistics``): a retry after a partial append would duplicate the
+row — only idempotent whole-file operations go through this layer.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import random
+import time
+import warnings
+import zlib
+from typing import Callable, Tuple, Type
+
+from howtotrainyourmamlpytorch_tpu import resilience
+
+DEFAULT_RETRIES = int(os.environ.get("MAML_IO_RETRIES", "3"))
+DEFAULT_BASE_S = float(os.environ.get("MAML_IO_RETRY_BASE_S", "0.02"))
+DEFAULT_CAP_S = float(os.environ.get("MAML_IO_RETRY_CAP_S", "2.0"))
+DEFAULT_FACTOR = 2.0
+DEFAULT_JITTER_FRAC = 0.5
+
+
+def backoff_delay(attempt: int, base: float = DEFAULT_BASE_S,
+                  factor: float = DEFAULT_FACTOR,
+                  cap: float = DEFAULT_CAP_S,
+                  jitter_frac: float = DEFAULT_JITTER_FRAC,
+                  rng: random.Random = None) -> float:
+    """Sleep before retry ``attempt`` (0-based): ``base * factor**attempt``
+    capped at ``cap``, then scaled by a jitter factor drawn uniformly
+    from ``[1, 1 + jitter_frac]``. Jitter multiplies AFTER the cap so the
+    worst case stays bounded by ``cap * (1 + jitter_frac)``."""
+    if attempt < 0:
+        raise ValueError(f"attempt must be >= 0, got {attempt}")
+    if base <= 0 or factor < 1 or cap <= 0 or jitter_frac < 0:
+        raise ValueError(
+            f"invalid backoff spec (base={base}, factor={factor}, "
+            f"cap={cap}, jitter_frac={jitter_frac})")
+    delay = min(base * factor ** attempt, cap)
+    if jitter_frac and rng is not None:
+        delay *= 1.0 + rng.random() * jitter_frac
+    return delay
+
+
+def retry_io(description: str, retries: int = None,
+             base: float = None, cap: float = None,
+             retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+             give_up_on: Tuple[Type[BaseException], ...] = (
+                 FileNotFoundError,),
+             sleep: Callable[[float], None] = time.sleep):
+    """Decorator: retry a transiently-failing idempotent IO callable.
+
+    ``give_up_on`` exceptions re-raise immediately even when they match
+    ``retry_on`` (FileNotFoundError IS an OSError, but retrying a missing
+    file only delays the caller's fallback logic).
+    """
+    n_retries = DEFAULT_RETRIES if retries is None else int(retries)
+    base_s = DEFAULT_BASE_S if base is None else float(base)
+    cap_s = DEFAULT_CAP_S if cap is None else float(cap)
+
+    def decorate(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            # Jitter seed = site ⊕ pid: deterministic WITHIN a process
+            # (reproducible chaos runs) but different across the fleet's
+            # processes — hosts hitting the same flaky mount in lockstep
+            # at a collective must not retry at identical instants.
+            rng = random.Random(zlib.crc32(description.encode())
+                                ^ (os.getpid() << 16))
+            for attempt in range(n_retries + 1):
+                try:
+                    return fn(*args, **kwargs)
+                except give_up_on:
+                    raise
+                except retry_on as e:
+                    if attempt >= n_retries:
+                        resilience.counter_inc("resilience/io_giveups")
+                        raise
+                    resilience.counter_inc("resilience/io_retries")
+                    warnings.warn(
+                        f"{description}: {type(e).__name__}: {e} — "
+                        f"retry {attempt + 1}/{n_retries}", stacklevel=2)
+                    sleep(backoff_delay(attempt, base=base_s, cap=cap_s,
+                                        rng=rng))
+            raise AssertionError("unreachable")  # loop always returns/raises
+        return wrapper
+    return decorate

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from howtotrainyourmamlpytorch_tpu import resilience
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.meta.outer import (
     MetaTrainState, init_train_state, migrate_lslr_rows,
@@ -115,6 +116,11 @@ class ServingEngine:
         self.cache = AdaptedParamsLRU(cfg.serve_cache_capacity)
         self.registry = registry if registry is not None else (
             MetricsRegistry())
+        # Serve-side storage retries / fault counters land in THIS
+        # engine's registry while it is the live serving process
+        # (restored on close(), mirroring the compile listener below).
+        self._prev_resilience_registry = resilience.set_registry(
+            self.registry)
         # Steady-state no-recompile guarantee is OBSERVABLE, not hoped:
         # the process-wide compile listener counts every XLA compile
         # into this registry; after warmup() the counter must go flat
@@ -153,9 +159,11 @@ class ServingEngine:
                    state_context=f"ckpt:{tag}:{ckpt.fingerprint(tag)}")
 
     def close(self) -> None:
-        """Detach the process-wide compile listener (a test or driver
-        may build many engines; each should count only its own)."""
+        """Detach the process-wide compile listener and restore the
+        previous resilience registry (a test or driver may build many
+        engines; each should count only its own)."""
         self._compile_watch.uninstall()
+        resilience.set_registry(self._prev_resilience_registry)
 
     def __enter__(self) -> "ServingEngine":
         return self
@@ -226,7 +234,10 @@ class ServingEngine:
         if not group:
             return responses
 
-        # Cache lookup per request (hits skip adaptation entirely).
+        # Cache lookup per request (hits skip adaptation entirely). The
+        # cache is an OPTIMIZATION, never a dependency: any lookup/store
+        # failure degrades that request to the adapt-on-miss path
+        # (counted) instead of failing the group (docs/RESILIENCE.md).
         keys = [support_fingerprint(r.support_x, r.support_y,
                                     self.num_adapt_steps,
                                     context=self._fp_context)
@@ -235,7 +246,11 @@ class ServingEngine:
         hit_flags: List[bool] = []
         misses: List[int] = []
         for i, key in enumerate(keys):
-            cached = self.cache.get(key)
+            try:
+                cached = self.cache.get(key)
+            except Exception:
+                reg.counter("resilience/cache_errors").inc()
+                cached = None
             hit_flags.append(cached is not None)
             if cached is not None:
                 entries[i] = cached
@@ -253,7 +268,11 @@ class ServingEngine:
             for j, i in enumerate(misses):
                 entry = jax.tree.map(lambda x, j=j: x[j], adapted)
                 entries[i] = entry
-                self.cache.put(keys[i], entry)
+                try:
+                    self.cache.put(keys[i], entry)
+                except Exception:
+                    # A failed store only costs the NEXT repeat an adapt.
+                    reg.counter("resilience/cache_errors").inc()
 
         logits = self._run_predict([entries[i] for i in range(len(group))],
                                    group, bucket)

@@ -15,7 +15,7 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, Optional, Union
 
-SCHEMA = "maml_tpu_telemetry_report_v2"  # v2: + "serving" section
+SCHEMA = "maml_tpu_telemetry_report_v3"  # v2: + "serving"; v3: + "resilience"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -123,6 +123,49 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                             else UNAVAILABLE),
         }
 
+    # Resilience section (resilience/ subsystem): counters ride registry
+    # "metrics" rows like serve/*. Unlike serving, one log routinely
+    # spans SEVERAL process lifetimes (preempt → restart resets every
+    # counter to 0), so last-row-wins would silently drop the killed
+    # segment's rewinds — exactly the events this section exists to
+    # surface. Accumulate with counter-reset detection instead (the
+    # Prometheus rate() rule): a value below its predecessor starts a
+    # new segment and contributes whole; otherwise the delta
+    # contributes. data/corrupt_episodes belongs here too — it is the
+    # loader's fail-soft skip counter. Logs predating the subsystem
+    # summarize the section to "unavailable".
+    _RES_KEYS = {
+        "rewinds": "resilience/rewinds",
+        "nan_steps": "resilience/nan_steps",
+        "loss_spikes": "resilience/loss_spikes",
+        "io_retries": "resilience/io_retries",
+        "io_giveups": "resilience/io_giveups",
+        "quarantined": "resilience/quarantined",
+        "faults_injected": "resilience/faults_injected",
+        "cache_errors": "resilience/cache_errors",
+        "corrupt_episodes": "data/corrupt_episodes",
+    }
+    resilience_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    totals: Dict[str, float] = {}
+    prev_row: Dict[str, float] = {}
+    for e in events:
+        if e.get("event") != "metrics":
+            continue
+        m = e.get("metrics") or {}
+        if not any(k.startswith("resilience/") for k in m) \
+                and "data/corrupt_episodes" not in m:
+            continue
+        for key in _RES_KEYS.values():
+            if m.get(key) is None:
+                continue
+            value = float(m[key])
+            prev = prev_row.get(key, 0.0)
+            totals[key] = totals.get(key, 0.0) + (
+                value if value < prev else value - prev)
+            prev_row[key] = value
+        resilience_sec = {label: int(totals.get(key, 0))
+                          for label, key in _RES_KEYS.items()}
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -152,6 +195,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "live_memory_bytes": (int(max(lives)) if lives else UNAVAILABLE),
         "host_skew": host_skew,
         "serving": serving,
+        "resilience": resilience_sec,
     }
 
 
@@ -179,6 +223,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("live memory bytes total", summary["live_memory_bytes"]),
         ("per-host step skew", summary["host_skew"]),
         ("serving", summary["serving"]),
+        ("resilience", summary["resilience"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

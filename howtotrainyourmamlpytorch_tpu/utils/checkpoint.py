@@ -10,6 +10,14 @@ TPU-native: state is a pure pytree (flax.serialization msgpack bytes), so a
 checkpoint is one atomic file write (tmp + rename) — no pickled module
 objects. Metadata (iteration, epoch, per-epoch val accuracy) lives in a
 sidecar JSON, human-readable for debugging and resume.
+
+Resilience (docs/RESILIENCE.md): checkpoint bytes are framed with a CRC32
+header verified on load (silent bit-rot becomes a loud
+:class:`CorruptCheckpointError` instead of garbage weights); reads and
+writes retry transient IO with backoff; and ``load_latest_or_fallback``
+QUARANTINES an unreadable checkpoint (rename to ``*.corrupt``, drop its
+bookkeeping) so every later resume skips it instead of re-attempting the
+same damaged bytes.
 """
 
 from __future__ import annotations
@@ -22,18 +30,75 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 from flax import serialization
 
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    counter_inc, faults, retry_io)
 from howtotrainyourmamlpytorch_tpu.utils.storage import (
     load_from_json, save_to_json)
 
 LATEST = "latest"
 
+# Framed checkpoint layout: magic ‖ crc32(payload) ‖ len(payload) ‖ payload.
+# Files without the magic are pre-framing checkpoints and load as raw
+# payload — old checkpoints stay resumable, they just skip CRC coverage.
+_MAGIC = b"MAMLCKP1"
+_HEADER_LEN = len(_MAGIC) + 4 + 8
+
+
+class CorruptCheckpointError(RuntimeError):
+    """Framed checkpoint whose payload fails its CRC/length check."""
+
+
+def _frame_payload(payload: bytes) -> bytes:
+    return (_MAGIC + zlib.crc32(payload).to_bytes(4, "little")
+            + len(payload).to_bytes(8, "little") + payload)
+
+
+def _unframe_payload(blob: bytes, path: str) -> bytes:
+    if not blob.startswith(_MAGIC):
+        return blob  # pre-framing checkpoint: raw msgpack payload
+    crc = int.from_bytes(blob[len(_MAGIC):len(_MAGIC) + 4], "little")
+    n = int.from_bytes(blob[len(_MAGIC) + 4:_HEADER_LEN], "little")
+    payload = blob[_HEADER_LEN:]
+    if len(payload) != n:
+        raise CorruptCheckpointError(
+            f"{path}: payload length {len(payload)} != header {n} "
+            f"(truncated write or partial copy)")
+    if zlib.crc32(payload) != crc:
+        raise CorruptCheckpointError(
+            f"{path}: payload CRC mismatch (bit-rot or concurrent "
+            f"overwrite)")
+    return payload
+
+
+@retry_io("checkpoint write")
+def _write_bytes_atomic(path: str, data: bytes) -> None:
+    if faults.maybe_fire("io_write"):
+        raise OSError(f"injected io_write fault ({path})")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+@retry_io("checkpoint read")
+def _read_bytes(path: str) -> bytes:
+    if faults.maybe_fire("io_read"):
+        raise OSError(f"injected io_read fault ({path})")
+    with open(path, "rb") as f:
+        return f.read()
+
 
 class CheckpointManager:
     """Manages ``train_model_<epoch>.ckpt`` files + ``state.json``."""
 
-    def __init__(self, directory: str, max_to_keep: int = 5):
+    def __init__(self, directory: str, max_to_keep: int = 5,
+                 quarantine: bool = True):
         self.directory = directory
         self.max_to_keep = max_to_keep
+        # Whether THIS process may rename/delete damaged files during
+        # fallback (multi-host: exactly one writer touches the shared
+        # filesystem — non-main processes pass False and only skip).
+        self.quarantine = quarantine
         os.makedirs(directory, exist_ok=True)
         self._meta_path = os.path.join(directory, "state.json")
         # Whether bookkeeping came from disk: a checkpoint FILE without
@@ -44,10 +109,14 @@ class CheckpointManager:
         if self.meta_from_disk:
             self.meta: Dict[str, Any] = load_from_json(self._meta_path)
             self.meta.setdefault("iter_at_epoch", {})
+            # Divergence-rewind count (resilience/guard.py): persisted so
+            # a resumed run keeps the re-seeded train stream.
+            self.meta.setdefault("rewinds", 0)
         else:
             self.meta = {"current_iter": 0, "current_epoch": 0,
                          "val_acc_per_epoch": {}, "iter_at_epoch": {},
-                         "best_val_acc": 0.0, "best_val_epoch": -1}
+                         "best_val_acc": 0.0, "best_val_epoch": -1,
+                         "rewinds": 0}
 
     # -- paths ----------------------------------------------------------
     def _ckpt_path(self, tag) -> str:
@@ -55,10 +124,17 @@ class CheckpointManager:
 
     @staticmethod
     def _atomic_write(path: str, data: bytes) -> None:
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, path)
+        _write_bytes_atomic(path, data)
+        # Deterministic post-write corruption (fault-injection only):
+        # flip a payload byte in place so the CRC verification and the
+        # quarantine-then-fallback path can be exercised end-to-end.
+        if faults.maybe_fire("ckpt_corrupt"):
+            with open(path, "r+b") as f:
+                size = os.path.getsize(path)
+                f.seek(size // 2)
+                byte = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([byte[0] ^ 0xFF]))
 
     # -- save -----------------------------------------------------------
     def save(self, state, epoch: int, current_iter: int,
@@ -72,7 +148,8 @@ class CheckpointManager:
         filesystem.
         """
         if write:
-            data = serialization.to_bytes(jax.device_get(state))
+            data = _frame_payload(
+                serialization.to_bytes(jax.device_get(state)))
             epoch_path = self._ckpt_path(epoch)
             self._atomic_write(epoch_path, data)
             # 'latest' is a hard link to the epoch file (atomic via tmp
@@ -110,8 +187,9 @@ class CheckpointManager:
         self.meta["current_iter"] = int(current_iter)
         if not write:
             return
-        self._atomic_write(self._ckpt_path(LATEST),
-                           serialization.to_bytes(jax.device_get(state)))
+        self._atomic_write(
+            self._ckpt_path(LATEST),
+            _frame_payload(serialization.to_bytes(jax.device_get(state))))
         save_to_json(self._meta_path, self.meta)
 
     def _prune(self) -> None:
@@ -136,8 +214,8 @@ class CheckpointManager:
         path = self._ckpt_path(tag)
         if not os.path.isfile(path):
             raise FileNotFoundError(path)
-        with open(path, "rb") as f:
-            state = serialization.from_bytes(template_state, f.read())
+        payload = _unframe_payload(_read_bytes(path), path)
+        state = serialization.from_bytes(template_state, payload)
         meta = dict(self.meta)
         if tag != LATEST:
             epoch_iter = self.meta["iter_at_epoch"].get(str(int(tag)))
@@ -145,6 +223,37 @@ class CheckpointManager:
                 meta["current_iter"] = epoch_iter
                 meta["current_epoch"] = int(tag)
         return state, meta
+
+    def _quarantine(self, tag) -> None:
+        """Move an unreadable checkpoint aside (``<file>.corrupt``) and
+        drop its bookkeeping, so the NEXT resume skips it instead of
+        re-attempting the same damaged bytes — and the ensemble test
+        protocol never tries to load it. No-op when this process is not
+        the filesystem writer (``quarantine=False``) or the file is
+        already gone (a peer got there first)."""
+        if not self.quarantine:
+            return
+        path = self._ckpt_path(tag)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            return
+        counter_inc("resilience/quarantined")
+        warnings.warn(
+            f"quarantined unreadable checkpoint {os.path.basename(path)} "
+            f"-> {os.path.basename(path)}.corrupt", stacklevel=3)
+        if tag != LATEST:
+            for key in ("val_acc_per_epoch", "iter_at_epoch"):
+                self.meta[key].pop(str(int(tag)), None)
+            # The quarantined epoch may have been the best: bookkeeping
+            # must track the best REMAINING checkpoint, or later (worse
+            # but real) epochs can never reclaim best_val_acc.
+            self._recompute_best()
+            try:
+                save_to_json(self._meta_path, self.meta)
+            except OSError:
+                pass  # bookkeeping update is best-effort; the rename
+                      # alone already prevents the re-attempt
 
     def load_latest_or_fallback(self, template_state):
         """Restore ``latest``; on a corrupt file, fall back to the newest
@@ -155,7 +264,8 @@ class CheckpointManager:
         NFS truncation. Falling back loses at most the iterations since
         the last epoch boundary; silently restarting from scratch (the
         alternative) would lose the whole run, so if nothing is readable
-        we raise rather than guess.
+        we raise rather than guess. Each unreadable-but-present file is
+        quarantined (``_quarantine``) so the damage is paid for once.
 
         Returns ``(state, meta, tag)`` where ``tag`` is ``'latest'`` or
         the epoch actually loaded.
@@ -180,6 +290,8 @@ class CheckpointManager:
                 # msgpack/flax error types vary) — both are
                 # external-damage modes, e.g. a partial rsync
                 failures.append((LATEST, brief(e)))
+                if not isinstance(e, FileNotFoundError):
+                    self._quarantine(LATEST)
         epochs = sorted(
             (int(e) for e in self.meta["iter_at_epoch"]
              if self.has_checkpoint(int(e))),
@@ -189,6 +301,8 @@ class CheckpointManager:
                 state, meta = self.load(template_state, epoch)
             except Exception as e:
                 failures.append((epoch, brief(e)))
+                if not isinstance(e, FileNotFoundError):
+                    self._quarantine(epoch)
                 continue
             warnings.warn(
                 f"checkpoint 'latest' unreadable "
@@ -221,6 +335,13 @@ class CheckpointManager:
                               if int(e) <= epoch}
         self.meta["current_iter"] = self.meta["iter_at_epoch"][str(epoch)]
         self.meta["current_epoch"] = epoch
+        self._recompute_best()
+        if write:
+            save_to_json(self._meta_path, self.meta)
+
+    def _recompute_best(self) -> None:
+        """Re-derive best_val_acc/best_val_epoch from the epochs still in
+        the bookkeeping (after a rewind or a quarantine removed some)."""
         kept = self.meta["val_acc_per_epoch"]
         if kept:
             best = max(kept.items(), key=lambda kv: (kv[1], int(kv[0])))
@@ -229,8 +350,6 @@ class CheckpointManager:
         else:
             self.meta["best_val_acc"] = 0.0
             self.meta["best_val_epoch"] = -1
-        if write:
-            save_to_json(self._meta_path, self.meta)
 
     # -- queries ---------------------------------------------------------
     def top_epochs(self, k: Optional[int] = None) -> List[int]:

@@ -9,6 +9,14 @@ tooling pointed at a reference experiment dir keeps working:
         saved_models/
         logs/summary_statistics.csv
         logs/test_summary.csv
+
+Resilience (docs/RESILIENCE.md): the idempotent whole-file operations
+(JSON save/load) retry transient IO errors with jittered exponential
+backoff (``resilience/retry.py``) and carry the ``io_write``/``io_read``
+fault-injection hooks inside the retried body, so an injected fault is
+recovered by the same code path a real mount hiccup exercises. The
+append-style CSV write is deliberately NOT retried — a retry after a
+partial append would duplicate the row.
 """
 
 from __future__ import annotations
@@ -17,6 +25,8 @@ import csv
 import json
 import os
 from typing import Any, Dict, List, Sequence
+
+from howtotrainyourmamlpytorch_tpu.resilience import faults, retry_io
 
 
 def build_experiment_folder(experiment_root: str,
@@ -68,13 +78,19 @@ def load_statistics(logs_dir: str,
     return {k: [r[k] for r in rows] for k in rows[0]}
 
 
+@retry_io("json write")
 def save_to_json(path: str, obj: Any) -> None:
+    if faults.maybe_fire("io_write"):
+        raise OSError(f"injected io_write fault ({path})")
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(obj, f, indent=2)
     os.replace(tmp, path)
 
 
+@retry_io("json read")
 def load_from_json(path: str) -> Any:
+    if faults.maybe_fire("io_read"):
+        raise OSError(f"injected io_read fault ({path})")
     with open(path) as f:
         return json.load(f)

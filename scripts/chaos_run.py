@@ -1,0 +1,177 @@
+"""Chaos harness: prove end-to-end fault recovery, don't hope for it.
+
+Runs the ISSUE 3 acceptance scenario on a tiny synthetic config:
+
+1. **baseline** — a fault-free run straight through the ensemble test
+   protocol (the accuracy yardstick).
+2. **faulted** — the same schedule with a deterministic fault plan
+   (resilience/faults.py): one transient checkpoint-write IO error
+   (``io_write@1``, recovered by the storage backoff layer), a NaN outer
+   loss in epoch 1 (``nan_loss@N``, recovered by the divergence guard's
+   rewind to the epoch-0 checkpoint + train-stream re-seed), and a
+   mid-epoch SIGTERM (``kill@M``, recovered by the save-on-signal
+   snapshot). The phase ends "preempted".
+3. **restart** — resume from 'latest' with NO faults; the run completes
+   epoch 1 and the test protocol.
+
+The verdict requires `resilience/rewinds >= 1`, `resilience/io_retries
+>= 1`, exactly one preemption, and a final test accuracy within
+``--tolerance`` of the baseline.
+
+Artifact contract (bench.py discipline): the LAST stdout JSON line is
+authoritative — ``{"metric": "chaos_recovery", "status":
+"recovered"|"failed", ...}`` with the fault/recovery counters. Exit 0
+iff recovered.
+
+Usage:
+    python scripts/chaos_run.py --quick          # CI/CPU smoke (~1 min)
+    python scripts/chaos_run.py --out /tmp/chaos --tolerance 0.2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def tiny_cfg(out_dir: str, name: str, **kw):
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    base = dict(
+        experiment_name=name, experiment_root=out_dir,
+        dataset_name="synthetic_chaos",
+        image_height=10, image_width=10, image_channels=1,
+        num_classes_per_set=3, num_samples_per_class=1,
+        num_target_samples=2, batch_size=2,
+        cnn_num_filters=4, num_stages=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=False, use_multi_step_loss_optimization=False,
+        total_epochs=2, total_iter_per_epoch=4,
+        num_evaluation_tasks=4, max_models_to_save=2,
+        compute_dtype="float32", meta_learning_rate=0.005,
+        # Sync every iteration: the guard/fault hooks live at the
+        # dispatch-sync points, and a chaos run wants tight granularity.
+        dispatch_sync_every=1, live_progress=False,
+        divergence_patience=1)
+    base.update(kw)
+    return MAMLConfig(**base)
+
+
+def run_phase(cfg):
+    """One ExperimentBuilder run; returns (result, counters snapshot)."""
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    builder = ExperimentBuilder(cfg)
+    result = builder.run_experiment()
+    return result, builder.registry.snapshot()
+
+
+def counter_sum(snapshots, key) -> int:
+    return int(sum(float(s.get(key) or 0) for s in snapshots))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Deterministic chaos run: inject faults, prove "
+                    "recovery, emit a JSON artifact.")
+    ap.add_argument("--out", default=None, metavar="DIR",
+                    help="experiment root (default: a fresh temp dir, "
+                         "removed on success)")
+    ap.add_argument("--tolerance", type=float, default=0.4,
+                    help="max |test_acc(faulted) - test_acc(baseline)| — "
+                         "the rewind re-seeds the train stream, so exact "
+                         "equality is not expected")
+    ap.add_argument("--quick", action="store_true",
+                    help="accepted for CLI symmetry with the other "
+                         "scripts; the config is already CI-sized")
+    args = ap.parse_args(argv)
+
+    # Optional platform pin (repo convention, see train_maml_system.py:
+    # the ambient sitecustomize overrides the JAX_PLATFORMS env var, so
+    # CPU-only drives need a knob that wins).
+    platform = os.environ.get("MAML_JAX_PLATFORM")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+    out = args.out or tempfile.mkdtemp(prefix="chaos_run_")
+    cleanup = args.out is None
+
+    # Fault schedule against the 2x4-iteration run: epoch 0 is iters
+    # 1..4 (checkpoint at 4); nan at iter 5 trips the patience-1 guard →
+    # rewind to epoch 0; kill at iter 6 (reached only after the rewind)
+    # preempts mid-epoch; io_write@1 hits the very first JSON write
+    # (config.json) and is retried.
+    faulted_spec = "io_write@1;nan_loss@5;kill@6"
+
+    print(json.dumps({"phase": "baseline", "status": "running"}),
+          flush=True)
+    baseline_result, baseline_counters = run_phase(
+        tiny_cfg(out, "chaos_baseline"))
+
+    print(json.dumps({"phase": "faulted", "spec": faulted_spec,
+                      "status": "running"}), flush=True)
+    faulted_result, faulted_counters = run_phase(
+        tiny_cfg(out, "chaos_faulted", fault_spec=faulted_spec))
+    preempted = (isinstance(faulted_result, dict)
+                 and "preempted_at_iter" in faulted_result)
+
+    print(json.dumps({"phase": "restart", "status": "running"}),
+          flush=True)
+    restart_result, restart_counters = run_phase(
+        tiny_cfg(out, "chaos_faulted", continue_from_epoch="latest"))
+
+    chaos_phases = [faulted_counters, restart_counters]
+    rewinds = counter_sum(chaos_phases, "resilience/rewinds")
+    io_retries = counter_sum(chaos_phases, "resilience/io_retries")
+    faults_injected = counter_sum(chaos_phases,
+                                  "resilience/faults_injected")
+    quarantined = counter_sum(chaos_phases, "resilience/quarantined")
+
+    base_acc = (baseline_result or {}).get("test_accuracy_mean")
+    chaos_acc = (restart_result or {}).get("test_accuracy_mean")
+    delta = (abs(chaos_acc - base_acc)
+             if base_acc is not None and chaos_acc is not None else None)
+
+    recovered = bool(
+        preempted and rewinds >= 1 and io_retries >= 1
+        and chaos_acc is not None
+        and delta is not None and delta <= args.tolerance)
+    # Recoveries: one per distinct fault class the run survived.
+    recoveries = int(preempted) + int(rewinds >= 1) + int(io_retries >= 1)
+
+    artifact = {
+        "metric": "chaos_recovery",
+        "value": 1.0 if recovered else 0.0,
+        "unit": "recovered",
+        "status": "recovered" if recovered else "failed",
+        "fault_spec": faulted_spec,
+        "faults_injected": faults_injected,
+        "recoveries": recoveries,
+        "rewinds": rewinds,
+        "io_retries": io_retries,
+        "quarantined": quarantined,
+        "preempted": preempted,
+        "preempted_at_iter": (faulted_result or {}).get(
+            "preempted_at_iter"),
+        "baseline_test_accuracy": base_acc,
+        "chaos_test_accuracy": chaos_acc,
+        "test_accuracy_delta": (round(delta, 6)
+                                if delta is not None else None),
+        "tolerance": args.tolerance,
+        "out_dir": None if cleanup else out,
+    }
+    if cleanup and recovered:
+        shutil.rmtree(out, ignore_errors=True)
+    print(json.dumps(artifact), flush=True)
+    return 0 if recovered else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -173,7 +173,8 @@ def test_corrupt_latest_falls_back_to_epoch_checkpoint(tmp_path):
 
     # Damage mode 1: the file is REPLACED (unlink + new inode — e.g. a
     # partial rsync). The hard-linked epoch-1 checkpoint is untouched, so
-    # fallback resumes from epoch 1's boundary.
+    # fallback resumes from epoch 1's boundary — and the damaged 'latest'
+    # is QUARANTINED (renamed *.corrupt) so it is never re-attempted.
     os.remove(latest)
     with open(latest, "wb") as f:
         f.write(b"truncated garbage")
@@ -183,11 +184,13 @@ def test_corrupt_latest_falls_back_to_epoch_checkpoint(tmp_path):
         builder = ExperimentBuilder(cfg2)
     assert any("unreadable" in str(r.message) for r in rec)
     assert builder.current_iter == 2 * cfg.total_iter_per_epoch
+    assert not os.path.exists(latest)            # quarantined...
+    assert os.path.exists(latest + ".corrupt")   # ...not deleted
 
-    # Damage mode 1b: 'latest' deleted outright (partial copy that missed
-    # it). Must still fall back — the pre-fix behavior silently restarted
-    # from scratch because the has_checkpoint('latest') guard hit first.
-    os.remove(latest)
+    # Damage mode 1b: 'latest' missing outright (here: the quarantine
+    # above; equivalently a partial copy that missed it). Must still fall
+    # back — the pre-fix behavior silently restarted from scratch because
+    # the has_checkpoint('latest') guard hit first.
     with warnings.catch_warnings(record=True) as rec:
         warnings.simplefilter("always")
         builder = ExperimentBuilder(cfg2)
@@ -196,10 +199,12 @@ def test_corrupt_latest_falls_back_to_epoch_checkpoint(tmp_path):
 
     # Damage mode 2: in-place bit-rot. 'latest' is a hard link to the
     # newest epoch checkpoint (one write per save), so the shared inode
-    # takes out BOTH and fallback must reach back to epoch 0. (Mode 1b
+    # takes out BOTH and fallback must reach back to epoch 0 —
+    # quarantining latest AND epoch 1 (whose bookkeeping is dropped so
+    # the ensemble protocol can never load the rotten file). (Mode 1b
     # left no 'latest'; recreate the production hard-link layout first.)
-    os.link(os.path.join(tmp_path, "smoke", "saved_models",
-                         "train_model_1.ckpt"), latest)
+    models_dir = os.path.join(tmp_path, "smoke", "saved_models")
+    os.link(os.path.join(models_dir, "train_model_1.ckpt"), latest)
     with open(latest, "r+b") as f:
         f.write(b"bit rot")
     with warnings.catch_warnings(record=True) as rec:
@@ -207,12 +212,13 @@ def test_corrupt_latest_falls_back_to_epoch_checkpoint(tmp_path):
         builder = ExperimentBuilder(cfg2)
     assert any("unreadable" in str(r.message) for r in rec)
     assert builder.current_iter == 1 * cfg.total_iter_per_epoch
+    assert not os.path.exists(os.path.join(models_dir,
+                                           "train_model_1.ckpt"))
+    assert "1" not in builder.ckpt.meta["iter_at_epoch"]
 
     # Damage mode 3: partial copy that dropped state.json but kept a
     # READABLE latest. Loading it would silently restart the iteration
     # counter and schedules at 0 under trained weights — must raise.
-    models_dir = os.path.join(tmp_path, "smoke", "saved_models")
-    os.remove(latest)
     os.link(os.path.join(models_dir, "train_model_0.ckpt"), latest)
     os.remove(os.path.join(models_dir, "state.json"))
     with pytest.raises(RuntimeError, match="state.json missing"):

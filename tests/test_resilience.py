@@ -1,0 +1,558 @@
+"""Resilience subsystem tests (ISSUE 3).
+
+Tier-1 keeps the cheap unit layers — fault-injection registry, backoff
+math, storage retry, CRC framing, quarantine, divergence guard, loader
+corrupt-episode skip, config knobs — inside the 870s budget. The system
+proofs (mid-epoch-kill resume equivalence, the full chaos acceptance
+scenario) are ``slow``.
+"""
+
+import json
+import math
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu import resilience
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    DivergenceGuard, backoff_delay, faults, retry_io)
+from howtotrainyourmamlpytorch_tpu.resilience.faults import FaultPlan
+from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    """Every test starts and ends with no fault plan and no process-wide
+    resilience registry (builders/engines install their own)."""
+    faults.configure("")
+    prev = resilience.set_registry(None)
+    yield
+    faults.configure("")
+    resilience.set_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# fault-injection registry
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_parse_and_fire():
+    plan = FaultPlan.parse("io_write@2:2; nan_loss@7 , kill@9")
+    assert {s.kind for s in plan.specs} == {"io_write", "nan_loss", "kill"}
+    # call-counted: fires on calls 2 and 3 only
+    assert [plan.maybe_fire("io_write") for _ in range(4)] == [
+        False, True, True, False]
+    # step-keyed
+    assert not plan.maybe_fire("nan_loss", step=6)
+    assert plan.maybe_fire("nan_loss", step=7)
+    assert plan.fired == [("io_write", 2), ("io_write", 3),
+                          ("nan_loss", 7)]
+
+
+def test_fault_fires_at_most_once_per_step():
+    """A rewind revisits the poisoned iteration; re-injecting there would
+    make recovery impossible by construction."""
+    plan = FaultPlan.parse("nan_loss@5")
+    assert plan.maybe_fire("nan_loss", step=5)
+    assert not plan.maybe_fire("nan_loss", step=5)
+
+
+def test_fault_plan_rejects_bad_specs():
+    for bad in ("nan_loss", "nope@3", "nan_loss@x", "nan_loss@-1",
+                "io_write@1:0"):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+
+def test_disabled_injection_is_inert():
+    assert not faults.active()
+    assert not faults.maybe_fire("nan_loss", step=1)
+    faults.configure("nan_loss@1")
+    assert faults.active() and faults.maybe_fire("nan_loss", step=1)
+    faults.configure("")
+    assert not faults.active()
+
+
+def test_fired_faults_count_into_registry():
+    reg = MetricsRegistry()
+    resilience.set_registry(reg)
+    faults.configure("io_read@1")
+    assert faults.maybe_fire("io_read")
+    assert reg.counter("resilience/faults_injected").value == 1
+
+
+# ---------------------------------------------------------------------------
+# backoff / retry
+# ---------------------------------------------------------------------------
+
+def test_backoff_delay_math():
+    import random
+    # Exponential growth, capped.
+    assert backoff_delay(0, base=0.1, factor=2, cap=10, jitter_frac=0) \
+        == pytest.approx(0.1)
+    assert backoff_delay(3, base=0.1, factor=2, cap=10, jitter_frac=0) \
+        == pytest.approx(0.8)
+    assert backoff_delay(30, base=0.1, factor=2, cap=10, jitter_frac=0) \
+        == pytest.approx(10)
+    # Jitter multiplies after the cap: bounded by cap * (1 + frac).
+    rng = random.Random(1)
+    for attempt in range(8):
+        d = backoff_delay(attempt, base=0.1, factor=2, cap=1.0,
+                          jitter_frac=0.5, rng=rng)
+        lo = min(0.1 * 2 ** attempt, 1.0)
+        assert lo <= d <= lo * 1.5
+    with pytest.raises(ValueError):
+        backoff_delay(-1)
+    with pytest.raises(ValueError):
+        backoff_delay(0, base=0)
+
+
+def test_retry_recovers_and_counts():
+    reg = MetricsRegistry()
+    resilience.set_registry(reg)
+    sleeps = []
+    calls = {"n": 0}
+
+    @retry_io("unit io", retries=3, base=1e-4, sleep=sleeps.append)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient")
+        return 42
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert flaky() == 42
+    assert calls["n"] == 3 and len(sleeps) == 2
+    assert reg.counter("resilience/io_retries").value == 2
+    assert any("retry 1/3" in str(r.message) for r in rec)
+
+
+def test_retry_bounded_and_giveup_counted():
+    reg = MetricsRegistry()
+    resilience.set_registry(reg)
+
+    @retry_io("unit io", retries=2, base=1e-4, sleep=lambda s: None)
+    def always_fails():
+        raise OSError("permanent")
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(OSError, match="permanent"):
+            always_fails()
+    assert reg.counter("resilience/io_retries").value == 2
+    assert reg.counter("resilience/io_giveups").value == 1
+
+
+def test_retry_gives_up_immediately_on_missing_file():
+    calls = {"n": 0}
+
+    @retry_io("unit io", retries=5, base=1e-4, sleep=lambda s: None)
+    def missing():
+        calls["n"] += 1
+        raise FileNotFoundError("nope")
+
+    with pytest.raises(FileNotFoundError):
+        missing()
+    assert calls["n"] == 1  # a missing file is control flow, not a fault
+
+
+def test_storage_json_injected_write_fault_recovers(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.storage import (
+        load_from_json, save_to_json)
+    reg = MetricsRegistry()
+    resilience.set_registry(reg)
+    faults.configure("io_write@1;io_read@1")
+    path = str(tmp_path / "x.json")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        save_to_json(path, {"a": 1})
+        assert load_from_json(path) == {"a": 1}
+    assert reg.counter("resilience/io_retries").value == 2
+    assert reg.counter("resilience/faults_injected").value == 2
+
+
+# ---------------------------------------------------------------------------
+# checkpoint CRC framing + quarantine
+# ---------------------------------------------------------------------------
+
+def _tiny_state():
+    import jax
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    cfg = MAMLConfig(image_height=8, image_width=8, image_channels=1,
+                     num_classes_per_set=2, cnn_num_filters=4,
+                     num_stages=1, number_of_training_steps_per_iter=2,
+                     number_of_evaluation_steps_per_iter=2,
+                     compute_dtype="float32")
+    init, _ = make_model(cfg)
+    return init_train_state(cfg, init, jax.random.PRNGKey(0))
+
+
+def test_checkpoint_crc_header_roundtrip_and_detection(tmp_path):
+    import jax
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        _MAGIC, CheckpointManager, CorruptCheckpointError)
+    mgr = CheckpointManager(str(tmp_path))
+    state = _tiny_state()
+    mgr.save(state, epoch=0, current_iter=3, val_acc=0.5)
+    path = tmp_path / "train_model_0.ckpt"
+    blob = path.read_bytes()
+    assert blob.startswith(_MAGIC)
+    loaded, meta = mgr.load(_tiny_state(), 0)
+    assert meta["current_iter"] == 3
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Flip one payload byte: the CRC must catch what msgpack might not.
+    mid = len(blob) // 2
+    path.write_bytes(blob[:mid] + bytes([blob[mid] ^ 0xFF])
+                     + blob[mid + 1:])
+    with pytest.raises(CorruptCheckpointError, match="CRC"):
+        mgr.load(_tiny_state(), 0)
+    # Truncation is caught by the length field.
+    path.write_bytes(blob[:-10])
+    with pytest.raises(CorruptCheckpointError, match="length"):
+        mgr.load(_tiny_state(), 0)
+
+
+def test_legacy_headerless_checkpoint_still_loads(tmp_path):
+    import jax
+    from flax import serialization
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        CheckpointManager)
+    mgr = CheckpointManager(str(tmp_path))
+    state = _tiny_state()
+    mgr.save(state, epoch=0, current_iter=3, val_acc=0.5)
+    # Rewrite the file as a pre-framing checkpoint: raw msgpack payload.
+    raw = serialization.to_bytes(jax.device_get(state))
+    (tmp_path / "train_model_0.ckpt").write_bytes(raw)
+    loaded, _ = mgr.load(_tiny_state(), 0)
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fallback_quarantines_corrupt_checkpoint(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        CheckpointManager)
+    reg = MetricsRegistry()
+    resilience.set_registry(reg)
+    mgr = CheckpointManager(str(tmp_path))
+    state = _tiny_state()
+    mgr.save(state, epoch=0, current_iter=2, val_acc=0.4)
+    mgr.save(state, epoch=1, current_iter=4, val_acc=0.6)
+    latest = tmp_path / "train_model_latest.ckpt"
+    # Replace 'latest' with garbage (new inode: the epoch files survive).
+    os.remove(latest)
+    latest.write_bytes(b"garbage")
+    # A resume constructs a FRESH manager (reads state.json from disk).
+    mgr = CheckpointManager(str(tmp_path))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        _, meta, tag = mgr.load_latest_or_fallback(_tiny_state())
+    assert tag == 1 and meta["current_iter"] == 4
+    # Quarantined: renamed aside, never re-attempted on the next resume.
+    assert not latest.exists()
+    assert (tmp_path / "train_model_latest.ckpt.corrupt").exists()
+    assert any("quarantined" in str(r.message) for r in rec)
+    assert reg.counter("resilience/quarantined").value == 1
+
+    # An EPOCH checkpoint that rots is also dropped from the bookkeeping
+    # (the ensemble protocol must not try to load it later).
+    p1 = tmp_path / "train_model_1.ckpt"
+    blob = p1.read_bytes()
+    mid = len(blob) // 2
+    p1.write_bytes(blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:])
+    mgr2 = CheckpointManager(str(tmp_path))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _, meta2, tag2 = mgr2.load_latest_or_fallback(_tiny_state())
+    assert tag2 == 0 and meta2["current_iter"] == 2
+    assert "1" not in mgr2.meta["iter_at_epoch"]
+    # Epoch 1 was the best (0.6): the best-val bookkeeping must fall
+    # back to the best REMAINING checkpoint, or no later epoch could
+    # ever reclaim best_val_acc from a *.corrupt file.
+    assert mgr2.meta["best_val_epoch"] == 0
+    assert mgr2.meta["best_val_acc"] == pytest.approx(0.4)
+    mgr3 = CheckpointManager(str(tmp_path))
+    assert "1" not in mgr3.meta["iter_at_epoch"]  # persisted
+    assert mgr3.meta["best_val_epoch"] == 0
+
+
+def test_quarantine_disabled_for_non_writer(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        CheckpointManager)
+    mgr = CheckpointManager(str(tmp_path))
+    state = _tiny_state()
+    mgr.save(state, epoch=0, current_iter=2, val_acc=0.4)
+    latest = tmp_path / "train_model_latest.ckpt"
+    os.remove(latest)
+    latest.write_bytes(b"garbage")
+    ro = CheckpointManager(str(tmp_path), quarantine=False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _, _, tag = ro.load_latest_or_fallback(_tiny_state())
+    assert tag == 0
+    assert latest.exists()  # a non-writer process must not touch the FS
+
+
+def test_injected_ckpt_corruption_recovered_on_resume(tmp_path):
+    """End-to-end through the manager: a fault-injected corrupt save is
+    caught by the CRC on load and the fallback recovers."""
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        CheckpointManager, CorruptCheckpointError)
+    mgr = CheckpointManager(str(tmp_path))
+    state = _tiny_state()
+    mgr.save(state, epoch=0, current_iter=2, val_acc=0.4)
+    # Corrupt the NEXT checkpoint write (epoch 1 file + its hard-linked
+    # 'latest' share the damaged inode).
+    faults.configure("ckpt_corrupt@1")
+    mgr.save(state, epoch=1, current_iter=4, val_acc=0.6)
+    with pytest.raises(CorruptCheckpointError):
+        mgr.load(_tiny_state(), 1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _, meta, tag = mgr.load_latest_or_fallback(_tiny_state())
+    assert tag == 0 and meta["current_iter"] == 2
+
+
+# ---------------------------------------------------------------------------
+# divergence guard
+# ---------------------------------------------------------------------------
+
+def test_guard_patience_and_reset():
+    g = DivergenceGuard(patience=3)
+    assert not g.observe(1.0, 0)
+    assert not g.observe(float("nan"), 1)
+    assert not g.observe(float("inf"), 2)
+    assert g.observe(float("nan"), 3)          # third consecutive bad
+    assert not g.observe(float("nan"), 4)      # streak reset by trigger
+    # A good loss in between resets the streak.
+    g2 = DivergenceGuard(patience=2)
+    assert not g2.observe(float("nan"), 0)
+    assert not g2.observe(1.0, 1)
+    assert not g2.observe(float("nan"), 2)
+    assert g2.observe(float("nan"), 3)
+
+
+def test_guard_spike_detection():
+    g = DivergenceGuard(patience=1, spike_factor=10.0)
+    for i in range(6):
+        assert not g.observe(1.0 + 0.01 * i, i)
+    assert not g.observe(5.0, 10)   # 5x median: not a spike at 10x
+    assert g.observe(50.0, 11)      # 50x median: spike, patience 1
+    # Spike detection needs history; a fresh guard ignores early spikes.
+    g2 = DivergenceGuard(patience=1, spike_factor=10.0)
+    assert not g2.observe(1e9, 0)
+
+
+def test_guard_counts_into_registry():
+    reg = MetricsRegistry()
+    resilience.set_registry(reg)
+    g = DivergenceGuard(patience=2)
+    g.observe(float("nan"), 0)
+    g.observe(float("nan"), 1)
+    assert reg.counter("resilience/nan_steps").value == 2
+
+
+def test_guard_rejects_bad_params():
+    with pytest.raises(ValueError):
+        DivergenceGuard(patience=0)
+    with pytest.raises(ValueError):
+        DivergenceGuard(patience=1, spike_factor=0.5)
+
+
+# ---------------------------------------------------------------------------
+# loader corrupt-episode skip
+# ---------------------------------------------------------------------------
+
+def _loader(registry=None):
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader)
+    cfg = MAMLConfig(dataset_name="synthetic_resilience",
+                     image_height=10, image_width=10, image_channels=1,
+                     num_classes_per_set=3, num_samples_per_class=1,
+                     num_target_samples=2, batch_size=4)
+    return MetaLearningDataLoader(cfg, registry=registry)
+
+
+def test_corrupt_episode_skipped_with_counter_and_replacement():
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        _REPLACEMENT_STRIDE)
+    reg = MetricsRegistry()
+    resilience.set_registry(reg)
+    faults.configure("episode_corrupt@2")
+    loader = _loader(registry=reg)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        batches = list(loader.get_train_batches(0, 2))
+    # Epoch step count preserved: both batches arrive, full-size.
+    assert len(batches) == 2
+    assert batches[0].support_x.shape[0] == 4
+    assert reg.counter("data/corrupt_episodes").value == 1
+    assert sum("replacement" in str(r.message) for r in rec) == 1
+    # The replacement is the DETERMINISTIC alternate episode, and the
+    # other positions are untouched.
+    sampler = loader.sampler("train")
+    np.testing.assert_array_equal(
+        batches[0].support_x[2],
+        sampler.sample(2 + _REPLACEMENT_STRIDE).support_x)
+    np.testing.assert_array_equal(batches[0].support_x[1],
+                                  sampler.sample(1).support_x)
+
+
+def test_persistently_broken_split_still_raises():
+    loader = _loader()
+    sampler = loader.sampler("train")
+    sampler.sample = lambda idx: (_ for _ in ()).throw(
+        RuntimeError("decode failed"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(RuntimeError, match="decode failed"):
+            list(loader.get_train_batches(0, 1))
+
+
+def test_train_salt_shifts_train_stream_only():
+    loader_a, loader_b = _loader(), _loader()
+    loader_b.set_train_salt(1)
+    a = next(iter(loader_a.get_train_batches(0, 1)))
+    b = next(iter(loader_b.get_train_batches(0, 1)))
+    assert not np.array_equal(a.support_x, b.support_x)
+    # Fixed eval streams are rewind-invariant.
+    va = next(iter(loader_a.get_val_batches()))
+    vb = next(iter(loader_b.get_val_batches()))
+    np.testing.assert_array_equal(np.asarray(va.support_x),
+                                  np.asarray(vb.support_x))
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+# ---------------------------------------------------------------------------
+
+def test_config_resilience_validation():
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    with pytest.raises(ValueError, match="divergence_patience"):
+        MAMLConfig(divergence_patience=-1)
+    with pytest.raises(ValueError, match="divergence_spike_factor"):
+        MAMLConfig(divergence_spike_factor=0.5)
+    with pytest.raises(ValueError, match="divergence_max_rewinds"):
+        MAMLConfig(divergence_max_rewinds=-1)
+    with pytest.raises(ValueError, match="fault spec"):
+        MAMLConfig(fault_spec="nonsense")
+    cfg = MAMLConfig.from_dict({"divergence_patience": 5,
+                                "fault_spec": "nan_loss@3"})
+    assert cfg.divergence_patience == 5 and cfg.fault_spec == "nan_loss@3"
+
+
+def test_preemption_at_epoch_boundary_reports_preempted(tmp_path):
+    """A signal that lands outside _train_epoch (epoch-boundary val
+    sweep, or before the loop starts) exits via the while condition —
+    it must still report preemption so the CLI exits EXIT_PREEMPTED and
+    the scheduler resubmits, never 'paused' (exit 0 = success)."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    builder = ExperimentBuilder(_cfg(tmp_path))
+    builder._preempted = True
+    assert builder.run_experiment() == {"preempted_at_iter": 0}
+
+
+# ---------------------------------------------------------------------------
+# system proofs (slow profile)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # full run + killed-and-resumed run (~60s), 1-core box
+def test_injected_midepoch_kill_resume_matches_uninterrupted(tmp_path):
+    """Satellite 3: a fault-injected mid-epoch SIGTERM (the REAL signal
+    path: handler -> quiesce -> latest snapshot) followed by a restart
+    must reproduce the uninterrupted run's post-resume trajectory
+    exactly (the episode stream is a pure function of the iteration)."""
+    import jax
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    cfg_a = _cfg(tmp_path / "a", dispatch_sync_every=1)
+    builder_a = ExperimentBuilder(cfg_a)
+    builder_a.run_experiment()
+
+    cfg_b = _cfg(tmp_path / "b", dispatch_sync_every=1,
+                 fault_spec="kill@3")
+    builder_b = ExperimentBuilder(cfg_b)
+    result = builder_b.run_experiment()
+    assert result == {"preempted_at_iter": 3}
+    assert builder_b.ckpt.has_checkpoint("latest")
+
+    cfg_b2 = _cfg(tmp_path / "b", dispatch_sync_every=1,
+                  continue_from_epoch="latest")
+    builder_b2 = ExperimentBuilder(cfg_b2)
+    assert builder_b2.current_iter == 3
+    builder_b2.run_experiment()
+
+    for a, b in zip(jax.tree.leaves(builder_a.state.params),
+                    jax.tree.leaves(builder_b2.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow  # NaN -> rewind -> recover run (~45s), 1-core box
+def test_nan_loss_triggers_rewind_and_run_recovers(tmp_path):
+    """Divergence guard end-to-end: an injected NaN outer loss in epoch 1
+    rewinds to the epoch-0 checkpoint, re-seeds the train stream, and
+    the run still completes the full schedule + test protocol."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    cfg = _cfg(tmp_path, dispatch_sync_every=1, divergence_patience=1,
+               fault_spec="nan_loss@6")  # epoch 1 (iters 6..10)
+    builder = ExperimentBuilder(cfg)
+    result = builder.run_experiment()
+    assert result["num_models"] == 2  # completed despite the NaN
+    assert builder.registry.counter("resilience/rewinds").value == 1
+    assert builder.ckpt.meta["rewinds"] == 1
+    # The rewind row landed in the event stream.
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    events = read_jsonl(os.path.join(builder.paths["logs"],
+                                     "events.jsonl"))
+    rewinds = [e for e in events if e.get("event") == "rewind"]
+    assert len(rewinds) == 1 and rewinds[0]["epoch"] == 0
+
+
+@pytest.mark.slow  # divergence with no checkpoint must fail loudly (~20s)
+def test_nan_before_any_checkpoint_fails_loudly(tmp_path):
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    cfg = _cfg(tmp_path, dispatch_sync_every=1, divergence_patience=1,
+               fault_spec="nan_loss@2")  # epoch 0: nothing to rewind to
+    with pytest.raises(RuntimeError, match="nothing to rewind"):
+        ExperimentBuilder(cfg).run_experiment()
+
+
+@pytest.mark.slow  # 3 tiny runs through the chaos harness (~90s), 1-core
+def test_chaos_acceptance(tmp_path, capsys):
+    """THE ISSUE 3 acceptance scenario: injected NaN loss + one injected
+    checkpoint-write IO error + one mid-epoch SIGTERM; the restarted run
+    completes with rewinds >= 1, io_retries >= 1, and a final accuracy
+    within tolerance of the fault-free run."""
+    import sys
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    try:
+        import chaos_run
+    finally:
+        sys.path.pop(0)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rc = chaos_run.main(["--out", str(tmp_path)])
+    last = capsys.readouterr().out.strip().splitlines()[-1]
+    artifact = json.loads(last)
+    assert rc == 0, artifact
+    assert artifact["status"] == "recovered"
+    assert artifact["rewinds"] >= 1
+    assert artifact["io_retries"] >= 1
+    assert artifact["preempted"] is True
+    assert artifact["faults_injected"] >= 3
+    assert artifact["test_accuracy_delta"] <= artifact["tolerance"]

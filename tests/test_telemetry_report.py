@@ -19,20 +19,24 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 
 # Every key the CI consumer may rely on (the acceptance list: step-time
 # percentiles, tasks/sec/chip, compile count/seconds, feed-stall
-# fraction, peak memory, per-host skew; v2 adds the serving section).
+# fraction, peak memory, per-host skew; v2 adds the serving section,
+# v3 the resilience section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
-    "live_memory_bytes", "host_skew", "serving",
+    "live_memory_bytes", "host_skew", "serving", "resilience",
 }
 
 
-def write_fixture_events(path, *, with_failsoft=True, with_serving=False):
+def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
+                         with_resilience=False):
     """A synthetic 2-epoch run's event stream, as the experiment loop
     writes it (train_epoch + telemetry + heartbeat per epoch); with
     ``with_serving``, a trailing serve/ registry-flush row as
-    ServingEngine.flush_metrics writes it."""
+    ServingEngine.flush_metrics writes it; with ``with_resilience``,
+    registry-flush rows carrying resilience/* counters as the
+    experiment loop's per-epoch flush writes them."""
     log = JsonlLogger(str(path))
     for epoch, (p50, p95, rate) in enumerate([(0.10, 0.50, 40.0),
                                               (0.08, 0.12, 50.0)]):
@@ -70,6 +74,20 @@ def write_fixture_events(path, *, with_failsoft=True, with_serving=False):
             "serve/latency_seconds": {"count": 38, "sum": 3.8,
                                       "p50": 0.1, "p95": 0.4},
         })
+    if with_resilience:
+        # Two rows: counters are cumulative, the LAST row wins.
+        log.log("metrics", metrics={"resilience/rewinds": 0.0,
+                                    "resilience/io_retries": 1.0})
+        log.log("metrics", metrics={
+            "resilience/rewinds": 1.0,
+            "resilience/nan_steps": 2.0,
+            "resilience/io_retries": 3.0,
+            "resilience/io_giveups": 0.0,
+            "resilience/quarantined": 1.0,
+            "resilience/faults_injected": 4.0,
+            "resilience/cache_errors": 1.0,
+            "data/corrupt_episodes": 2.0,
+        })
     return log.path
 
 
@@ -90,8 +108,9 @@ def test_summarize_events_fixture(tmp_path):
     assert s["peak_memory_bytes"] == 2001
     assert s["host_skew"]["hosts"] == 4
     assert s["host_skew"]["max_skew_frac"] == pytest.approx(0.1)
-    # No serve/ rows -> the serving section says so explicitly.
+    # No serve/ or resilience/ rows -> the sections say so explicitly.
     assert s["serving"] == UNAVAILABLE
+    assert s["resilience"] == UNAVAILABLE
     # The table renders every row without raising.
     table = format_table(s)
     assert "feed stall fraction" in table and "0.1" in table
@@ -115,6 +134,51 @@ def test_summarize_events_serving_section(tmp_path):
     assert "serving" in format_table(s)
     # Training metrics are untouched by the serve rows.
     assert s["epochs"] == 2 and s["compile_count"] == 4
+
+
+def test_summarize_events_resilience_section(tmp_path):
+    """resilience/* metric rows (the experiment loop's per-epoch registry
+    flush) render the v3 resilience section; cumulative counters mean
+    the LAST row wins."""
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    path = write_fixture_events(tmp_path / "events.jsonl",
+                                with_resilience=True)
+    s = summarize_events(read_jsonl(path))
+    assert set(s) == SCHEMA_KEYS
+    res = s["resilience"]
+    assert res["rewinds"] == 1
+    assert res["nan_steps"] == 2
+    assert res["io_retries"] == 3
+    assert res["io_giveups"] == 0
+    assert res["quarantined"] == 1
+    assert res["faults_injected"] == 4
+    assert res["cache_errors"] == 1
+    assert res["corrupt_episodes"] == 2
+    assert "resilience" in format_table(s)
+    # Training + serving metrics untouched by the resilience rows.
+    assert s["epochs"] == 2 and s["serving"] == UNAVAILABLE
+
+
+def test_resilience_counters_survive_process_restarts():
+    """A preempted-and-restarted run logs a fresh (reset-to-zero)
+    registry into the SAME events.jsonl. Counter-reset accumulation must
+    total across segments — last-row-wins would report the restarted
+    segment's zeros and hide the killed segment's rewind."""
+    events = [
+        # killed segment: epoch flush, then the preempt-path flush
+        {"event": "metrics", "metrics": {"resilience/rewinds": 0.0,
+                                         "resilience/io_retries": 1.0}},
+        {"event": "metrics", "metrics": {"resilience/rewinds": 1.0,
+                                         "resilience/io_retries": 1.0}},
+        # restarted segment: fresh registry, counters reset
+        {"event": "metrics", "metrics": {"resilience/rewinds": 0.0,
+                                         "resilience/io_retries": 0.0}},
+        {"event": "metrics", "metrics": {"resilience/rewinds": 0.0,
+                                         "resilience/io_retries": 2.0}},
+    ]
+    res = summarize_events(events)["resilience"]
+    assert res["rewinds"] == 1     # killed segment's rewind kept
+    assert res["io_retries"] == 3  # 1 (segment 1) + 2 (segment 2)
 
 
 def test_summarize_events_failsoft_markers(tmp_path):

@@ -19,6 +19,7 @@ import sys
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+from howtotrainyourmamlpytorch_tpu.resilience import EXIT_PREEMPTED
 from howtotrainyourmamlpytorch_tpu.utils.dataset_tools import (
     maybe_unzip_dataset)
 
@@ -169,7 +170,13 @@ def main(argv=None) -> int:
     finally:
         barrier("dataset_ready")
     builder = ExperimentBuilder(cfg)
-    builder.run_experiment()
+    result = builder.run_experiment()
+    if isinstance(result, dict) and "preempted_at_iter" in result:
+        # Distinct exit code (EX_TEMPFAIL): the run checkpointed cleanly
+        # on SIGTERM/SIGINT and wants to be resubmitted with
+        # continue_from_epoch='latest' — not a success, not a failure
+        # (docs/RESILIENCE.md § Exit codes).
+        return EXIT_PREEMPTED
     return 0
 
 
