@@ -92,6 +92,13 @@ class MAMLConfig:
     # mean=std=0.5 (i.e. x -> 2x-1). See MOUNT-AUDIT.md.
     image_norm_mean: Optional[Tuple[float, ...]] = None
     image_norm_std: Optional[Tuple[float, ...]] = None
+    # Packed episodic shards (datastore/ subsystem, docs/DATA.md):
+    # directory holding per-split <split>.mamlpack files. None = look
+    # next to the dataset dir (where scripts/dataset_pack.py writes by
+    # default). build_source prefers a readable shard over the directory
+    # tree — O(header) mmap open, zero decode; a corrupt shard is
+    # quarantined (*.corrupt) and the directory source takes over.
+    dataset_pack_path: Optional[str] = None
     # Fetch a missing packaged dataset over the network (reference
     # behavior: download-then-extract via the Google-Drive links in
     # utils/dataset_tools.py § DATASET_URLS). Off by default: the IDs are

@@ -3,11 +3,14 @@ from howtotrainyourmamlpytorch_tpu.data.sources import (
     DiskImageSource,
     SyntheticSource,
     build_source,
+    pack_shard_path,
+    source_kind,
 )
 from howtotrainyourmamlpytorch_tpu.data.sampler import EpisodeSampler
 from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
 
 __all__ = [
     "ArraySource", "DiskImageSource", "SyntheticSource", "build_source",
+    "pack_shard_path", "source_kind",
     "EpisodeSampler", "MetaLearningDataLoader",
 ]

@@ -8,6 +8,14 @@ TPU-friendly path: the episodic datasets are small — Omniglot ~14MB,
 Mini-ImageNet ~5GB resized — and host RAM beats per-episode JPEG decode) and
 a deterministic :class:`SyntheticSource` for tests/benchmarks.
 
+Packed shards (datastore/ subsystem, docs/DATA.md): when a
+``<split>.mamlpack`` shard exists (``scripts/dataset_pack.py``),
+:func:`build_source` prefers the mmap-backed
+:class:`~howtotrainyourmamlpytorch_tpu.datastore.packed.PackedSource`
+over the directory walk — O(header) open, zero decode, page cache shared
+across a host's processes; a corrupt shard is quarantined (``*.corrupt``)
+and the directory source takes over.
+
 Normalization note: images are returned float32 in [0, 1]; per-dataset
 affine normalization is applied by the sampler. The reference mount was
 empty at survey time (SURVEY.md § Provenance) so the exact reference
@@ -18,16 +26,34 @@ documented where it is defined and must be re-checked if the mount appears.
 from __future__ import annotations
 
 import os
+import time
 import warnings
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from howtotrainyourmamlpytorch_tpu.resilience import counter_inc, get_registry
+
 SPLITS = ("train", "val", "test")
+
+# Suffix of packed shards (datastore/format.py § MAMLPACK1), duplicated
+# here so resolving "is there a pack?" never imports the datastore
+# package for runs that have none.
+PACK_SUFFIX = ".mamlpack"
+
+
+def source_kind(source) -> str:
+    """Stable short name of a source's implementation ('packed', 'disk',
+    'synthetic', 'array') — the telemetry/bench vocabulary for "where do
+    episodes come from?" (docs/DATA.md). Wrappers delegate to what they
+    wrap."""
+    return str(getattr(source, "kind", type(source).__name__.lower()))
 
 
 class ArraySource:
     """Class-indexed images held in host memory as uint8 NHWC arrays."""
+
+    kind = "array"
 
     def __init__(self, classes: Dict[str, np.ndarray]):
         if not classes:
@@ -58,6 +84,11 @@ class ArraySource:
         device-side normalization path (4x fewer host->device bytes)."""
         return self._classes[class_name][indices]
 
+    def class_images(self, class_name: str) -> np.ndarray:
+        """The class's whole ``(n, H, W, C)`` uint8 block (the pack
+        CLI's bulk-read path; episodes use ``get_images_raw``)."""
+        return self._classes[class_name]
+
 
 class DiskImageSource:
     """Lazy class→file-path index over the reference's directory layouts.
@@ -81,6 +112,8 @@ class DiskImageSource:
 
     IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
 
+    kind = "disk"
+
     def __init__(self, root: str, image_size: Tuple[int, int, int],
                  preload: bool = False, numeric_sort: bool = False,
                  class_key_indexes: Optional[Sequence[int]] = None):
@@ -89,6 +122,7 @@ class DiskImageSource:
         self.numeric_sort = numeric_sort
         self._index: Dict[str, List[str]] = {}
         self._cache: Dict[str, np.ndarray] = {}
+        self._corrupt_warned = False
         root_norm = root.rstrip("/\\") or root
         for dirpath, dirnames, filenames in os.walk(root_norm):
             dirnames.sort()
@@ -137,19 +171,51 @@ class DiskImageSource:
         return len(self._index[class_name])
 
     def _load_class(self, class_name: str) -> np.ndarray:
+        """Decode + memoize one class, SKIPPING unreadable files.
+
+        A raise here used to poison the class forever: the exception
+        fired inside the memoized decode on every re-touch, so the
+        loader's fail-soft episode replacement could never succeed for
+        any episode that drew this class. Instead each bad file is
+        skipped with a ``data/corrupt_images`` count (one warning per
+        source), the class index shrinks to the readable files (so
+        ``num_images`` tells the sampler the truth from then on), and
+        only a class that loses EVERY image raises — that split really
+        is broken."""
         if class_name not in self._cache:
             from PIL import Image
             h, w, c = self.image_size
-            imgs = []
+            imgs, good, last_err = [], [], None
             for path in self._index[class_name]:
-                im = Image.open(path)
-                im = im.convert("L" if c == 1 else "RGB")
-                if im.size != (w, h):
-                    im = im.resize((w, h), Image.LANCZOS)
-                arr = np.asarray(im, np.uint8)
+                try:
+                    im = Image.open(path)
+                    im = im.convert("L" if c == 1 else "RGB")
+                    if im.size != (w, h):
+                        im = im.resize((w, h), Image.LANCZOS)
+                    arr = np.asarray(im, np.uint8)
+                except Exception as e:  # PIL raises a zoo of types
+                    last_err = e
+                    counter_inc("data/corrupt_images")
+                    if not self._corrupt_warned:
+                        self._corrupt_warned = True
+                        warnings.warn(
+                            f"unreadable image {path} "
+                            f"({type(e).__name__}: {str(e)[:120]}); "
+                            f"skipping it (further corrupt images are "
+                            f"counted, not warned)", stacklevel=3)
+                    continue
                 if c == 1:
                     arr = arr[..., None]
                 imgs.append(arr)
+                good.append(path)
+            if not imgs:
+                raise OSError(
+                    f"class {class_name!r}: all "
+                    f"{len(self._index[class_name])} image files "
+                    f"unreadable (last: {type(last_err).__name__}: "
+                    f"{str(last_err)[:120]})")
+            if len(good) != len(self._index[class_name]):
+                self._index[class_name] = good
             self._cache[class_name] = np.stack(imgs)
         return self._cache[class_name]
 
@@ -161,6 +227,19 @@ class DiskImageSource:
     def get_images_raw(self, class_name: str,
                        indices: np.ndarray) -> np.ndarray:
         return self._load_class(class_name)[indices]
+
+    def class_images(self, class_name: str) -> np.ndarray:
+        """The class's whole decoded ``(n, H, W, C)`` uint8 block (the
+        pack CLI's bulk-read path)."""
+        return self._load_class(class_name)
+
+    def evict_class(self, class_name: str) -> None:
+        """Drop a memoized class block. The pack CLI streams a whole
+        split through ``class_images``; without eviction the memo would
+        pin the full decoded dataset in RAM on exactly the small login
+        boxes packing targets. Episodic training never calls this —
+        revisiting classes is the workload, the memo is the point."""
+        self._cache.pop(class_name, None)
 
 
 class SubsetSource:
@@ -178,6 +257,10 @@ class SubsetSource:
         self._names = list(names)
 
     @property
+    def kind(self) -> str:
+        return source_kind(self._source)
+
+    @property
     def class_names(self) -> List[str]:
         return self._names
 
@@ -191,6 +274,14 @@ class SubsetSource:
     def get_images_raw(self, class_name: str,
                        indices: np.ndarray) -> np.ndarray:
         return self._source.get_images_raw(class_name, indices)
+
+    def class_images(self, class_name: str) -> np.ndarray:
+        return self._source.class_images(class_name)
+
+    def evict_class(self, class_name: str) -> None:
+        evict = getattr(self._source, "evict_class", None)
+        if evict is not None:
+            evict(class_name)
 
 
 def split_class_names(names: Sequence[str],
@@ -221,13 +312,21 @@ class SyntheticSource(ArraySource):
     """Deterministic procedurally-generated classes (tests / benchmarks).
 
     Each class is a fixed random prototype plus per-image noise, generated
-    from ``seed`` — distinct (split, seed) pairs give disjoint statistics.
+    from ``seed`` — an int, or a tuple of ints fed to
+    ``np.random.SeedSequence`` as independent entropy words so composite
+    seeds like ``(split_id, cfg.seed)`` give disjoint streams with NO
+    arithmetic collisions (the old ``1000*split_id + seed`` mixing made
+    (seed=1000, train) and (seed=0, val) the same stream).
     """
 
+    kind = "synthetic"
+
     def __init__(self, num_classes: int, images_per_class: int,
-                 image_size: Tuple[int, int, int], seed: int = 0):
+                 image_size: Tuple[int, int, int], seed=0):
         h, w, c = image_size
-        rng = np.random.default_rng(seed)
+        rng = np.random.default_rng(
+            np.random.SeedSequence(seed) if isinstance(seed, tuple)
+            else seed)
         classes = {}
         for i in range(num_classes):
             proto = rng.uniform(0, 255, (1, h, w, c))
@@ -240,23 +339,113 @@ class SyntheticSource(ArraySource):
 _SPLIT_SEEDS = {"train": 0, "val": 1, "test": 2}
 
 
+def pack_shard_path(cfg, split: str) -> str:
+    """Where ``build_source`` looks for ``split``'s packed shard:
+    ``<cfg.dataset_pack_path>/<split>.mamlpack`` when the config points
+    at a pack directory, else ``<cfg.dataset_dir>/<split>.mamlpack`` —
+    next to the split subdirectories, where ``scripts/dataset_pack.py``
+    writes by default."""
+    base = cfg.dataset_pack_path or cfg.dataset_dir
+    return os.path.join(base, split + PACK_SUFFIX)
+
+
+def _try_packed_source(cfg, split: str):
+    """Open ``split``'s packed shard if one exists; None = no (usable)
+    pack, fall through to the directory/synthetic resolution.
+
+    A corrupt/truncated shard is QUARANTINED — renamed ``*.corrupt``
+    (idempotent under multi-process races: the rename is attempted by
+    whichever process notices first, losers tolerate the miss) and
+    counted into ``resilience/quarantined``, consistent with the
+    checkpoint policy (utils/checkpoint.py § _quarantine) — so every
+    later open falls back to the directory source instead of re-parsing
+    the same damaged bytes. A shard whose geometry merely disagrees with
+    the config is left in place (it is a wrong file, not a damaged one)
+    and skipped with a warning.
+    """
+    path = pack_shard_path(cfg, split)
+    if not os.path.isfile(path):
+        if cfg.dataset_pack_path:
+            # An EXPLICIT pack path with no shard is warned about: a
+            # typo'd path silently changing the run's cold-start class
+            # is the quiet-fallback failure mode this config key's
+            # did-you-mean validation exists to prevent. The implicit
+            # next-to-the-dataset probe stays silent — most runs have
+            # no pack and that is normal.
+            warnings.warn(
+                f"dataset_pack_path is set but {path!r} does not "
+                f"exist; falling back to directory/synthetic "
+                f"resolution for split {split!r}", stacklevel=4)
+        return None
+    from howtotrainyourmamlpytorch_tpu.datastore.packed import (
+        CorruptShardError, PackedSource)
+    t0 = time.perf_counter()
+    try:
+        src = PackedSource(path, expected_image_shape=cfg.image_shape)
+    except CorruptShardError as e:
+        try:
+            os.replace(path, path + ".corrupt")
+            counter_inc("resilience/quarantined")
+        except OSError:
+            pass  # a peer quarantined it first, or the dir is read-only;
+            #       the fallback below proceeds either way
+        warnings.warn(
+            f"packed shard {path} is corrupt "
+            f"({type(e).__name__}: {str(e)[:160]}); quarantined to "
+            f"*.corrupt, falling back to the directory source",
+            stacklevel=3)
+        return None
+    except ValueError as e:
+        warnings.warn(
+            f"packed shard {path} skipped: {e} (not quarantined — the "
+            f"file is intact, the config disagrees with it)",
+            stacklevel=3)
+        return None
+    reg = get_registry()
+    if reg is not None:
+        reg.counter("data/pack_open_seconds").inc(
+            time.perf_counter() - t0)
+        reg.gauge("data/pack_bytes_mapped").set(src.nbytes_mapped)
+    return src
+
+
 def build_source(cfg, split: str):
     """Resolve a split's image source from the config.
 
-    ``sets_are_pre_split=True`` (default): disk layout
-    ``<cfg.dataset_dir>/<split>/<class>/…`` when present — where
-    ``dataset_dir`` is ``dataset_path/dataset_name`` (the reference's
-    contract) or ``dataset_path`` itself if it already holds the split
-    dirs. ``sets_are_pre_split=False``: one flat class pool under
-    ``dataset_dir``, partitioned into class-disjoint splits by
-    ``cfg.train_val_test_split``. Either way ``load_into_memory``,
-    ``labels_as_int`` and ``indexes_of_folders_indicating_class`` shape
-    the disk index (see :class:`DiskImageSource`). Otherwise a synthetic
-    fallback (with a warning unless the dataset name says 'synthetic') so
-    the framework runs end-to-end with no datasets installed.
+    Resolution order:
+
+    1. A packed shard (``<split>.mamlpack`` under ``dataset_pack_path``
+       or next to the split dirs — :func:`pack_shard_path`): O(header)
+       mmap open, zero decode, page cache shared across processes
+       (docs/DATA.md). Corrupt shards are quarantined and fall through.
+    2. ``sets_are_pre_split=True`` (default): disk layout
+       ``<cfg.dataset_dir>/<split>/<class>/…`` when present — where
+       ``dataset_dir`` is ``dataset_path/dataset_name`` (the reference's
+       contract) or ``dataset_path`` itself if it already holds the
+       split dirs. ``sets_are_pre_split=False``: one flat class pool
+       under ``dataset_dir``, partitioned into class-disjoint splits by
+       ``cfg.train_val_test_split``. Either way ``load_into_memory``,
+       ``labels_as_int`` and ``indexes_of_folders_indicating_class``
+       shape the disk index (see :class:`DiskImageSource`).
+    3. A synthetic fallback (with a warning unless the dataset name says
+       'synthetic') so the framework runs end-to-end with no datasets
+       installed.
+
+    Every resolution counts ``data/source_kind/<kind>`` into the
+    process registry (when one is installed) so the telemetry report can
+    answer "what actually fed this run?" after the fact.
     """
     if split not in SPLITS:
         raise ValueError(f"unknown split {split!r}")
+    src = _resolve_source(cfg, split)
+    counter_inc(f"data/source_kind/{source_kind(src)}")
+    return src
+
+
+def _resolve_source(cfg, split: str):
+    packed = _try_packed_source(cfg, split)
+    if packed is not None:
+        return packed
     disk_kwargs = dict(
         preload=cfg.load_into_memory,
         numeric_sort=cfg.labels_as_int,
@@ -275,10 +464,11 @@ def build_source(cfg, split: str):
         warnings.warn(
             f"dataset split directory {root!r} not found; using a "
             f"synthetic source", stacklevel=2)
-    # Enough classes for 20-way sampling and disjoint per split.
+    # Enough classes for 20-way sampling; disjoint per (split, seed) via
+    # SeedSequence entropy words (no arithmetic seed collisions).
     return SyntheticSource(
         num_classes=max(4 * cfg.num_classes_per_set, 40),
         images_per_class=max(
             2 * (cfg.num_samples_per_class + cfg.num_target_samples), 20),
         image_size=cfg.image_shape,
-        seed=1000 * _SPLIT_SEEDS[split] + cfg.seed)
+        seed=(_SPLIT_SEEDS[split], cfg.seed))

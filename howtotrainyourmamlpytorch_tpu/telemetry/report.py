@@ -15,7 +15,8 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, Optional, Union
 
-SCHEMA = "maml_tpu_telemetry_report_v3"  # v2: + "serving"; v3: + "resilience"
+# v2: + "serving"; v3: + "resilience"; v4: + "data" (datastore subsystem)
+SCHEMA = "maml_tpu_telemetry_report_v4"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -32,6 +33,21 @@ def _median(values: List[float]) -> Optional[float]:
     s = sorted(values)
     n = len(s)
     return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _accumulate_counter(totals: Dict[str, float],
+                        prev: Dict[str, float],
+                        key: str, value: float) -> None:
+    """Reset-aware counter accumulation (the Prometheus rate() rule),
+    shared by the resilience and data-plane sections: one log routinely
+    spans several process lifetimes (preempt → restart resets every
+    counter to 0), so last-row-wins would drop the killed segment. A
+    value below its predecessor starts a new segment and contributes
+    whole; otherwise the delta contributes."""
+    p = prev.get(key, 0.0)
+    totals[key] = totals.get(key, 0.0) + (value if value < p
+                                          else value - p)
+    prev[key] = value
 
 
 def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -158,13 +174,51 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         for key in _RES_KEYS.values():
             if m.get(key) is None:
                 continue
-            value = float(m[key])
-            prev = prev_row.get(key, 0.0)
-            totals[key] = totals.get(key, 0.0) + (
-                value if value < prev else value - prev)
-            prev_row[key] = value
+            _accumulate_counter(totals, prev_row, key, float(m[key]))
         resilience_sec = {label: int(totals.get(key, 0))
                           for label, key in _RES_KEYS.items()}
+
+    # Data-plane section (datastore/ subsystem, docs/DATA.md): which
+    # source kind actually fed the run (data/source_kind/<kind> counters
+    # from build_source), the packed-shard open cost and mapped bytes,
+    # and the loader's corrupt-image skip counter. Counters accumulate
+    # with the same reset detection as the resilience section
+    # (_accumulate_counter); pack_bytes_mapped is a gauge — last row
+    # wins. data/corrupt_episodes stays in the resilience section (it
+    # is the episode-level fail-soft counter).
+    _KIND_PREFIX = "data/source_kind/"
+    data_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    d_totals: Dict[str, float] = {}
+    d_prev: Dict[str, float] = {}
+    pack_bytes: Optional[float] = None
+    for e in events:
+        if e.get("event") != "metrics":
+            continue
+        m = e.get("metrics") or {}
+        keys = [k for k in m if k.startswith("data/")
+                and k != "data/corrupt_episodes"
+                and isinstance(m[k], (int, float))]
+        if not keys:
+            continue
+        for key in keys:
+            if key == "data/pack_bytes_mapped":
+                pack_bytes = float(m[key])
+                continue
+            _accumulate_counter(d_totals, d_prev, key, float(m[key]))
+        kinds = sorted(k[len(_KIND_PREFIX):]
+                       for k, tot in d_totals.items()
+                       if k.startswith(_KIND_PREFIX) and tot > 0)
+        data_sec = {
+            "source_kind": ",".join(kinds) if kinds else UNAVAILABLE,
+            "pack_open_seconds": (
+                round(d_totals["data/pack_open_seconds"], 6)
+                if "data/pack_open_seconds" in d_totals else UNAVAILABLE),
+            "pack_bytes_mapped": (int(pack_bytes)
+                                  if pack_bytes is not None
+                                  else UNAVAILABLE),
+            "corrupt_images": int(
+                d_totals.get("data/corrupt_images", 0)),
+        }
 
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
@@ -196,6 +250,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "host_skew": host_skew,
         "serving": serving,
         "resilience": resilience_sec,
+        "data": data_sec,
     }
 
 
@@ -224,6 +279,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("per-host step skew", summary["host_skew"]),
         ("serving", summary["serving"]),
         ("resilience", summary["resilience"]),
+        ("data plane", summary["data"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

@@ -42,6 +42,11 @@ def test_bench_quick_emits_full_capture_contract():
     assert first["compile_seconds"] > 0
     assert first["feed_stall_frac"] == 0.0  # synthetic device-resident
     #                                         batch: no host feed to stall
+    # Data-plane keys (ISSUE 4): the dataset open probe is always
+    # measured and non-null — with no dataset installed the flagship
+    # config resolves to the synthetic fallback.
+    assert first["dataset_open_seconds"] > 0
+    assert first["dataset_source_kind"] == "synthetic"
     # The authoritative LAST line is a strict superset with all three
     # measurement groups.
     for key in ("value", "run_weighted_tasks_per_sec_per_chip",

@@ -20,17 +20,17 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # Every key the CI consumer may rely on (the acceptance list: step-time
 # percentiles, tasks/sec/chip, compile count/seconds, feed-stall
 # fraction, peak memory, per-host skew; v2 adds the serving section,
-# v3 the resilience section).
+# v3 the resilience section, v4 the data-plane section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
-    "live_memory_bytes", "host_skew", "serving", "resilience",
+    "live_memory_bytes", "host_skew", "serving", "resilience", "data",
 }
 
 
 def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
-                         with_resilience=False):
+                         with_resilience=False, with_data=False):
     """A synthetic 2-epoch run's event stream, as the experiment loop
     writes it (train_epoch + telemetry + heartbeat per epoch); with
     ``with_serving``, a trailing serve/ registry-flush row as
@@ -88,6 +88,18 @@ def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
             "resilience/cache_errors": 1.0,
             "data/corrupt_episodes": 2.0,
         })
+    if with_data:
+        # Registry flushes carrying the data-plane keys build_source
+        # records (datastore subsystem); cumulative counters, so the
+        # accumulated view must total across the rows.
+        log.log("metrics", metrics={"data/source_kind/packed": 1.0,
+                                    "data/pack_open_seconds": 0.002,
+                                    "data/pack_bytes_mapped": 4096.0})
+        log.log("metrics", metrics={"data/source_kind/packed": 3.0,
+                                    "data/source_kind/synthetic": 1.0,
+                                    "data/pack_open_seconds": 0.006,
+                                    "data/pack_bytes_mapped": 4096.0,
+                                    "data/corrupt_images": 2.0})
     return log.path
 
 
@@ -108,9 +120,11 @@ def test_summarize_events_fixture(tmp_path):
     assert s["peak_memory_bytes"] == 2001
     assert s["host_skew"]["hosts"] == 4
     assert s["host_skew"]["max_skew_frac"] == pytest.approx(0.1)
-    # No serve/ or resilience/ rows -> the sections say so explicitly.
+    # No serve/, resilience/ or data/ rows -> the sections say so
+    # explicitly.
     assert s["serving"] == UNAVAILABLE
     assert s["resilience"] == UNAVAILABLE
+    assert s["data"] == UNAVAILABLE
     # The table renders every row without raising.
     table = format_table(s)
     assert "feed stall fraction" in table and "0.1" in table
@@ -179,6 +193,26 @@ def test_resilience_counters_survive_process_restarts():
     res = summarize_events(events)["resilience"]
     assert res["rewinds"] == 1     # killed segment's rewind kept
     assert res["io_retries"] == 3  # 1 (segment 1) + 2 (segment 2)
+
+
+def test_summarize_events_data_section(tmp_path):
+    """data/* metric rows (build_source's source-kind counters + pack
+    open telemetry) render the v4 data-plane section; counters total
+    with reset detection, the bytes gauge is last-wins."""
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    path = write_fixture_events(tmp_path / "events.jsonl",
+                                with_data=True)
+    s = summarize_events(read_jsonl(path))
+    assert set(s) == SCHEMA_KEYS
+    data = s["data"]
+    # Kinds seen across the run, comma-joined deterministically.
+    assert data["source_kind"] == "packed,synthetic"
+    assert data["pack_open_seconds"] == pytest.approx(0.006)
+    assert data["pack_bytes_mapped"] == 4096
+    assert data["corrupt_images"] == 2
+    assert "data plane" in format_table(s)
+    # Training metrics untouched by the data rows.
+    assert s["epochs"] == 2 and s["serving"] == UNAVAILABLE
 
 
 def test_summarize_events_failsoft_markers(tmp_path):
@@ -273,6 +307,8 @@ def test_report_on_real_two_epoch_cpu_run(tmp_path):
     # CPU backend has no allocator stats: explicit marker, never fake 0.
     assert s["peak_memory_bytes"] == UNAVAILABLE
     assert s["host_skew"]["hosts"] == 1
+    # v4 data-plane section: build_source counted what fed the run.
+    assert s["data"]["source_kind"] == "synthetic"
     # The Prometheus textfile snapshot landed next to the JSONL stream.
     prom = open(os.path.join(exp_dir, "logs", "metrics.prom")).read()
     assert "# TYPE compile_count counter" in prom
