@@ -280,6 +280,44 @@ class MAMLConfig:
                                            # MAML_FAULTS env var overrides.
                                            # "" = no injection, and every
                                            # hook is one None-check
+    # Watchdog deadlines (resilience/watchdog.py, docs/RESILIENCE.md §
+    # Hangs & forensics): max seconds of silence allowed in each named
+    # progress phase before the watchdog dumps all-thread stacks +
+    # flight.jsonl into a crash bundle and exits EXIT_HUNG (74). All
+    # generous by default (a false trip kills a healthy run; a late trip
+    # only wastes the deadline's worth of pod-hours); 0 disables a
+    # phase, all-zero disables the subsystem entirely (nothing is
+    # installed; every beacon site is one None check).
+    watchdog_step_timeout_s: float = 1800.0
+                                           # train/eval step dispatch +
+                                           # the epoch-boundary bookkeeping
+                                           # between steps
+    watchdog_feed_timeout_s: float = 900.0  # waiting on the next batch
+                                           # from the prefetch worker
+    watchdog_collective_timeout_s: float = 1800.0
+                                           # host-level multihost
+                                           # collectives (a peer that died
+                                           # mid-collective strands these
+                                           # forever)
+    watchdog_compile_timeout_s: float = 7200.0
+                                           # XLA compile boundaries get a
+                                           # separate, much larger budget:
+                                           # cold pod compiles are
+                                           # documented at ~30 min and
+                                           # must not false-trip the step
+                                           # deadline
+    watchdog_serve_timeout_s: float = 600.0
+                                           # one ServingEngine.step() call
+                                           # (an IDLE engine never trips —
+                                           # only in-flight work is
+                                           # deadlined)
+    watchdog_poll_interval_s: float = 0.0  # monitor poll period; 0 = auto
+                                           # (min enabled deadline / 4,
+                                           # clamped to [0.05, 5] s)
+    flight_recorder_events: int = 256      # ring-buffer capacity of the
+                                           # flight recorder dumped as
+                                           # flight.jsonl into crash
+                                           # bundles
 
     # Keys found in a loaded JSON that we accepted-and-ignored (for logging).
     ignored_keys: Tuple[str, ...] = ()
@@ -363,6 +401,15 @@ class MAMLConfig:
                 f"{self.divergence_spike_factor}")
         if self.divergence_max_rewinds < 0:
             raise ValueError("divergence_max_rewinds must be >= 0")
+        for field in ("watchdog_step_timeout_s", "watchdog_feed_timeout_s",
+                      "watchdog_collective_timeout_s",
+                      "watchdog_compile_timeout_s",
+                      "watchdog_serve_timeout_s",
+                      "watchdog_poll_interval_s"):
+            if getattr(self, field) < 0:
+                raise ValueError(f"{field} must be >= 0 (0 = disabled)")
+        if self.flight_recorder_events < 1:
+            raise ValueError("flight_recorder_events must be >= 1")
         if self.fault_spec:
             # Parse-validate now: a typo'd chaos spec that silently
             # injects nothing would "prove" recovery that never ran.

@@ -34,7 +34,7 @@ from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.data.sampler import EpisodeSampler
 from howtotrainyourmamlpytorch_tpu.data.sources import build_source
 from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
-from howtotrainyourmamlpytorch_tpu.resilience import faults
+from howtotrainyourmamlpytorch_tpu.resilience import faults, watchdog
 from howtotrainyourmamlpytorch_tpu.telemetry.instruments import (
     FeedStallMeter)
 
@@ -182,6 +182,13 @@ class MetaLearningDataLoader:
                 for b in range(num_batches):
                     if abandoned.is_set():
                         return
+                    # Chaos hook: a wedged feed (hung mount, dead
+                    # decoder) is simulated by sleeping the WORKER past
+                    # the feed deadline — the consumer blocks in q.get
+                    # with phase 'feed' stamped and the watchdog trips.
+                    if faults.maybe_fire("hang_feed",
+                                         step=start_idx + b):
+                        faults.hang()
                     base = (start_idx + b) * batch_size + salt
                     if self._multihost:
                         batch = assemble_global_batch(
@@ -206,6 +213,11 @@ class MetaLearningDataLoader:
         t.start()
         try:
             while True:
+                # Progress beacon (resilience/watchdog.py): the consumer
+                # is about to block on the input pipeline — a wait past
+                # watchdog_feed_timeout_s means the feed is wedged, not
+                # slow. One None check with no beacon installed.
+                watchdog.stamp("feed", detail=split)
                 t0 = time.perf_counter()
                 item = q.get()
                 if meter is not None:

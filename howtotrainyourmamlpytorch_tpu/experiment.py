@@ -19,6 +19,7 @@ TPU-first notes:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import signal
@@ -46,7 +47,10 @@ from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
     LATEST, CheckpointManager)
 from howtotrainyourmamlpytorch_tpu import resilience
-from howtotrainyourmamlpytorch_tpu.resilience import DivergenceGuard, faults
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    DivergenceGuard, faults, flightrec, watchdog)
+from howtotrainyourmamlpytorch_tpu.resilience.flightrec import (
+    write_crash_bundle)
 from howtotrainyourmamlpytorch_tpu.telemetry import (
     FeedStallMeter, MetricsRegistry, device_memory_stats, emit_heartbeat)
 from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
@@ -151,6 +155,18 @@ class ExperimentBuilder:
         # the stop decision is agreed across processes at sync boundaries.
         self._preempted = False
         self._multihost = jax.process_count() > 1
+        # Watchdog + flight recorder (resilience/watchdog.py): installed
+        # for the duration of run_experiment only (like the compile
+        # listener) when any watchdog_*_timeout_s is > 0; all-zero
+        # installs nothing and every beacon site is one None check.
+        self._watchdog: Optional[watchdog.Watchdog] = None
+        self._beacon: Optional[watchdog.ProgressBeacon] = None
+        self._flightrec = None
+        # Phase keys whose first REAL step call this process has made:
+        # that call pays (or waits out) the XLA compile, so it runs
+        # under the separate, much larger compile deadline.
+        self._stamped_compiles: set = set()
+        self._eval_compile_stamped = False
         # Divergence guard (resilience/guard.py): observes the outer-loss
         # scalar at dispatch-sync points; a trigger rewinds to the
         # last-good epoch checkpoint (_perform_rewind).
@@ -318,8 +334,17 @@ class ExperimentBuilder:
                 # ~one state copy + one concurrent step's activations).
                 donated = (snapshot if i == len(later) - 1
                            else jax.tree.map(jnp.copy, snapshot))
-                out, _ = self.plan.train_steps[key](donated, batch,
-                                                    jnp.float32(self.epoch))
+                # Multi-host warmup is synchronous and blocks the run:
+                # it runs under the compile deadline. The single-process
+                # background thread must NOT stamp — the main loop keeps
+                # progressing (and stamping) while it compiles, and a
+                # background thread re-stamping phases would clobber the
+                # live one.
+                scope = (watchdog.phase("compile", detail=str(key))
+                         if self._multihost else contextlib.nullcontext())
+                with scope:
+                    out, _ = self.plan.train_steps[key](
+                        donated, batch, jnp.float32(self.epoch))
                 jax.block_until_ready(out.params)
                 del out
                 if self.is_main_process:
@@ -344,8 +369,8 @@ class ExperimentBuilder:
         epoch = self.epoch
         iters_left = (cfg.total_iter_per_epoch
                       - self.current_iter % cfg.total_iter_per_epoch)
-        step_fn = self.plan.train_steps[(cfg.use_second_order(epoch),
-                                         cfg.use_msl(epoch))]
+        phase_key = (cfg.use_second_order(epoch), cfg.use_msl(epoch))
+        step_fn = self.plan.train_steps[phase_key]
         # Live in-epoch progress (the reference's tqdm running loss/acc
         # line) rides the dispatch-sync fetches — the loss scalar is being
         # pulled there anyway, so the line costs one extra scalar transfer
@@ -371,8 +396,20 @@ class ExperimentBuilder:
                     jax.block_until_ready(self.state.params)
                     prof.__exit__(None, None, None)
                     prof = None
-                self.state, metrics = step_fn(self.state, batch,
-                                              jnp.float32(epoch))
+                # Progress beacon: "dispatching train step <iter>". The
+                # FIRST call of a phase executable pays (or waits behind
+                # the warmup thread for) its XLA compile, so it runs
+                # under the separate watchdog_compile_timeout_s budget —
+                # a 30-min cold compile must not trip the step deadline.
+                watchdog.stamp("step", detail=self.current_iter)
+                if phase_key not in self._stamped_compiles:
+                    self._stamped_compiles.add(phase_key)
+                    with watchdog.phase("compile", detail=str(phase_key)):
+                        self.state, metrics = step_fn(self.state, batch,
+                                                      jnp.float32(epoch))
+                else:
+                    self.state, metrics = step_fn(self.state, batch,
+                                                  jnp.float32(epoch))
                 metrics_acc.append(metrics)
                 self.current_iter += 1
                 timer.tick()  # dispatch-interval under async execution;
@@ -396,6 +433,11 @@ class ExperimentBuilder:
                     if faults.maybe_fire("nan_loss",
                                          step=self.current_iter):
                         loss_now = float("nan")
+                    if faults.maybe_fire("hang_step",
+                                         step=self.current_iter):
+                        # Simulated wedged step (phase 'step' is the
+                        # current beacon): the watchdog must kill us.
+                        faults.hang()
                     if live:
                         live_samples.append(
                             (loss_now,
@@ -541,11 +583,23 @@ class ExperimentBuilder:
             feed_stall_frac=feed["feed_stall_frac"],
             memory=mem)
         # Straggler visibility: every host contributes its local dispatch
-        # mean; the row carries the per-host vector + skew_frac.
+        # mean; the row carries the per-host vector + skew_frac. With a
+        # beacon installed, the per-host progress age (now − last beacon
+        # stamp) rides the same row — a stalling peer shows on the
+        # dashboard BEFORE its watchdog trips. Every host passes the
+        # same shape (beacon presence is config-determined), so the
+        # underlying gathers stay collective-safe.
+        beacon = self._beacon
+        progress_age = beacon.age() if beacon is not None else None
+        if progress_age is not None:
+            reg.gauge(watchdog.PROGRESS_AGE_GAUGE).set(progress_age)
         emit_heartbeat(self.jsonl, epoch=epoch,
                        iteration=self.current_iter,
                        local_mean_step_seconds=tsum.get(
-                           "mean_step_seconds", 0.0))
+                           "mean_step_seconds", 0.0),
+                       progress_age_seconds=progress_age,
+                       progress_phase=(beacon.current()[0]
+                                       if beacon is not None else None))
 
     def _eval_batches(self, split: str) -> Iterable:
         """The split's fixed evaluation batches, device-cached after the
@@ -566,7 +620,16 @@ class ExperimentBuilder:
         n_left = self.cfg.num_evaluation_tasks
         losses, accs, logits = [], [], []
         for batch in batches:
-            res = self.plan.eval_step(state, batch)
+            # Eval dispatches stamp 'step' too — a validation sweep or
+            # the test protocol can hang exactly like training, and the
+            # first eval call pays its own compile.
+            watchdog.stamp("step", detail="eval")
+            if not self._eval_compile_stamped:
+                self._eval_compile_stamped = True
+                with watchdog.phase("compile", detail="eval"):
+                    res = self.plan.eval_step(state, batch)
+            else:
+                res = self.plan.eval_step(state, batch)
             res = jax.device_get(res)
             take = min(n_left, len(res.loss))
             losses.append(res.loss[:take])
@@ -584,6 +647,13 @@ class ExperimentBuilder:
         return out
 
     # ------------------------------------------------------------------
+    def _bundle_dir(self) -> str:
+        """Crash-bundle directory (docs/RESILIENCE.md § Hangs &
+        forensics); per-process on a pod so hosts don't clobber each
+        other's forensics on the shared filesystem."""
+        suffix = f"_p{jax.process_index()}" if self._multihost else ""
+        return os.path.join(self.paths["logs"], f"crash_bundle{suffix}")
+
     def run_experiment(self) -> Dict[str, Any]:
         # The compile listener counts EVERY in-process XLA compile while
         # the run is live — expected ones (phase executables) and
@@ -592,9 +662,64 @@ class ExperimentBuilder:
         # so a builder that is never run cannot leak the process-wide
         # listener.
         self._compile_watch = instrument_compiles(self.registry)
+        # Watchdog + flight recorder share the listener's lifecycle: live
+        # only while the run is, process-wide installs restored on exit.
+        cfg = self.cfg
+        deadlines = watchdog.deadlines_from_config(cfg)
+        wd_enabled = any(v > 0 for v in deadlines.values())
+        prev_recorder = prev_beacon = None
+        if wd_enabled:
+            self._flightrec = flightrec.FlightRecorder(
+                cfg.flight_recorder_events)
+            prev_recorder = flightrec.install(self._flightrec)
+            self._beacon = watchdog.ProgressBeacon()
+            prev_beacon = watchdog.install_beacon(self._beacon)
+            self._beacon.stamp("step", detail=self.current_iter)
+            self._watchdog = watchdog.Watchdog(
+                self._beacon, deadlines,
+                bundle_dir=self._bundle_dir(),
+                registry=self.registry, jsonl=self.jsonl,
+                prom_path=f"{self.paths['logs']}/metrics.prom",
+                poll_interval_s=cfg.watchdog_poll_interval_s,
+                process_index=jax.process_index()).start()
+            # Eager registration: every per-epoch metrics row (and the
+            # report's watchdog section) must show "0 trips", not omit
+            # the counter.
+            self.registry.counter(watchdog.TRIPS_COUNTER)
         try:
-            return self._run_experiment()
+            result = self._run_experiment()
+            if (self._flightrec is not None and isinstance(result, dict)
+                    and "preempted_at_iter" in result):
+                # The SIGTERM/SIGINT path also dumps the flight ring: a
+                # preemption post-mortem ("what was it doing when the
+                # scheduler pulled the node?") deserves the same last-
+                # seconds context a crash gets.
+                write_crash_bundle(
+                    self._bundle_dir(), reason="preempted",
+                    info={"iter": self.current_iter},
+                    registry=self.registry)
+            return result
+        except BaseException as e:
+            # Unhandled exception: the third flight-dump trigger. Not
+            # for SystemExit (an orderly exit carries no mystery).
+            if (self._flightrec is not None
+                    and not isinstance(e, SystemExit)):
+                write_crash_bundle(
+                    self._bundle_dir(),
+                    reason=f"exception:{type(e).__name__}",
+                    info={"error": str(e)[:500],
+                          "iter": self.current_iter},
+                    registry=self.registry)
+            raise
         finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
+            if wd_enabled:
+                watchdog.install_beacon(prev_beacon)
+                flightrec.install(prev_recorder)
+                self._beacon = None
+                self._flightrec = None
             # Detach the process-wide compile listener (a sweep driver
             # may build many ExperimentBuilders; each should count only
             # its own compiles).
@@ -624,9 +749,13 @@ class ExperimentBuilder:
         # and exit the loop cleanly; resume with
         # continue_from_epoch='latest' loses zero iterations, and the CLI
         # exits with the distinct EXIT_PREEMPTED code (resilience/) so a
-        # scheduler resubmits instead of marking failure.
+        # scheduler resubmits instead of marking failure. A SECOND
+        # signal while the first is still draining the in-flight step
+        # escalates (_handle_signal): the graceful path assumes the step
+        # finishes, and a hung step would otherwise make the run
+        # un-interruptible exactly when the operator is mashing Ctrl-C.
         prev_handlers = []
-        handler = lambda *_: setattr(self, "_preempted", True)  # noqa: E731
+        handler = self._handle_signal
         for sig in (signal.SIGTERM, signal.SIGINT):
             try:
                 prev_handlers.append((sig, signal.signal(sig, handler)))
@@ -666,6 +795,39 @@ class ExperimentBuilder:
             # resubmits instead of marking success.
             return {"preempted_at_iter": self.current_iter}
         return {"paused_at_iter": self.current_iter}
+
+    def _handle_signal(self, signum=None, frame=None) -> None:
+        """SIGTERM/SIGINT handler. First signal: request the graceful
+        drain (finish the in-flight step, snapshot 'latest', exit 75).
+        Second signal while still draining: the drain itself is stuck —
+        dump forensics and die NOW with the same preemption code, so a
+        scheduler still resubmits and an operator's second Ctrl-C always
+        works."""
+        if self._preempted:
+            self._escalate_signal(signum)
+            return
+        self._preempted = True
+
+    def _escalate_signal(self, signum=None) -> None:
+        """Immediate-exit half of the double-signal contract: flight
+        ring + all-thread stacks into the crash bundle, then
+        ``os._exit(EXIT_PREEMPTED)`` — no unwinding, the ordinary drain
+        already proved it cannot complete."""
+        try:
+            # Reentrancy note: this runs in a signal handler ON the main
+            # thread, possibly interrupting a beacon stamp or registry
+            # flush mid-critical-section — the recorder/registry locks
+            # are RLocks precisely so these calls cannot self-deadlock,
+            # and any other failure here must still reach the exit.
+            flightrec.record("signal_escalation", signum=signum,
+                             iter=self.current_iter)
+            write_crash_bundle(
+                self._bundle_dir(), reason="signal_escalation",
+                info={"signum": signum, "iter": self.current_iter},
+                registry=self.registry)
+        except Exception:
+            pass
+        os._exit(resilience.EXIT_PREEMPTED)
 
     def _perform_rewind(self) -> None:
         """Recover from a diverged outer loss: reload the newest readable

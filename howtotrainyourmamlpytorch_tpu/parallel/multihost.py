@@ -17,6 +17,12 @@ This module is the upgrade that makes the (dcn, tasks) mesh span hosts:
     streams (data/sampler.py) make this coordination-free: position ``i`` of
     outer-batch ``b`` is episode index ``b·B + i`` on every host, so hosts
     agree on the global batch without exchanging a byte.
+
+Every host-level collective here runs inside a ``collective`` watchdog
+phase (:func:`_collective`): a peer that dies mid-collective strands the
+survivors forever with no exception — exactly the silent hang the
+watchdog's ``watchdog_collective_timeout_s`` deadline exists to kill
+(docs/RESILIENCE.md § Hangs & forensics).
 """
 
 from __future__ import annotations
@@ -29,11 +35,31 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+from howtotrainyourmamlpytorch_tpu.resilience import faults, watchdog
 
 _ENV_COORD = "JAX_COORDINATOR_ADDRESS"
 _ENV_NPROC = "JAX_NUM_PROCESSES"
 _ENV_PID = "JAX_PROCESS_ID"
 _ENV_AUTO = "JAX_AUTO_DISTRIBUTED"
+
+
+def _collective(name: str):
+    """Watchdog + chaos scope every host-level collective enters.
+
+    Stamps the ``collective`` phase (restoring the caller's phase with a
+    fresh timestamp on exit) so a collective stranded by a dead peer
+    trips ``watchdog_collective_timeout_s`` instead of whatever phase
+    the caller happened to be in — and gives the flight recorder the
+    collective's name. The ``hang_collective`` chaos hook (call-counted:
+    ``hang_collective@N`` sleeps the Nth collective) fires INSIDE the
+    scope and before the single-process early-returns, so a stuck
+    collective is simulable without a pod. One None check each when no
+    beacon/plan is installed.
+    """
+    if faults.maybe_fire("hang_collective"):
+        with watchdog.phase("collective", detail=name):
+            faults.hang()
+    return watchdog.phase("collective", detail=name)
 
 
 def _already_initialized() -> bool:
@@ -93,13 +119,13 @@ def any_process_true(flag: bool) -> bool:
     if hosts broke out of the train loop at different iterations, the
     stragglers' collectives would wait forever for departed partners.
     """
-    if jax.process_count() <= 1:
-        return bool(flag)
-    import numpy as np
-    from jax.experimental import multihost_utils
-    flags = multihost_utils.process_allgather(
-        np.asarray([bool(flag)], dtype=np.bool_))
-    return bool(np.any(flags))
+    with _collective("any_process_true"):
+        if jax.process_count() <= 1:
+            return bool(flag)
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray([bool(flag)], dtype=np.bool_))
+        return bool(np.any(flags))
 
 
 def any_process_true_each(flags: Sequence[bool]) -> List[bool]:
@@ -110,13 +136,14 @@ def any_process_true_each(flags: Sequence[bool]) -> List[bool]:
     host-level allreduce latency paid every ``dispatch_sync_every``
     iterations for decisions that virtually never fire.
     """
-    if jax.process_count() <= 1:
-        return [bool(f) for f in flags]
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(
-        np.asarray(list(flags), dtype=np.bool_))
-    return [bool(v) for v in np.any(
-        np.asarray(gathered).reshape(-1, len(flags)), axis=0)]
+    with _collective("any_process_true_each"):
+        if jax.process_count() <= 1:
+            return [bool(f) for f in flags]
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            np.asarray(list(flags), dtype=np.bool_))
+        return [bool(v) for v in np.any(
+            np.asarray(gathered).reshape(-1, len(flags)), axis=0)]
 
 
 def abort_all_if_any(err, peer_msg: str) -> None:
@@ -141,11 +168,12 @@ def agree_int_from_main(value: int) -> int:
     hosts entering the train loop at different iterations deadlock in
     their first mismatched collective).
     """
-    if jax.process_count() <= 1:
-        return int(value)
-    from jax.experimental import multihost_utils
-    return int(multihost_utils.broadcast_one_to_all(
-        np.asarray([int(value)]))[0])
+    with _collective("agree_int_from_main"):
+        if jax.process_count() <= 1:
+            return int(value)
+        from jax.experimental import multihost_utils
+        return int(multihost_utils.broadcast_one_to_all(
+            np.asarray([int(value)]))[0])
 
 
 def gather_host_floats(value: float) -> List[float]:
@@ -157,12 +185,13 @@ def gather_host_floats(value: float) -> List[float]:
     identical row. A collective — every process must call it at the same
     program point, like :func:`any_process_true`.
     """
-    if jax.process_count() <= 1:
-        return [float(value)]
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(
-        np.asarray([float(value)], dtype=np.float64))
-    return [float(v) for v in np.asarray(gathered).reshape(-1)]
+    with _collective("gather_host_floats"):
+        if jax.process_count() <= 1:
+            return [float(value)]
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            np.asarray([float(value)], dtype=np.float64))
+        return [float(v) for v in np.asarray(gathered).reshape(-1)]
 
 
 def barrier(tag: str) -> None:
@@ -171,9 +200,10 @@ def barrier(tag: str) -> None:
     Used to order shared-filesystem effects: process 0 writes (checkpoint,
     dataset extraction), everyone barriers, then all processes read.
     """
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(tag)
+    with _collective(f"barrier:{tag}"):
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(tag)
 
 
 def local_batch_positions(sharding: NamedSharding,

@@ -16,6 +16,12 @@ holds the pieces the rest of the codebase composes:
 * :mod:`~.guard` — host-side NaN/Inf + loss-spike detection on the outer
   loss; the experiment loop rewinds to the last-good checkpoint when it
   fires (``ExperimentBuilder._perform_rewind``).
+* :mod:`~.watchdog` — progress beacons + per-phase deadlines; a hang
+  (stuck collective, wedged feed, never-returning compile) dumps
+  all-thread stacks and the flight ring, then exits ``EXIT_HUNG``.
+* :mod:`~.flightrec` — the lock-protected in-memory event ring dumped as
+  ``flight.jsonl`` into every crash bundle (watchdog trip, preemption,
+  unhandled exception).
 
 Metrics: everything here counts into ONE process-wide registry reference
 (`set_registry`), installed by the component that owns telemetry for the
@@ -33,6 +39,11 @@ from typing import Any, Optional
 # schedulers/wrappers can distinguish a clean preemption (resubmit with
 # continue_from_epoch='latest') from success (0) and real failure (1).
 EXIT_PREEMPTED = 75
+# Exit code for "hung past a watchdog deadline; forensics dumped,
+# resubmit me" — EX_IOERR's slot, distinct from EXIT_PREEMPTED so a
+# scheduler/dashboard can tell a clean preemption from a hang kill
+# (docs/RESILIENCE.md § Hangs & forensics).
+EXIT_HUNG = 74
 
 _registry: Optional[Any] = None  # duck-typed telemetry.MetricsRegistry
 
@@ -68,9 +79,18 @@ from howtotrainyourmamlpytorch_tpu.resilience.retry import (  # noqa: E402
     backoff_delay,
     retry_io,
 )
+from howtotrainyourmamlpytorch_tpu.resilience.flightrec import (  # noqa: E402
+    FlightRecorder,
+    write_crash_bundle,
+)
+from howtotrainyourmamlpytorch_tpu.resilience.watchdog import (  # noqa: E402
+    ProgressBeacon,
+    Watchdog,
+)
 
 __all__ = [
-    "EXIT_PREEMPTED", "DivergenceGuard", "FaultPlan", "FaultSpec",
+    "EXIT_HUNG", "EXIT_PREEMPTED", "DivergenceGuard", "FaultPlan",
+    "FaultSpec", "FlightRecorder", "ProgressBeacon", "Watchdog",
     "backoff_delay", "counter_inc", "get_registry", "retry_io",
-    "set_registry",
+    "set_registry", "write_crash_bundle",
 ]

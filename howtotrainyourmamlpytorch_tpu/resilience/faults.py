@@ -29,6 +29,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 ENV_VAR = "MAML_FAULTS"
@@ -40,7 +41,36 @@ KINDS = (
     "nan_loss",         # outer loss read as NaN at a train iteration
     "kill",             # SIGTERM raised at a train iteration
     "episode_corrupt",  # episode sampling raises at an episode index
+    "hang_feed",        # the prefetch worker sleeps past the feed
+                        # deadline at a train iteration (loader)
+    "hang_collective",  # a multihost collective sleeps past the
+                        # collective deadline (call-counted)
+    "hang_step",        # the train loop sleeps at a dispatch-sync point
+                        # at a train iteration
 )
+
+# How long a hang_* fault sleeps (seconds). Long enough to overrun any
+# sane watchdog deadline — the watchdog's os._exit is what ends it —
+# but bounded, so a hang injected with the watchdog disabled eventually
+# releases the process to the outer `timeout` wrapper instead of
+# wedging it forever. Overridable for tests.
+HANG_SECONDS_ENV = "MAML_HANG_SECONDS"
+DEFAULT_HANG_SECONDS = 3600.0
+
+
+def hang(seconds: Optional[float] = None) -> None:
+    """Deterministic sleep used by the ``hang_*`` fault kinds: blocks the
+    calling thread in small increments (so signal delivery on the main
+    thread stays live) for ``seconds`` (default: env override or 1h)."""
+    if seconds is None:
+        try:
+            seconds = float(os.environ.get(HANG_SECONDS_ENV,
+                                           DEFAULT_HANG_SECONDS))
+        except ValueError:
+            seconds = DEFAULT_HANG_SECONDS
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        time.sleep(min(0.2, max(deadline - time.monotonic(), 0.0)))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,7 +142,11 @@ class FaultPlan:
                 self.fired.append((kind, int(step)))
         if hit:
             from howtotrainyourmamlpytorch_tpu import resilience
+            from howtotrainyourmamlpytorch_tpu.resilience import flightrec
             resilience.counter_inc("resilience/faults_injected")
+            # Injections are exactly the context a post-mortem needs:
+            # the flight ring records each firing (no-op uninstalled).
+            flightrec.record("fault", fault=kind, step=int(step))
         return hit
 
 

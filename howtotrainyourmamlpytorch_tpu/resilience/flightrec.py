@@ -1,0 +1,155 @@
+"""Flight recorder: a bounded in-memory ring of recent run events.
+
+The costliest failure on a multi-host pod is the *silent* one — a hang
+the watchdog (resilience/watchdog.py) eventually kills, a SIGKILL from
+the scheduler, an unhandled exception deep in a collective. Post-mortem,
+the question is always the same: *what was the run doing in its last
+seconds?* The flight recorder answers it: every phase transition, step
+index, collective name, serve batch and fault injection appends one
+small dict to a lock-protected ring buffer (``collections.deque`` with
+``maxlen``), which costs nothing until a fault — no IO, no growth, just
+an O(1) append per event. On a watchdog trip, on the SIGTERM/SIGINT
+preemption path, and on an unhandled exception the ring is dumped as
+``flight.jsonl`` into the crash bundle alongside the all-thread stack
+dump, giving every post-mortem the last-N-events context.
+
+Like the resilience metrics registry, the recorder is installed
+process-wide (:func:`install`); :func:`record` is a single module-global
+``None`` check when nothing is installed, so library use without
+forensics stays free.
+"""
+
+from __future__ import annotations
+
+import collections
+import faulthandler
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_CAPACITY = 256
+
+# Bundle file names (docs/RESILIENCE.md § Hangs & forensics).
+STACKS_FILE = "stacks.txt"
+FLIGHT_FILE = "flight.jsonl"
+CRASH_FILE = "crash.json"
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of event dicts, oldest-first.
+
+    Each event carries ``t`` (monotonic seconds — orderable against the
+    watchdog's beacon stamps), ``ts`` (unix seconds — correlatable with
+    events.jsonl) and ``kind``; everything else is caller payload.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._ring: "collections.deque" = collections.deque(
+            maxlen=self.capacity)
+        # RLock, not Lock: the signal-escalation path records/dumps from
+        # a handler that runs ON the main thread and may interrupt the
+        # main thread INSIDE record() — a plain lock would deadlock the
+        # very path that exists to make a stuck process interruptible.
+        self._lock = threading.RLock()
+
+    def record(self, kind: str, **fields: Any) -> None:
+        event = {"t": time.monotonic(), "ts": time.time(),
+                 "kind": kind, **fields}
+        with self._lock:
+            self._ring.append(event)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def events(self) -> List[Dict[str, Any]]:
+        """Snapshot, oldest-first (append order; the deque drops from the
+        left when full, so order is always chronological)."""
+        with self._lock:
+            return list(self._ring)
+
+    def dump_jsonl(self, path: str) -> int:
+        """Write the ring as JSONL, oldest-first; returns rows written.
+        Non-finite floats are the caller's problem upstream — events are
+        built from host timestamps and small ints/strings here."""
+        events = self.events()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            for event in events:
+                f.write(json.dumps(event, default=str) + "\n")
+        return len(events)
+
+
+_recorder: Optional[FlightRecorder] = None
+
+
+def install(recorder: Optional[FlightRecorder]) -> Optional[FlightRecorder]:
+    """Install the process-wide recorder; returns the previous one
+    (scoped lifetimes restore it — the resilience registry pattern)."""
+    global _recorder
+    prev = _recorder
+    _recorder = recorder
+    return prev
+
+
+def get() -> Optional[FlightRecorder]:
+    return _recorder
+
+
+def record(kind: str, **fields: Any) -> None:
+    """Record into the installed recorder; one ``None`` check without."""
+    rec = _recorder
+    if rec is not None:
+        rec.record(kind, **fields)
+
+
+def write_crash_bundle(bundle_dir: str, *, reason: str,
+                       info: Optional[Dict[str, Any]] = None,
+                       recorder: Optional[FlightRecorder] = None,
+                       registry: Optional[Any] = None) -> str:
+    """Write a crash bundle: all-thread stacks + flight ring + context.
+
+    Layout (docs/RESILIENCE.md):
+
+    * ``stacks.txt`` — ``faulthandler.dump_traceback(all_threads=True)``,
+      the "where was every thread" answer for a hang;
+    * ``flight.jsonl`` — the flight recorder ring, oldest-first (absent
+      when no recorder is installed);
+    * ``crash.json`` — reason, timestamps, the tripped phase/deadline
+      info and a final registry snapshot.
+
+    Every write is best-effort (the process is dying; a second failure
+    here must not mask the first) and goes DIRECTLY to the filesystem —
+    no retry layer: backoff on a crash path only delays the forensics
+    the restart needs.
+    """
+    os.makedirs(bundle_dir, exist_ok=True)
+    try:
+        with open(os.path.join(bundle_dir, STACKS_FILE), "w") as f:
+            faulthandler.dump_traceback(file=f, all_threads=True)
+    except Exception:
+        pass
+    rec = recorder if recorder is not None else _recorder
+    if rec is not None:
+        try:
+            rec.dump_jsonl(os.path.join(bundle_dir, FLIGHT_FILE))
+        except Exception:
+            pass
+    crash: Dict[str, Any] = {"reason": reason, "ts": time.time(),
+                             "pid": os.getpid(), **(info or {})}
+    if registry is not None:
+        try:
+            crash["metrics"] = registry.snapshot()
+        except Exception:
+            pass
+    try:
+        with open(os.path.join(bundle_dir, CRASH_FILE), "w") as f:
+            json.dump(crash, f, indent=2, default=str)
+    except Exception:
+        pass
+    return bundle_dir

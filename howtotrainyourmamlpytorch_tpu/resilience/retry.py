@@ -11,7 +11,10 @@ decorate their read/write primitives with :func:`retry_io`:
 * ``FileNotFoundError`` gives up immediately by default — a missing file
   is control flow (fallback/fresh-run detection), not a transient fault;
 * every retry counts ``resilience/io_retries`` and every exhaustion
-  counts ``resilience/io_giveups`` in the installed telemetry registry.
+  counts ``resilience/io_giveups`` in the installed telemetry registry;
+* invalid env knob values (non-numeric, negative) warn once and fall
+  back to the defaults — the retry layer must not itself crash a job
+  over a typo'd tuning variable.
 
 The delay math lives in :func:`backoff_delay`, a pure function pinned by
 tier-1 tests. Retries are NOT applied to append-style writes
@@ -22,6 +25,7 @@ row — only idempotent whole-file operations go through this layer.
 from __future__ import annotations
 
 import functools
+import math
 import os
 import random
 import time
@@ -31,9 +35,40 @@ from typing import Callable, Tuple, Type
 
 from howtotrainyourmamlpytorch_tpu import resilience
 
-DEFAULT_RETRIES = int(os.environ.get("MAML_IO_RETRIES", "3"))
-DEFAULT_BASE_S = float(os.environ.get("MAML_IO_RETRY_BASE_S", "0.02"))
-DEFAULT_CAP_S = float(os.environ.get("MAML_IO_RETRY_CAP_S", "2.0"))
+_warned_env = set()
+
+
+def _env_number(name: str, default, cast, minimum=0):
+    """Parse a numeric env knob, falling back to ``default`` (with ONE
+    warning per knob per process) on invalid values — non-numeric or
+    below ``minimum``. A typo'd ``MAML_IO_RETRIES=three`` in a job
+    template must degrade retry tuning, not crash every import of this
+    module (the resilience layer cannot itself be the brittle part)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        value = cast(raw)
+        if not math.isfinite(value) or value < minimum:
+            raise ValueError("non-finite or below minimum")
+    except (TypeError, ValueError):
+        if name not in _warned_env:
+            _warned_env.add(name)
+            warnings.warn(
+                f"invalid {name}={raw!r} (need a {cast.__name__} "
+                f">= {minimum}); using the default {default}",
+                stacklevel=2)
+        return default
+    return value
+
+
+DEFAULT_RETRIES = _env_number("MAML_IO_RETRIES", 3, int)
+# Zero delays are invalid too (backoff_delay rejects base/cap <= 0):
+# the fallback must land on values every later call can actually use.
+DEFAULT_BASE_S = _env_number("MAML_IO_RETRY_BASE_S", 0.02, float,
+                             minimum=1e-6)
+DEFAULT_CAP_S = _env_number("MAML_IO_RETRY_CAP_S", 2.0, float,
+                            minimum=1e-6)
 DEFAULT_FACTOR = 2.0
 DEFAULT_JITTER_FRAC = 0.5
 

@@ -1,0 +1,278 @@
+"""Watchdog: hang detection via progress beacons and per-phase deadlines.
+
+PR 3's resilience subsystem recovers from failures that *announce*
+themselves; this module handles the ones that don't — a collective stuck
+because one peer died, a wedged data feed, a compile that never returns.
+Every long-running layer stamps a named phase on a process-wide
+:class:`ProgressBeacon` (``step``, ``feed``, ``collective``, ``compile``,
+``serve_request`` — host-side Python only, never inside a compiled
+executable), and a daemon :class:`Watchdog` thread checks the age of the
+*current* phase against that phase's deadline from config
+(``watchdog_step_timeout_s`` & friends; ``0`` disables a phase; compile
+phases get a separate, much larger budget so first-step compiles don't
+false-trip). On a missed deadline the watchdog dumps all-thread stacks
+and the flight-recorder ring into a crash bundle
+(:func:`~.flightrec.write_crash_bundle`), flushes the telemetry
+registry, and exits ``resilience.EXIT_HUNG`` (74) so a scheduler
+resubmits into the resume path instead of burning pod-hours waiting.
+
+Disabled (every ``watchdog_*_timeout_s`` = 0) the subsystem installs
+nothing: every stamp site is a single module-global ``None`` check —
+the PR 3 zero-cost discipline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from howtotrainyourmamlpytorch_tpu.resilience import flightrec
+
+# Phase name -> MAMLConfig timeout field. Phases NOT in this map (e.g.
+# the "idle"/"init" bookkeeping phases) never trip — an idle serving
+# engine or a run between watchdog scopes must not be killed for making
+# no progress it was never asked to make.
+PHASE_TIMEOUT_FIELDS = {
+    "step": "watchdog_step_timeout_s",
+    "feed": "watchdog_feed_timeout_s",
+    "collective": "watchdog_collective_timeout_s",
+    "compile": "watchdog_compile_timeout_s",
+    "serve_request": "watchdog_serve_timeout_s",
+}
+
+TRIPS_COUNTER = "watchdog/trips"
+PROGRESS_AGE_GAUGE = "watchdog/progress_age_seconds"
+TRIP_EVENT = "watchdog_trip"
+
+
+def deadlines_from_config(cfg: Any) -> Dict[str, float]:
+    """The per-phase deadline map the watchdog enforces."""
+    return {phase: float(getattr(cfg, field))
+            for phase, field in PHASE_TIMEOUT_FIELDS.items()}
+
+
+def watchdog_enabled(cfg: Any) -> bool:
+    return any(v > 0 for v in deadlines_from_config(cfg).values())
+
+
+class ProgressBeacon:
+    """Named-phase progress stamps with monotonic timestamps.
+
+    One beacon per process (installed via :func:`install_beacon`); any
+    thread may :meth:`stamp`. The watchdog reads only the CURRENT phase:
+    a stamp is the claim "I am now doing <phase> and just made
+    progress", so a phase whose stamp grows old without a new stamp is,
+    by construction, stuck in that phase. Every stamp also appends a
+    ``phase`` event to the flight recorder — that stream IS the ring's
+    phase-transition/step-index record.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._phase = "init"
+        self._detail: Any = None
+        self._stamp = time.monotonic()
+
+    def stamp(self, phase: str, detail: Any = None) -> None:
+        with self._lock:
+            self._phase = phase
+            self._detail = detail
+            self._stamp = time.monotonic()
+        flightrec.record("phase", phase=phase, detail=detail)
+
+    def current(self) -> Tuple[str, float, Any]:
+        """(phase, monotonic stamp, detail) — one consistent read."""
+        with self._lock:
+            return self._phase, self._stamp, self._detail
+
+    def age(self, now: Optional[float] = None) -> float:
+        """Seconds since the last stamp (any phase) — the liveness
+        number the telemetry heartbeat exports per host."""
+        _, stamp, _ = self.current()
+        return (time.monotonic() if now is None else now) - stamp
+
+    @contextlib.contextmanager
+    def phase(self, name: str, detail: Any = None):
+        """Scoped phase: stamp ``name`` now, re-stamp the previous phase
+        (with a FRESH timestamp — completing the scoped work IS
+        progress) on exit. Used around collectives and known compile
+        boundaries so their larger budgets apply exactly while they
+        run."""
+        prev_phase, _, prev_detail = self.current()
+        self.stamp(name, detail)
+        try:
+            yield
+        finally:
+            self.stamp(prev_phase, prev_detail)
+
+
+_beacon: Optional[ProgressBeacon] = None
+
+
+def install_beacon(beacon: Optional[ProgressBeacon]
+                   ) -> Optional[ProgressBeacon]:
+    """Install the process-wide beacon; returns the previous one."""
+    global _beacon
+    prev = _beacon
+    _beacon = beacon
+    return prev
+
+
+def get_beacon() -> Optional[ProgressBeacon]:
+    return _beacon
+
+
+def stamp(phase: str, detail: Any = None) -> None:
+    """Stamp the installed beacon; one ``None`` check when disabled."""
+    b = _beacon
+    if b is not None:
+        b.stamp(phase, detail)
+
+
+@contextlib.contextmanager
+def phase(name: str, detail: Any = None):
+    """Scoped-phase helper against the installed beacon (no-op scope
+    when no beacon is installed)."""
+    b = _beacon
+    if b is None:
+        yield
+        return
+    with b.phase(name, detail):
+        yield
+
+
+class Watchdog:
+    """Daemon monitor thread enforcing per-phase progress deadlines.
+
+    The deadline check (:meth:`check`) is a pure function of the
+    beacon's current (phase, stamp) and the deadline map, unit-testable
+    without a thread or a clock; :meth:`trip` performs the forensic
+    dump. The default trip action exits the PROCESS with
+    ``resilience.EXIT_HUNG`` via ``os._exit`` — a hung run cannot be
+    trusted to unwind (the main thread is, by definition, stuck), so no
+    cleanup code runs and the scheduler's resubmit lands in the PR 3
+    resume path. Tests inject ``on_trip`` to observe a trip without
+    dying.
+    """
+
+    def __init__(self, beacon: ProgressBeacon,
+                 deadlines: Dict[str, float], *,
+                 bundle_dir: str,
+                 registry: Optional[Any] = None,
+                 jsonl: Optional[Any] = None,
+                 prom_path: Optional[str] = None,
+                 poll_interval_s: float = 0.0,
+                 on_trip: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 process_index: int = 0):
+        self.beacon = beacon
+        self.deadlines = {k: float(v) for k, v in deadlines.items()}
+        self.bundle_dir = bundle_dir
+        self.registry = registry
+        self.jsonl = jsonl
+        self.prom_path = prom_path
+        self.on_trip = on_trip
+        self.process_index = int(process_index)
+        enabled = [v for v in self.deadlines.values() if v > 0]
+        self.enabled = bool(enabled)
+        # Auto poll: fast enough to detect the tightest deadline with
+        # ~25% overshoot, clamped so a 2s chaos deadline doesn't spin
+        # the host and a 2h compile budget still gets sub-5s response
+        # to the OTHER phases' deadlines.
+        if poll_interval_s > 0:
+            self.poll_interval_s = float(poll_interval_s)
+        else:
+            self.poll_interval_s = (min(min(enabled) / 4.0, 5.0)
+                                    if enabled else 5.0)
+            self.poll_interval_s = max(self.poll_interval_s, 0.05)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.tripped: Optional[Dict[str, Any]] = None
+
+    # -- deadline math (pure; tier-1 pinned) ------------------------------
+    def deadline_for(self, phase_name: str) -> float:
+        """Seconds of allowed silence in ``phase_name``; 0 = no deadline
+        (disabled phase or a bookkeeping phase like 'idle')."""
+        return self.deadlines.get(phase_name, 0.0)
+
+    def check(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """Trip info if the current phase overran its deadline, else
+        None. ``now`` is a monotonic instant (tests pass synthetic
+        ones)."""
+        if not self.enabled:
+            return None
+        phase_name, stamp, detail = self.beacon.current()
+        budget = self.deadline_for(phase_name)
+        if budget <= 0:
+            return None
+        age = (time.monotonic() if now is None else now) - stamp
+        if age <= budget:
+            return None
+        return {"phase": phase_name, "detail": detail,
+                "age_seconds": age, "deadline_seconds": budget,
+                "process_index": self.process_index}
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Watchdog":
+        if self.enabled and self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="watchdog")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            info = self.check()
+            if info is not None:
+                self.trip(info)
+                return
+
+    # -- trip path --------------------------------------------------------
+    def trip(self, info: Dict[str, Any]) -> None:
+        """Forensics, then die: count the trip, write the crash bundle
+        (stacks + flight ring + context), flush the telemetry registry
+        so the final counters survive, and exit ``EXIT_HUNG``. Every
+        step is best-effort — a failure mid-dump must not prevent the
+        exit that frees the pod."""
+        from howtotrainyourmamlpytorch_tpu import resilience
+        self.tripped = info
+        flightrec.record("watchdog_trip", **info)
+        if self.registry is not None:
+            try:
+                self.registry.counter(TRIPS_COUNTER).inc()
+                self.registry.gauge(PROGRESS_AGE_GAUGE).set(
+                    info["age_seconds"])
+            except Exception:
+                pass
+        try:
+            flightrec.write_crash_bundle(
+                self.bundle_dir, reason=f"hung_{info['phase']}",
+                info=info, registry=self.registry)
+        except Exception:
+            pass
+        if self.jsonl is not None:
+            try:
+                self.jsonl.log(TRIP_EVENT, **info,
+                               bundle_dir=self.bundle_dir)
+                if self.registry is not None:
+                    self.registry.flush_jsonl(self.jsonl,
+                                              phase=TRIP_EVENT)
+            except Exception:
+                pass
+        if self.prom_path and self.registry is not None:
+            try:
+                self.registry.write_prometheus(self.prom_path)
+            except Exception:
+                pass
+        if self.on_trip is not None:
+            self.on_trip(info)
+            return
+        os._exit(resilience.EXIT_HUNG)

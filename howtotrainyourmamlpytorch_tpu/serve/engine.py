@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from howtotrainyourmamlpytorch_tpu import resilience
+from howtotrainyourmamlpytorch_tpu.resilience import flightrec, watchdog
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.meta.outer import (
     MetaTrainState, init_train_state, migrate_lslr_rows,
@@ -131,6 +132,30 @@ class ServingEngine:
         # asserts on this, independent of registry wiring.
         self.adapt_invocations = 0
         self._cache_mirrored = (0, 0, 0)  # hits, misses, evictions
+        # Watchdog (resilience/watchdog.py): a serving process hangs the
+        # same ways a training one does (wedged device, stuck transfer),
+        # so the engine enforces watchdog_serve_timeout_s on each
+        # in-flight step() — an IDLE engine stamps 'idle', which has no
+        # deadline and never trips. Installed only when this process has
+        # no beacon already (a training-owned watchdog wins) and
+        # restored on close(), like the registry/compile listener.
+        self._watchdog: Optional[watchdog.Watchdog] = None
+        self._prev_beacon = None
+        self._prev_recorder = None
+        if (cfg.watchdog_serve_timeout_s > 0
+                and watchdog.get_beacon() is None):
+            self._prev_recorder = flightrec.install(
+                flightrec.FlightRecorder(cfg.flight_recorder_events))
+            beacon = watchdog.ProgressBeacon()
+            beacon.stamp("idle")
+            self._prev_beacon = watchdog.install_beacon(beacon)
+            bundle = os.path.join(cfg.experiment_root,
+                                  cfg.experiment_name, "logs",
+                                  "crash_bundle_serve")
+            self._watchdog = watchdog.Watchdog(
+                beacon, watchdog.deadlines_from_config(cfg),
+                bundle_dir=bundle, registry=self.registry,
+                poll_interval_s=cfg.watchdog_poll_interval_s).start()
 
     # -- construction ----------------------------------------------------
     @classmethod
@@ -161,9 +186,15 @@ class ServingEngine:
     def close(self) -> None:
         """Detach the process-wide compile listener and restore the
         previous resilience registry (a test or driver may build many
-        engines; each should count only its own)."""
+        engines; each should count only its own). The engine-owned
+        watchdog/beacon/recorder, if any, follow the same discipline."""
         self._compile_watch.uninstall()
         resilience.set_registry(self._prev_resilience_registry)
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
+            watchdog.install_beacon(self._prev_beacon)
+            flightrec.install(self._prev_recorder)
 
     def __enter__(self) -> "ServingEngine":
         return self
@@ -197,27 +228,44 @@ class ServingEngine:
         dtype = (np.uint8 if self.cfg.transfer_images_uint8
                  else np.float32)
         for s_b, q_b in self.batcher.buckets:
-            req = FewShotRequest(
-                support_x=np.zeros((s_b, h, w, c), dtype),
-                support_y=np.zeros((s_b,), np.int32),
-                query_x=np.zeros((q_b, h, w, c), dtype),
-                deadline=float("inf"))
-            batch = pad_group([req], (s_b, q_b),
-                              self.cfg.serve_batch_tasks,
-                              self.cfg.image_shape)
-            # record=False: the first call per bucket is dominated by
-            # the XLA compile — letting it into the adapt/predict
-            # histograms (or the adapt counters) would misreport
-            # steady-state serving cost.
-            adapted = self._run_adapt(batch, record=False)
-            entry = jax.tree.map(lambda x: x[0], adapted)
-            self._run_predict([entry], [req], (s_b, q_b),
-                              record=False)
+            # Each bucket's warmup pays an XLA compile: it runs under
+            # the separate (much larger) compile deadline, not the
+            # serve-request one.
+            with watchdog.phase("compile", detail=f"serve{(s_b, q_b)}"):
+                req = FewShotRequest(
+                    support_x=np.zeros((s_b, h, w, c), dtype),
+                    support_y=np.zeros((s_b,), np.int32),
+                    query_x=np.zeros((q_b, h, w, c), dtype),
+                    deadline=float("inf"))
+                batch = pad_group([req], (s_b, q_b),
+                                  self.cfg.serve_batch_tasks,
+                                  self.cfg.image_shape)
+                # record=False: the first call per bucket is dominated by
+                # the XLA compile — letting it into the adapt/predict
+                # histograms (or the adapt counters) would misreport
+                # steady-state serving cost.
+                adapted = self._run_adapt(batch, record=False)
+                entry = jax.tree.map(lambda x: x[0], adapted)
+                self._run_predict([entry], [req], (s_b, q_b),
+                                  record=False)
 
     def step(self, now: Optional[float] = None) -> List[FewShotResponse]:
         """Serve ONE batch: dequeue a same-bucket group, answer expired
         requests with errors, adapt the cache misses (one compiled
-        batch), predict for everyone, respond. Returns [] when idle."""
+        batch), predict for everyone, respond. Returns [] when idle.
+
+        Progress contract: the whole call runs under a ``serve_request``
+        watchdog phase SCOPE, which restores the beacon's previous phase
+        (with a fresh stamp) on exit — an engine-owned beacon returns to
+        its deadline-free 'idle', and a training-owned beacon (this
+        engine living inside a training process) gets its own phase
+        back instead of being silently parked in 'idle', which would
+        defuse the training watchdog.
+        """
+        with watchdog.phase("serve_request", detail=self.batcher.depth):
+            return self._step(now=now)
+
+    def _step(self, now: Optional[float] = None) -> List[FewShotResponse]:
         reg = self.registry
         bucket, group, expired = self.batcher.next_group(
             self.cfg.serve_batch_tasks, now=now)
@@ -256,6 +304,11 @@ class ServingEngine:
                 entries[i] = cached
             else:
                 misses.append(i)
+        # Flight-ring context for post-mortems: which group was in
+        # flight, and how much of it the cache absorbed.
+        flightrec.record("serve_batch", group=len(group),
+                         cache_hits=sum(hit_flags),
+                         cache_misses=len(misses))
 
         if misses:
             batch = pad_group([group[i] for i in misses], bucket,

@@ -49,17 +49,33 @@ def host_step_skew(local_mean_step_seconds: float) -> Dict[str, Any]:
 def emit_heartbeat(jsonl: JsonlLogger, *, epoch: int, iteration: int,
                    local_mean_step_seconds: float,
                    process_index: Optional[int] = None,
+                   progress_age_seconds: Optional[float] = None,
+                   progress_phase: Optional[str] = None,
                    **extra: Any) -> Dict[str, Any]:
     """One heartbeat row per call ACROSS the fleet (not one per host).
 
     Collective (see :func:`host_step_skew`); the returned row is the
     same on every process. Extra payload (memory stats, feed stall) is
     merged into the row.
+
+    ``progress_age_seconds`` is the caller's watchdog-beacon age (now −
+    last beacon stamp). When passed, the per-host ages are gathered
+    alongside the step times and the row carries the vector plus its
+    max — a stalling peer shows on the dashboard BEFORE its watchdog
+    deadline trips. Collective-safety: beacon presence is determined by
+    config (identical on every host), so either every process passes an
+    age or none does — the gather count stays uniform.
     """
     if process_index is None:
         import jax
         process_index = jax.process_index()
     skew = host_step_skew(local_mean_step_seconds)
+    if progress_age_seconds is not None:
+        ages = gather_host_floats(progress_age_seconds)
+        skew["host_progress_age_seconds"] = ages
+        skew["progress_age_seconds"] = max(ages)
+    if progress_phase is not None:
+        skew["progress_phase"] = progress_phase
     return jsonl.log(HEARTBEAT_EVENT, epoch=epoch, iter=iteration,
                      process_index=process_index, **skew, **extra)
 

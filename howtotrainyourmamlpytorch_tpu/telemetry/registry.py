@@ -176,7 +176,11 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # RLock, not Lock: the resilience crash paths (watchdog trip,
+        # signal escalation) snapshot the registry from contexts that
+        # may interrupt the main thread inside a registry operation —
+        # per-metric locks are already reentrant for the same reason.
+        self._lock = threading.RLock()
         self._metrics: Dict[str, Any] = {}
 
     def _get_or_create(self, name: str, cls, *args):

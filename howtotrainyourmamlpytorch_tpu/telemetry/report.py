@@ -15,8 +15,9 @@ from __future__ import annotations
 import math
 from typing import Any, Dict, List, Optional, Union
 
-# v2: + "serving"; v3: + "resilience"; v4: + "data" (datastore subsystem)
-SCHEMA = "maml_tpu_telemetry_report_v4"
+# v2: + "serving"; v3: + "resilience"; v4: + "data" (datastore
+# subsystem); v5: + "watchdog" (hang detection / flight recorder)
+SCHEMA = "maml_tpu_telemetry_report_v5"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -220,6 +221,48 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                 d_totals.get("data/corrupt_images", 0)),
         }
 
+    # Watchdog section (resilience/watchdog.py, schema v5): trips from
+    # the watchdog/trips counter on registry "metrics" rows (reset-aware
+    # — a tripped run EXITS, so its final counters live in a killed
+    # segment) cross-checked against explicit "watchdog_trip" event rows
+    # (written even when a registry flush failed mid-death); last_phase
+    # / progress_age track the most recent signal in log order, so a
+    # trip row (always last in its segment) wins over earlier
+    # heartbeats. Runs without a watchdog summarize to "unavailable".
+    wd_totals: Dict[str, float] = {}
+    wd_prev: Dict[str, float] = {}
+    wd_trip_rows = 0
+    wd_seen = False
+    wd_last_phase: Metric = UNAVAILABLE
+    wd_age: Metric = UNAVAILABLE
+    for e in events:
+        if e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if m.get("watchdog/trips") is not None:
+                wd_seen = True
+                _accumulate_counter(wd_totals, wd_prev, "trips",
+                                    float(m["watchdog/trips"]))
+        elif e.get("event") == "heartbeat":
+            if e.get("progress_age_seconds") is not None:
+                wd_seen = True
+                wd_age = round(float(e["progress_age_seconds"]), 3)
+            if e.get("progress_phase") is not None:
+                wd_last_phase = str(e["progress_phase"])
+        elif e.get("event") == "watchdog_trip":
+            wd_seen = True
+            wd_trip_rows += 1
+            if e.get("phase") is not None:
+                wd_last_phase = str(e["phase"])
+            if e.get("age_seconds") is not None:
+                wd_age = round(float(e["age_seconds"]), 3)
+    watchdog_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if wd_seen:
+        watchdog_sec = {
+            "trips": max(int(wd_totals.get("trips", 0)), wd_trip_rows),
+            "last_phase": wd_last_phase,
+            "progress_age_seconds": wd_age,
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -251,6 +294,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "serving": serving,
         "resilience": resilience_sec,
         "data": data_sec,
+        "watchdog": watchdog_sec,
     }
 
 
@@ -280,6 +324,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("serving", summary["serving"]),
         ("resilience", summary["resilience"]),
         ("data plane", summary["data"]),
+        ("watchdog", summary["watchdog"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

@@ -13,10 +13,18 @@ Runs the ISSUE 3 acceptance scenario on a tiny synthetic config:
    snapshot). The phase ends "preempted".
 3. **restart** — resume from 'latest' with NO faults; the run completes
    epoch 1 and the test protocol.
+4. **hang** (ISSUE 6) — a SUBPROCESS run (the watchdog kills its whole
+   process with ``os._exit``) with an injected wedged data feed
+   (``hang_feed@N``) and a tight ``watchdog_feed_timeout_s``: the
+   watchdog must trip within its deadline, write a crash bundle
+   (all-thread ``stacks.txt`` + ``flight.jsonl``) and exit
+   ``resilience.EXIT_HUNG`` (74) — then a clean in-process restart from
+   'latest' resumes past the hang and finishes.
 
 The verdict requires `resilience/rewinds >= 1`, `resilience/io_retries
->= 1`, exactly one preemption, and a final test accuracy within
-``--tolerance`` of the baseline.
+>= 1`, exactly one preemption, hang exit code 74 + bundle present +
+hang-restart completion, and final test accuracies (restart AND
+hang-restart) within ``--tolerance`` of the baseline.
 
 Artifact contract (bench.py discipline): the LAST stdout JSON line is
 authoritative — ``{"metric": "chaos_recovery", "status":
@@ -34,6 +42,7 @@ import argparse
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 
@@ -70,6 +79,73 @@ def run_phase(cfg):
     builder = ExperimentBuilder(cfg)
     result = builder.run_experiment()
     return result, builder.registry.snapshot()
+
+
+def run_hang_phase(out: str, platform: str):
+    """The ISSUE 6 hang scenario, in a subprocess (the watchdog ends its
+    process with ``os._exit(EXIT_HUNG)`` — it must not end ours).
+
+    Epoch 0 completes and checkpoints; the prefetch worker then sleeps
+    past ``watchdog_feed_timeout_s`` while feeding iteration 5
+    (``hang_feed@5``), wedging the consumer in the 'feed' phase. Returns
+    the phase's result dict (exit code, bundle facts, trip count).
+    """
+    cfg = tiny_cfg(out, "chaos_hang", fault_spec="hang_feed@5",
+                   continue_from_epoch="latest",
+                   watchdog_feed_timeout_s=6.0,
+                   watchdog_step_timeout_s=300.0,
+                   watchdog_collective_timeout_s=300.0,
+                   watchdog_compile_timeout_s=900.0,
+                   watchdog_poll_interval_s=0.5)
+    cfg_path = os.path.join(out, "chaos_hang_config.json")
+    os.makedirs(out, exist_ok=True)
+    with open(cfg_path, "w") as f:
+        json.dump(cfg.to_dict(), f)
+    env = dict(os.environ)
+    # The fault plan must come from the config ONLY (an inherited
+    # MAML_FAULTS would override it), and the subprocess must land on
+    # the same backend the harness runs on.
+    env.pop("MAML_FAULTS", None)
+    # Bound the injected sleep well past the deadline but short of the
+    # harness timeout: if the watchdog FAILS to trip, the run finishes
+    # normally and the artifact shows the wrong exit code (a diagnosis)
+    # instead of this harness dying on a subprocess timeout.
+    env.setdefault("MAML_HANG_SECONDS", "120")
+    if platform:
+        env["MAML_JAX_PLATFORM"] = platform
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "train_maml_system.py"),
+         "--name_of_args_json_file", cfg_path],
+        env=env, capture_output=True, text=True, timeout=900)
+
+    bundle = os.path.join(out, "chaos_hang", "logs", "crash_bundle")
+    stacks = os.path.join(bundle, "stacks.txt")
+    flight = os.path.join(bundle, "flight.jsonl")
+    flight_rows = []
+    if os.path.exists(flight):
+        with open(flight) as f:
+            flight_rows = [json.loads(line) for line in f if line.strip()]
+    trip_rows = 0
+    events_path = os.path.join(out, "chaos_hang", "logs", "events.jsonl")
+    if os.path.exists(events_path):
+        with open(events_path) as f:
+            trip_rows = sum(1 for line in f if line.strip()
+                            and json.loads(line).get("event")
+                            == "watchdog_trip")
+    stacks_ok = (os.path.exists(stacks)
+                 and os.path.getsize(stacks) > 0)
+    return {
+        "hang_exit_code": proc.returncode,
+        "bundle_dir": bundle,
+        "stacks_dumped": stacks_ok,
+        "flight_rows": len(flight_rows),
+        "flight_has_feed_phase": any(
+            r.get("kind") == "phase" and r.get("phase") == "feed"
+            for r in flight_rows),
+        "watchdog_trips": trip_rows,
+        "stderr_tail": proc.stderr[-800:] if proc.returncode != 74
+        else None,
+    }
 
 
 def counter_sum(snapshots, key) -> int:
@@ -127,6 +203,17 @@ def main(argv=None) -> int:
     restart_result, restart_counters = run_phase(
         tiny_cfg(out, "chaos_faulted", continue_from_epoch="latest"))
 
+    # Hang scenario (ISSUE 6): wedged feed -> watchdog trip -> exit 74 +
+    # crash bundle, then a clean restart resumes past the hang.
+    print(json.dumps({"phase": "hang", "spec": "hang_feed@5",
+                      "status": "running"}), flush=True)
+    hang = run_hang_phase(out, platform or os.environ.get("JAX_PLATFORMS",
+                                                          ""))
+    print(json.dumps({"phase": "hang_restart", "status": "running"}),
+          flush=True)
+    hang_restart_result, _ = run_phase(
+        tiny_cfg(out, "chaos_hang", continue_from_epoch="latest"))
+
     chaos_phases = [faulted_counters, restart_counters]
     rewinds = counter_sum(chaos_phases, "resilience/rewinds")
     io_retries = counter_sum(chaos_phases, "resilience/io_retries")
@@ -138,13 +225,26 @@ def main(argv=None) -> int:
     chaos_acc = (restart_result or {}).get("test_accuracy_mean")
     delta = (abs(chaos_acc - base_acc)
              if base_acc is not None and chaos_acc is not None else None)
+    hang_acc = (hang_restart_result or {}).get("test_accuracy_mean")
+    hang_delta = (abs(hang_acc - base_acc)
+                  if base_acc is not None and hang_acc is not None
+                  else None)
+
+    from howtotrainyourmamlpytorch_tpu.resilience import EXIT_HUNG
+    hang_recovered = bool(
+        hang["hang_exit_code"] == EXIT_HUNG
+        and hang["stacks_dumped"] and hang["flight_rows"] > 0
+        and hang["watchdog_trips"] >= 1
+        and hang_delta is not None and hang_delta <= args.tolerance)
 
     recovered = bool(
         preempted and rewinds >= 1 and io_retries >= 1
         and chaos_acc is not None
-        and delta is not None and delta <= args.tolerance)
+        and delta is not None and delta <= args.tolerance
+        and hang_recovered)
     # Recoveries: one per distinct fault class the run survived.
-    recoveries = int(preempted) + int(rewinds >= 1) + int(io_retries >= 1)
+    recoveries = (int(preempted) + int(rewinds >= 1)
+                  + int(io_retries >= 1) + int(hang_recovered))
 
     artifact = {
         "metric": "chaos_recovery",
@@ -164,6 +264,15 @@ def main(argv=None) -> int:
         "chaos_test_accuracy": chaos_acc,
         "test_accuracy_delta": (round(delta, 6)
                                 if delta is not None else None),
+        "hang_exit_code": hang["hang_exit_code"],
+        "hang_stacks_dumped": hang["stacks_dumped"],
+        "hang_flight_rows": hang["flight_rows"],
+        "hang_watchdog_trips": hang["watchdog_trips"],
+        "hang_stderr_tail": hang["stderr_tail"],
+        "hang_test_accuracy": hang_acc,
+        "hang_test_accuracy_delta": (round(hang_delta, 6)
+                                     if hang_delta is not None else None),
+        "hang_recovered": hang_recovered,
         "tolerance": args.tolerance,
         "out_dir": None if cleanup else out,
     }

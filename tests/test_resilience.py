@@ -145,6 +145,68 @@ def test_retry_bounded_and_giveup_counted():
     assert reg.counter("resilience/io_giveups").value == 1
 
 
+def test_retry_env_knobs_invalid_values_fall_back(monkeypatch):
+    """Satellite: a typo'd MAML_IO_RETRIES/_RETRY_BASE_S/_CAP_S must
+    warn once and fall back to the defaults — never raise at import or
+    call time (the resilience layer cannot itself be the brittle
+    part)."""
+    from howtotrainyourmamlpytorch_tpu.resilience import retry
+
+    retry._warned_env.clear()
+    cases = [
+        ("MAML_IO_RETRIES", "three", 3, int, 0),
+        ("MAML_IO_RETRIES", "-2", 3, int, 0),
+        ("MAML_IO_RETRY_BASE_S", "fast", 0.02, float, 1e-6),
+        ("MAML_IO_RETRY_BASE_S", "-0.5", 0.02, float, 1e-6),
+        ("MAML_IO_RETRY_BASE_S", "0", 0.02, float, 1e-6),  # backoff
+        # rejects base<=0: the fallback must stay usable
+        ("MAML_IO_RETRY_CAP_S", "nan", 2.0, float, 1e-6),
+        ("MAML_IO_RETRY_CAP_S", "-1", 2.0, float, 1e-6),
+    ]
+    for name, raw, default, cast, minimum in cases:
+        retry._warned_env.clear()
+        monkeypatch.setenv(name, raw)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert retry._env_number(name, default, cast,
+                                     minimum=minimum) == default
+            # Warn ONCE per knob per process.
+            assert retry._env_number(name, default, cast,
+                                     minimum=minimum) == default
+        assert sum(name in str(r.message) for r in rec) == 1
+    # Valid values still parse; unset uses the default silently.
+    monkeypatch.setenv("MAML_IO_RETRIES", "5")
+    assert retry._env_number("MAML_IO_RETRIES", 3, int) == 5
+    monkeypatch.delenv("MAML_IO_RETRIES")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert retry._env_number("MAML_IO_RETRIES", 3, int) == 3
+    assert not rec
+
+
+def test_retry_module_import_survives_bad_env():
+    """The module-level defaults are read at import time: importing with
+    a hostile environment must succeed with the documented defaults
+    (pre-fix, `int('three')` raised at import)."""
+    import subprocess
+    import sys
+    code = (
+        "from howtotrainyourmamlpytorch_tpu.resilience import retry;"
+        "assert retry.DEFAULT_RETRIES == 3, retry.DEFAULT_RETRIES;"
+        "assert retry.DEFAULT_BASE_S == 0.02;"
+        "assert retry.DEFAULT_CAP_S == 2.0;"
+        "print('ok')")
+    env = dict(os.environ, MAML_IO_RETRIES="three",
+               MAML_IO_RETRY_BASE_S="-1", MAML_IO_RETRY_CAP_S="oops",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-W", "ignore", "-c", code],
+                       capture_output=True, text=True, timeout=300,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr[-1000:]
+    assert "ok" in r.stdout
+
+
 def test_retry_gives_up_immediately_on_missing_file():
     calls = {"n": 0}
 
@@ -458,6 +520,40 @@ def test_preemption_at_epoch_boundary_reports_preempted(tmp_path):
     assert builder.run_experiment() == {"preempted_at_iter": 0}
 
 
+def test_second_signal_escalates_to_immediate_exit(tmp_path, monkeypatch):
+    """Satellite: a SECOND SIGTERM/SIGINT while the first is still
+    draining the in-flight step must dump forensics and _exit(75) NOW —
+    a hung step would otherwise make the graceful save-on-signal path
+    un-interruptible."""
+    import signal as _signal
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    from howtotrainyourmamlpytorch_tpu.resilience import EXIT_PREEMPTED
+
+    builder = ExperimentBuilder(_cfg(tmp_path))
+    exits = []
+
+    class _Exited(BaseException):
+        pass
+
+    def fake_exit(code):
+        exits.append(code)
+        raise _Exited()
+
+    monkeypatch.setattr(os, "_exit", fake_exit)
+    # First signal: graceful — just sets the drain flag.
+    builder._handle_signal(_signal.SIGTERM, None)
+    assert builder._preempted and not exits
+    # Second signal while draining: immediate forensic exit.
+    with pytest.raises(_Exited):
+        builder._handle_signal(_signal.SIGTERM, None)
+    assert exits == [EXIT_PREEMPTED]
+    bundle = builder._bundle_dir()
+    assert os.path.getsize(os.path.join(bundle, "stacks.txt")) > 0
+    crash = json.load(open(os.path.join(bundle, "crash.json")))
+    assert crash["reason"] == "signal_escalation"
+
+
 # ---------------------------------------------------------------------------
 # system proofs (slow profile)
 # ---------------------------------------------------------------------------
@@ -529,7 +625,123 @@ def test_nan_before_any_checkpoint_fails_loudly(tmp_path):
         ExperimentBuilder(cfg).run_experiment()
 
 
-@pytest.mark.slow  # 3 tiny runs through the chaos harness (~90s), 1-core
+@pytest.mark.slow  # subprocess hang run + in-process restart (~60s)
+def test_hang_feed_watchdog_end_to_end(tmp_path):
+    """THE ISSUE 6 system proof: an injected wedged data feed
+    (hang_feed) trips the watchdog within its deadline in a REAL
+    training process — all-thread stack dump + flight.jsonl written,
+    exit code 74 — and a clean restart from 'latest' resumes past the
+    hang and completes the schedule + test protocol."""
+    import subprocess
+    import sys
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    from howtotrainyourmamlpytorch_tpu.resilience import EXIT_HUNG
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Epoch 0 (iters 0..4) checkpoints at iter 5... total_iter_per_epoch
+    # is 5 in _cfg: epoch-0 batches are 0..4, epoch-1 batches 5..9;
+    # hang the feed of iteration 6, after the epoch-0 checkpoint.
+    cfg = _cfg(tmp_path, dispatch_sync_every=1,
+               continue_from_epoch="latest",
+               fault_spec="hang_feed@6",
+               watchdog_feed_timeout_s=6.0,
+               watchdog_step_timeout_s=300.0,
+               watchdog_compile_timeout_s=900.0,
+               watchdog_poll_interval_s=0.5)
+    cfg_path = tmp_path / "hang_config.json"
+    cfg_path.write_text(json.dumps(cfg.to_dict()))
+    env = dict(os.environ, MAML_JAX_PLATFORM="cpu",
+               MAML_HANG_SECONDS="120")
+    env.pop("MAML_FAULTS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "train_maml_system.py"),
+         "--name_of_args_json_file", str(cfg_path)],
+        env=env, capture_output=True, text=True, timeout=600, cwd=repo)
+    assert proc.returncode == EXIT_HUNG, (proc.returncode,
+                                          proc.stderr[-1500:])
+
+    bundle = tmp_path / "smoke" / "logs" / "crash_bundle"
+    stacks = (bundle / "stacks.txt").read_text()
+    assert "Thread" in stacks  # all-thread dump, not just the main one
+    flight = [json.loads(line) for line in
+              (bundle / "flight.jsonl").read_text().splitlines()]
+    # The ring holds the hang's context: the injected fault and the
+    # final stuck 'feed' phase, ending in the trip record.
+    assert any(r["kind"] == "fault" and r["fault"] == "hang_feed"
+               for r in flight)
+    assert flight[-1]["kind"] == "watchdog_trip"
+    assert flight[-1]["phase"] == "feed"
+    crash = json.loads((bundle / "crash.json").read_text())
+    assert crash["reason"] == "hung_feed"
+    assert crash["age_seconds"] >= 6.0
+    # The trip row + final registry flush landed in the event stream.
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    events = read_jsonl(str(tmp_path / "smoke" / "logs" / "events.jsonl"))
+    assert sum(e.get("event") == "watchdog_trip" for e in events) == 1
+    # ... and the telemetry report renders the v5 watchdog section.
+    from howtotrainyourmamlpytorch_tpu.telemetry import summarize_events
+    wd = summarize_events(events)["watchdog"]
+    assert wd["trips"] == 1 and wd["last_phase"] == "feed"
+
+    # Restart with no faults: resumes at the snapshot and completes.
+    builder = ExperimentBuilder(_cfg(tmp_path, dispatch_sync_every=1,
+                                     continue_from_epoch="latest"))
+    assert builder.current_iter >= 5  # epoch-0 checkpoint was kept
+    result = builder.run_experiment()
+    assert result["num_models"] == 2  # full schedule + test protocol
+
+
+@pytest.mark.slow  # three tiny end-to-end runs (~60s), 1-core box
+def test_watchdog_disabled_is_parity_with_enabled(tmp_path):
+    """Acceptance pin: with all watchdog_*_timeout_s = 0 the training
+    path behaves byte-identically to the (non-tripping) enabled default
+    — same final weights bitwise, and the beacon adds ZERO compiles
+    (everything lives in host Python outside compiled code)."""
+    import jax
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    off = {f: 0.0 for f in (
+        "watchdog_step_timeout_s", "watchdog_feed_timeout_s",
+        "watchdog_collective_timeout_s", "watchdog_compile_timeout_s",
+        "watchdog_serve_timeout_s")}
+    # Run 1 (disabled) pays the process's cold compiles; runs 2 and 3
+    # are equally cache-warm, so comparing THEIR counts isolates the
+    # watchdog: if the beacon injected anything into traced code, the
+    # enabled run's HLO would differ and miss the executable cache.
+    builder_cold = ExperimentBuilder(_cfg(tmp_path / "cold", **off))
+    builder_cold.run_experiment()
+
+    builder_on = ExperimentBuilder(_cfg(tmp_path / "on"))
+    builder_on.run_experiment()
+    compiles_on = builder_on.registry.counter("compile/count").value
+
+    builder_off = ExperimentBuilder(_cfg(tmp_path / "off", **off))
+    builder_off.run_experiment()
+    compiles_off = builder_off.registry.counter("compile/count").value
+
+    for a, b, c in zip(jax.tree.leaves(builder_cold.state.params),
+                       jax.tree.leaves(builder_on.state.params),
+                       jax.tree.leaves(builder_off.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(c))
+    assert compiles_on == compiles_off
+    # The enabled run's heartbeat rows carry the liveness gauge.
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    events = read_jsonl(os.path.join(builder_on.paths["logs"],
+                                     "events.jsonl"))
+    beats = [e for e in events if e.get("event") == "heartbeat"]
+    assert beats and all(
+        e.get("progress_age_seconds") is not None for e in beats)
+    off_events = read_jsonl(os.path.join(builder_off.paths["logs"],
+                                         "events.jsonl"))
+    off_beats = [e for e in off_events if e.get("event") == "heartbeat"]
+    assert off_beats and all(
+        e.get("progress_age_seconds") is None for e in off_beats)
+
+
+@pytest.mark.slow  # 5 tiny runs through the chaos harness (~3min), 1-core
 def test_chaos_acceptance(tmp_path, capsys):
     """THE ISSUE 3 acceptance scenario: injected NaN loss + one injected
     checkpoint-write IO error + one mid-epoch SIGTERM; the restarted run
@@ -556,3 +768,11 @@ def test_chaos_acceptance(tmp_path, capsys):
     assert artifact["preempted"] is True
     assert artifact["faults_injected"] >= 3
     assert artifact["test_accuracy_delta"] <= artifact["tolerance"]
+    # Hang phase (ISSUE 6): wedged feed -> watchdog -> exit 74 + bundle
+    # (stacks + flight ring) -> restart recovered within tolerance.
+    assert artifact["hang_exit_code"] == 74
+    assert artifact["hang_stacks_dumped"] is True
+    assert artifact["hang_flight_rows"] > 0
+    assert artifact["hang_watchdog_trips"] >= 1
+    assert artifact["hang_recovered"] is True
+    assert artifact["hang_test_accuracy_delta"] <= artifact["tolerance"]

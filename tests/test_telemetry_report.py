@@ -20,17 +20,20 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # Every key the CI consumer may rely on (the acceptance list: step-time
 # percentiles, tasks/sec/chip, compile count/seconds, feed-stall
 # fraction, peak memory, per-host skew; v2 adds the serving section,
-# v3 the resilience section, v4 the data-plane section).
+# v3 the resilience section, v4 the data-plane section, v5 the
+# watchdog section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
+    "watchdog",
 }
 
 
 def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
-                         with_resilience=False, with_data=False):
+                         with_resilience=False, with_data=False,
+                         with_watchdog=False):
     """A synthetic 2-epoch run's event stream, as the experiment loop
     writes it (train_epoch + telemetry + heartbeat per epoch); with
     ``with_serving``, a trailing serve/ registry-flush row as
@@ -100,6 +103,23 @@ def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
                                     "data/pack_open_seconds": 0.006,
                                     "data/pack_bytes_mapped": 4096.0,
                                     "data/corrupt_images": 2.0})
+    if with_watchdog:
+        # A watchdog-enabled run: heartbeats carry the liveness age, a
+        # registry row carries the trips counter (reset after the trip
+        # kills the process — the restart's row reads 0), and the trip
+        # itself lands as an explicit watchdog_trip event row.
+        log.log("heartbeat", epoch=2, iter=30, process_index=0,
+                hosts=4, host_mean_step_seconds=[0.1] * 4,
+                skew_frac=0.0, slowest_host=0,
+                host_progress_age_seconds=[0.5, 0.4, 0.6, 9.5],
+                progress_age_seconds=9.5, progress_phase="step")
+        log.log("metrics", metrics={"watchdog/trips": 1.0})
+        log.log("watchdog_trip", phase="feed", detail="train",
+                age_seconds=12.25, deadline_seconds=6.0,
+                process_index=0)
+        # Restarted segment: fresh registry — reset-aware accumulation
+        # must not double or drop the killed segment's trip.
+        log.log("metrics", metrics={"watchdog/trips": 0.0})
     return log.path
 
 
@@ -120,11 +140,12 @@ def test_summarize_events_fixture(tmp_path):
     assert s["peak_memory_bytes"] == 2001
     assert s["host_skew"]["hosts"] == 4
     assert s["host_skew"]["max_skew_frac"] == pytest.approx(0.1)
-    # No serve/, resilience/ or data/ rows -> the sections say so
-    # explicitly.
+    # No serve/, resilience/, data/ or watchdog rows -> the sections say
+    # so explicitly.
     assert s["serving"] == UNAVAILABLE
     assert s["resilience"] == UNAVAILABLE
     assert s["data"] == UNAVAILABLE
+    assert s["watchdog"] == UNAVAILABLE
     # The table renders every row without raising.
     table = format_table(s)
     assert "feed stall fraction" in table and "0.1" in table
@@ -213,6 +234,42 @@ def test_summarize_events_data_section(tmp_path):
     assert "data plane" in format_table(s)
     # Training metrics untouched by the data rows.
     assert s["epochs"] == 2 and s["serving"] == UNAVAILABLE
+
+
+def test_summarize_events_watchdog_section(tmp_path):
+    """watchdog rows (heartbeat liveness, watchdog/trips counter,
+    watchdog_trip event) render the v5 watchdog section; the trip row
+    (always the segment's last word) wins last_phase/progress_age, and
+    the post-restart counter reset must not drop the trip."""
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    path = write_fixture_events(tmp_path / "events.jsonl",
+                                with_watchdog=True)
+    s = summarize_events(read_jsonl(path))
+    assert set(s) == SCHEMA_KEYS
+    wd = s["watchdog"]
+    assert wd["trips"] == 1
+    assert wd["last_phase"] == "feed"
+    assert wd["progress_age_seconds"] == pytest.approx(12.25)
+    assert "watchdog" in format_table(s)
+    # Training metrics untouched by the watchdog rows.
+    assert s["epochs"] == 2 and s["serving"] == UNAVAILABLE
+
+
+def test_watchdog_section_from_heartbeats_alone():
+    """A healthy watchdog-enabled run (no trips) still reports the
+    section: 0 trips, the last heartbeat's phase and liveness age —
+    'watchdog on, nothing tripped' and 'no watchdog' are different
+    facts."""
+    events = [
+        {"event": "metrics", "metrics": {"watchdog/trips": 0.0}},
+        {"event": "heartbeat", "progress_age_seconds": 0.4,
+         "progress_phase": "step", "skew_frac": 0.0, "hosts": 1},
+        {"event": "heartbeat", "progress_age_seconds": 0.7,
+         "progress_phase": "feed", "skew_frac": 0.0, "hosts": 1},
+    ]
+    wd = summarize_events(events)["watchdog"]
+    assert wd == {"trips": 0, "last_phase": "feed",
+                  "progress_age_seconds": 0.7}
 
 
 def test_summarize_events_failsoft_markers(tmp_path):
@@ -309,6 +366,12 @@ def test_report_on_real_two_epoch_cpu_run(tmp_path):
     assert s["host_skew"]["hosts"] == 1
     # v4 data-plane section: build_source counted what fed the run.
     assert s["data"]["source_kind"] == "synthetic"
+    # v5 watchdog section: the default-enabled watchdog reported
+    # liveness (0 trips on a healthy run — a measured zero, not absent).
+    assert s["watchdog"]["trips"] == 0
+    assert s["watchdog"]["last_phase"] in (
+        "step", "feed", "collective", "compile", "idle")
+    assert isinstance(s["watchdog"]["progress_age_seconds"], float)
     # The Prometheus textfile snapshot landed next to the JSONL stream.
     prom = open(os.path.join(exp_dir, "logs", "metrics.prom")).read()
     assert "# TYPE compile_count counter" in prom

@@ -1,0 +1,428 @@
+"""Watchdog & flight recorder units (ISSUE 6).
+
+Tier-1 keeps the cheap layers — ring-buffer bounds/thread-safety/dump
+ordering, beacon/deadline math (disabled never trips; the compile
+budget is separate from the step budget), hang fault-kind parsing,
+trip-writes-bundle with an injected trip action, config validation,
+structural install/uninstall around a (stubbed) run. The system proofs
+(hang_feed → stacks → exit 74 → restart, watchdog-on/off parity) live
+in tests/test_resilience.py's slow profile and scripts/chaos_run.py.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu import resilience
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    faults, flightrec, watchdog)
+from howtotrainyourmamlpytorch_tpu.resilience.faults import FaultPlan
+from howtotrainyourmamlpytorch_tpu.resilience.flightrec import (
+    FlightRecorder, write_crash_bundle)
+from howtotrainyourmamlpytorch_tpu.resilience.watchdog import (
+    ProgressBeacon, Watchdog)
+from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+from howtotrainyourmamlpytorch_tpu.utils.tracing import JsonlLogger
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    """Every test starts/ends with no beacon, recorder, fault plan or
+    resilience registry installed (runs/engines install their own)."""
+    faults.configure("")
+    prev_reg = resilience.set_registry(None)
+    prev_beacon = watchdog.install_beacon(None)
+    prev_rec = flightrec.install(None)
+    yield
+    faults.configure("")
+    resilience.set_registry(prev_reg)
+    watchdog.install_beacon(prev_beacon)
+    flightrec.install(prev_rec)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder ring
+# ---------------------------------------------------------------------------
+
+def test_ring_bounded_and_ordered():
+    rec = FlightRecorder(capacity=8)
+    for i in range(30):
+        rec.record("phase", phase="step", i=i)
+    assert len(rec) == 8
+    # Oldest dropped; survivors in append order.
+    assert [e["i"] for e in rec.events()] == list(range(22, 30))
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+def test_ring_thread_safe_append():
+    rec = FlightRecorder(capacity=64)
+    n_threads, per_thread = 8, 400
+
+    def hammer(tid):
+        for i in range(per_thread):
+            rec.record("phase", tid=tid, i=i)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = rec.events()
+    assert len(rec) == 64
+    # Monotone timestamps prove snapshot consistency under concurrency.
+    ts = [e["t"] for e in events]
+    assert ts == sorted(ts)
+    # Per-thread suborder preserved (each thread's i strictly increases).
+    for tid in range(n_threads):
+        own = [e["i"] for e in events if e["tid"] == tid]
+        assert own == sorted(own)
+
+
+def test_ring_dump_jsonl_ordering(tmp_path):
+    rec = FlightRecorder(capacity=4)
+    for i in range(6):
+        rec.record("phase", phase=f"p{i}")
+    path = tmp_path / "flight.jsonl"
+    assert rec.dump_jsonl(str(path)) == 4
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    assert [r["phase"] for r in rows] == ["p2", "p3", "p4", "p5"]
+    assert all(r["kind"] == "phase" and "t" in r and "ts" in r
+               for r in rows)
+
+
+def test_module_record_is_noop_without_recorder():
+    assert flightrec.get() is None
+    flightrec.record("phase", phase="step")  # must not raise
+    rec = FlightRecorder(4)
+    assert flightrec.install(rec) is None
+    flightrec.record("phase", phase="step")
+    assert len(rec) == 1
+    assert flightrec.install(None) is rec
+
+
+# ---------------------------------------------------------------------------
+# beacon + deadline math
+# ---------------------------------------------------------------------------
+
+def test_beacon_stamp_age_and_flight_record():
+    rec = FlightRecorder(16)
+    flightrec.install(rec)
+    b = ProgressBeacon()
+    b.stamp("step", detail=7)
+    phase, stamp, detail = b.current()
+    assert phase == "step" and detail == 7
+    assert b.age(now=stamp + 2.5) == pytest.approx(2.5)
+    # Every stamp feeds the flight ring (the ring IS the phase record).
+    last = rec.events()[-1]
+    assert last["kind"] == "phase"
+    assert last["phase"] == "step" and last["detail"] == 7
+
+
+def test_beacon_phase_scope_restores_with_fresh_stamp():
+    b = ProgressBeacon()
+    b.stamp("step", detail=3)
+    _, t0, _ = b.current()
+    with b.phase("collective", detail="barrier"):
+        assert b.current()[0] == "collective"
+    phase, t1, detail = b.current()
+    assert phase == "step" and detail == 3
+    assert t1 >= t0  # restored with a FRESH stamp: scoped work counts
+                     # as progress
+
+
+def test_module_stamp_and_phase_noop_without_beacon():
+    watchdog.stamp("step", detail=1)  # must not raise
+    with watchdog.phase("collective"):
+        pass
+    b = ProgressBeacon()
+    watchdog.install_beacon(b)
+    watchdog.stamp("feed")
+    assert b.current()[0] == "feed"
+    with watchdog.phase("collective"):
+        assert b.current()[0] == "collective"
+    assert b.current()[0] == "feed"
+
+
+def test_deadline_disabled_never_trips():
+    b = ProgressBeacon()
+    b.stamp("step")
+    # Per-phase zero: no deadline for that phase.
+    wd = Watchdog(b, {"step": 0.0, "feed": 5.0}, bundle_dir="/nonexistent")
+    _, stamp, _ = b.current()
+    assert wd.check(now=stamp + 1e9) is None
+    # All-zero: the watchdog is disabled outright (start() is a no-op).
+    wd0 = Watchdog(b, {"step": 0.0, "feed": 0.0},
+                   bundle_dir="/nonexistent")
+    assert not wd0.enabled
+    assert wd0.check(now=stamp + 1e9) is None
+    wd0.start()
+    assert wd0._thread is None
+    # Unknown/bookkeeping phases ('idle') never trip even when enabled.
+    b.stamp("idle")
+    _, stamp, _ = b.current()
+    assert wd.check(now=stamp + 1e9) is None
+
+
+def test_deadline_compile_budget_separate_from_step():
+    b = ProgressBeacon()
+    wd = Watchdog(b, {"step": 1.0, "compile": 100.0},
+                  bundle_dir="/nonexistent")
+    b.stamp("compile")
+    _, stamp, _ = b.current()
+    assert wd.check(now=stamp + 50.0) is None       # within compile budget
+    info = wd.check(now=stamp + 101.0)
+    assert info["phase"] == "compile"
+    b.stamp("step", detail=12)
+    _, stamp, _ = b.current()
+    assert wd.check(now=stamp + 0.5) is None
+    info = wd.check(now=stamp + 2.0)                # step budget is its own
+    assert info["phase"] == "step" and info["detail"] == 12
+    assert info["age_seconds"] == pytest.approx(2.0)
+    assert info["deadline_seconds"] == pytest.approx(1.0)
+
+
+def test_watchdog_poll_interval_auto_and_override():
+    b = ProgressBeacon()
+    assert Watchdog(b, {"step": 2.0}, bundle_dir="x").poll_interval_s \
+        == pytest.approx(0.5)
+    assert Watchdog(b, {"step": 1e6}, bundle_dir="x").poll_interval_s \
+        == pytest.approx(5.0)
+    assert Watchdog(b, {"step": 0.01}, bundle_dir="x").poll_interval_s \
+        == pytest.approx(0.05)
+    assert Watchdog(b, {"step": 2.0}, bundle_dir="x",
+                    poll_interval_s=1.25).poll_interval_s \
+        == pytest.approx(1.25)
+
+
+# ---------------------------------------------------------------------------
+# trip path
+# ---------------------------------------------------------------------------
+
+def test_trip_writes_bundle_counts_and_flushes(tmp_path):
+    reg = MetricsRegistry()
+    rec = FlightRecorder(16)
+    flightrec.install(rec)
+    b = ProgressBeacon()
+    watchdog.install_beacon(b)
+    b.stamp("feed", detail="train")
+    b.stamp("step", detail=41)
+    jsonl = JsonlLogger(str(tmp_path / "events.jsonl"))
+    bundle = str(tmp_path / "crash_bundle")
+    trips = []
+    wd = Watchdog(b, {"step": 0.5}, bundle_dir=bundle, registry=reg,
+                  jsonl=jsonl, prom_path=str(tmp_path / "metrics.prom"),
+                  on_trip=trips.append)
+    info = wd.check(now=b.current()[1] + 1.0)
+    assert info is not None
+    wd.trip(info)
+    assert trips == [info]  # injected action ran INSTEAD of os._exit
+    # Bundle layout: all-thread stacks, the flight ring, crash context.
+    stacks = open(os.path.join(bundle, "stacks.txt")).read()
+    assert "Thread" in stacks or "File" in stacks
+    rows = [json.loads(line) for line in
+            open(os.path.join(bundle, "flight.jsonl"))]
+    phases = [r for r in rows if r["kind"] == "phase"]
+    assert [p["phase"] for p in phases] == ["feed", "step"]
+    assert rows[-1]["kind"] == "watchdog_trip"
+    crash = json.load(open(os.path.join(bundle, "crash.json")))
+    assert crash["reason"] == "hung_step"
+    assert crash["phase"] == "step" and crash["detail"] == 41
+    assert crash["metrics"]["watchdog/trips"] == 1
+    # Telemetry flushed: trip row + registry snapshot row + Prometheus.
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    events = read_jsonl(str(tmp_path / "events.jsonl"))
+    trip_rows = [e for e in events if e["event"] == "watchdog_trip"]
+    assert len(trip_rows) == 1 and trip_rows[0]["phase"] == "step"
+    metric_rows = [e for e in events if e["event"] == "metrics"]
+    assert metric_rows[-1]["metrics"]["watchdog/trips"] == 1
+    assert "watchdog_trips 1" in open(
+        str(tmp_path / "metrics.prom")).read()
+    assert reg.counter(watchdog.TRIPS_COUNTER).value == 1
+
+
+def test_watchdog_thread_trips_on_real_stall(tmp_path):
+    """The daemon-thread path end-to-end (with an injected trip action
+    instead of os._exit): a stamped phase left to age past a tight
+    deadline trips within ~2 poll intervals."""
+    b = ProgressBeacon()
+    b.stamp("feed")
+    tripped = threading.Event()
+    wd = Watchdog(b, {"feed": 0.15}, bundle_dir=str(tmp_path / "b"),
+                  poll_interval_s=0.05,
+                  on_trip=lambda info: tripped.set())
+    wd.start()
+    try:
+        assert tripped.wait(timeout=5.0)
+        assert wd.tripped["phase"] == "feed"
+    finally:
+        wd.stop()
+    assert os.path.exists(tmp_path / "b" / "stacks.txt")
+
+
+def test_watchdog_thread_quiet_while_progressing(tmp_path):
+    """Fresh stamps keep the watchdog silent; stop() joins the thread."""
+    b = ProgressBeacon()
+    tripped = threading.Event()
+    # Deadline far above the stamp cadence so a loaded CI box's
+    # scheduling jitter can't fake a stall.
+    wd = Watchdog(b, {"step": 2.0}, bundle_dir=str(tmp_path / "b"),
+                  poll_interval_s=0.05,
+                  on_trip=lambda info: tripped.set())
+    wd.start()
+    for i in range(12):
+        b.stamp("step", detail=i)
+        time.sleep(0.05)
+    wd.stop()
+    assert not tripped.is_set()
+    assert wd._thread is None
+
+
+# ---------------------------------------------------------------------------
+# fault kinds + crash-bundle helper
+# ---------------------------------------------------------------------------
+
+def test_hang_fault_kinds_parse_and_fire():
+    plan = FaultPlan.parse("hang_feed@5; hang_collective@2, hang_step@3")
+    assert {s.kind for s in plan.specs} == {"hang_feed", "hang_collective",
+                                            "hang_step"}
+    assert plan.maybe_fire("hang_feed", step=5)
+    assert not plan.maybe_fire("hang_feed", step=5)  # at most once
+    # hang_collective is call-counted: fires on the 2nd collective.
+    assert [plan.maybe_fire("hang_collective") for _ in range(3)] \
+        == [False, True, False]
+    with pytest.raises(ValueError):
+        FaultPlan.parse("hang_nope@1")
+
+
+def test_hang_sleep_is_bounded_and_env_tunable(monkeypatch):
+    t0 = time.monotonic()
+    faults.hang(seconds=0.05)
+    assert 0.04 <= time.monotonic() - t0 < 2.0
+    monkeypatch.setenv(faults.HANG_SECONDS_ENV, "0.05")
+    t0 = time.monotonic()
+    faults.hang()
+    assert time.monotonic() - t0 < 2.0
+    monkeypatch.setenv(faults.HANG_SECONDS_ENV, "not-a-number")
+    t0 = time.monotonic()
+    faults.hang(seconds=0.0)  # explicit arg still wins
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_injected_collective_hang_is_single_process_simulable(monkeypatch):
+    """hang_collective must fire on this (single-process) box — the
+    chaos hook sits before the collective's early return."""
+    from howtotrainyourmamlpytorch_tpu.parallel import multihost
+    monkeypatch.setenv(faults.HANG_SECONDS_ENV, "0.01")
+    faults.configure("hang_collective@1")
+    rec = FlightRecorder(8)
+    flightrec.install(rec)
+    t0 = time.monotonic()
+    assert multihost.any_process_true(False) is False
+    assert time.monotonic() - t0 < 2.0
+    assert any(e["kind"] == "fault" and e["fault"] == "hang_collective"
+               for e in rec.events())
+
+
+def test_write_crash_bundle_without_recorder(tmp_path):
+    """The bundle degrades gracefully: no recorder -> no flight.jsonl,
+    stacks + crash.json still written (signal escalation can run before
+    any watchdog is installed)."""
+    bundle = write_crash_bundle(str(tmp_path / "b"), reason="test",
+                                info={"iter": 3})
+    assert os.path.getsize(os.path.join(bundle, "stacks.txt")) > 0
+    assert not os.path.exists(os.path.join(bundle, "flight.jsonl"))
+    crash = json.load(open(os.path.join(bundle, "crash.json")))
+    assert crash["reason"] == "test" and crash["iter"] == 3
+
+
+# ---------------------------------------------------------------------------
+# config + wiring structure
+# ---------------------------------------------------------------------------
+
+def test_config_watchdog_validation():
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    for field in ("watchdog_step_timeout_s", "watchdog_feed_timeout_s",
+                  "watchdog_collective_timeout_s",
+                  "watchdog_compile_timeout_s",
+                  "watchdog_serve_timeout_s",
+                  "watchdog_poll_interval_s"):
+        with pytest.raises(ValueError, match=field):
+            MAMLConfig(**{field: -1.0})
+    with pytest.raises(ValueError, match="flight_recorder_events"):
+        MAMLConfig(flight_recorder_events=0)
+    cfg = MAMLConfig()
+    assert watchdog.watchdog_enabled(cfg)  # generous defaults are ON
+    # The compile budget defaults far above the step budget (a cold pod
+    # compile must not false-trip).
+    d = watchdog.deadlines_from_config(cfg)
+    assert d["compile"] > d["step"]
+    off = cfg.replace(**{f: 0.0 for f in (
+        "watchdog_step_timeout_s", "watchdog_feed_timeout_s",
+        "watchdog_collective_timeout_s", "watchdog_compile_timeout_s",
+        "watchdog_serve_timeout_s")})
+    assert not watchdog.watchdog_enabled(off)
+
+
+_ALL_TIMEOUTS = ("watchdog_step_timeout_s", "watchdog_feed_timeout_s",
+                 "watchdog_collective_timeout_s",
+                 "watchdog_compile_timeout_s", "watchdog_serve_timeout_s")
+
+
+def test_run_installs_watchdog_iff_enabled(tmp_path, monkeypatch):
+    """Structural half of the acceptance pin: with every timeout 0 a run
+    installs NO beacon/recorder/watchdog (each site stays a single None
+    check); with the defaults it installs all three for the run's
+    duration and restores the process state after. The training-parity
+    half is the slow test in test_resilience.py."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    seen = {}
+
+    def probe(builder):
+        def stub():
+            seen["beacon"] = watchdog.get_beacon()
+            seen["recorder"] = flightrec.get()
+            seen["watchdog"] = builder._watchdog
+            return {"paused_at_iter": builder.current_iter}
+        return stub
+
+    off = {f: 0.0 for f in _ALL_TIMEOUTS}
+    builder = ExperimentBuilder(_cfg(tmp_path / "off", **off))
+    monkeypatch.setattr(builder, "_run_experiment", probe(builder))
+    builder.run_experiment()
+    assert seen == {"beacon": None, "recorder": None, "watchdog": None}
+
+    builder = ExperimentBuilder(_cfg(tmp_path / "on"))
+    monkeypatch.setattr(builder, "_run_experiment", probe(builder))
+    builder.run_experiment()
+    assert isinstance(seen["beacon"], ProgressBeacon)
+    assert isinstance(seen["recorder"], FlightRecorder)
+    assert seen["watchdog"].enabled
+    # Scoped lifetime: everything restored/stopped after the run.
+    assert watchdog.get_beacon() is None
+    assert flightrec.get() is None
+    assert builder._watchdog is None
+
+
+def test_unhandled_exception_dumps_flight_bundle(tmp_path, monkeypatch):
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    builder = ExperimentBuilder(_cfg(tmp_path))
+
+    def boom():
+        raise RuntimeError("kaboom")
+
+    monkeypatch.setattr(builder, "_run_experiment", boom)
+    with pytest.raises(RuntimeError, match="kaboom"):
+        builder.run_experiment()
+    bundle = builder._bundle_dir()
+    assert os.path.exists(os.path.join(bundle, "flight.jsonl"))
+    crash = json.load(open(os.path.join(bundle, "crash.json")))
+    assert crash["reason"] == "exception:RuntimeError"
+    assert "kaboom" in crash["error"]
