@@ -255,6 +255,43 @@ class MAMLConfig:
                                            # stay within the checkpoint's
                                            # LSLR/BN per-step rows)
 
+    # ---- optimization-health introspection (telemetry/health.py,
+    # docs/OBSERVABILITY.md) --------------------------------------------
+    health_metrics_every_n_steps: int = 0
+                                           # fetch the in-graph training-
+                                           # health diagnostics (outer-grad
+                                           # norms, per-layer update
+                                           # ratios, LSLR stats, MSL
+                                           # vector, per-inner-step losses)
+                                           # at most every N iterations, at
+                                           # the existing dispatch-sync
+                                           # points. 0 = off, and the
+                                           # compiled step carries ZERO
+                                           # extra HLO outputs (the
+                                           # watchdog zero-cost
+                                           # discipline); >0 compiles the
+                                           # diagnostics into the step and
+                                           # the host fetches them on this
+                                           # cadence
+    health_grad_norm_warn_factor: float = 10.0
+                                           # DivergenceGuard early
+                                           # warning: an outer-grad global
+                                           # norm above factor x the
+                                           # running median of recent
+                                           # norms (or any non-finite
+                                           # norm) logs a
+                                           # health/grad_norm_warn row —
+                                           # BEFORE the NaN that triggers
+                                           # a rewind. 0 = non-finite-only
+                                           # warnings; needs
+                                           # health_metrics_every_n_steps
+                                           # > 0 to observe anything.
+                                           # Independent of
+                                           # divergence_patience: the
+                                           # warning is observability and
+                                           # keeps firing with rewinds
+                                           # disabled
+
     # ---- resilience (resilience/ subsystem, docs/RESILIENCE.md) --------
     divergence_patience: int = 2           # consecutive bad outer-loss
                                            # observations (NaN/Inf or
@@ -392,6 +429,14 @@ class MAMLConfig:
                 f"eval step count; the checkpoint's per-step LSLR/BN rows "
                 f"cover at most {max_steps} steps), got "
                 f"{self.serve_adapt_steps}")
+        if self.health_metrics_every_n_steps < 0:
+            raise ValueError(
+                "health_metrics_every_n_steps must be >= 0 (0 = off)")
+        if (self.health_grad_norm_warn_factor != 0.0
+                and self.health_grad_norm_warn_factor <= 1.0):
+            raise ValueError(
+                f"health_grad_norm_warn_factor must be 0 (non-finite-only)"
+                f" or > 1, got {self.health_grad_norm_warn_factor}")
         if self.divergence_patience < 0:
             raise ValueError("divergence_patience must be >= 0 (0 = off)")
         if (self.divergence_spike_factor != 0.0

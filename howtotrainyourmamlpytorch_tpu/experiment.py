@@ -53,11 +53,13 @@ from howtotrainyourmamlpytorch_tpu.resilience.flightrec import (
     write_crash_bundle)
 from howtotrainyourmamlpytorch_tpu.telemetry import (
     FeedStallMeter, MetricsRegistry, device_memory_stats, emit_heartbeat)
+from howtotrainyourmamlpytorch_tpu.telemetry import health as health_mod
+from howtotrainyourmamlpytorch_tpu.telemetry import trace as trace_mod
 from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
 from howtotrainyourmamlpytorch_tpu.utils.storage import (
     build_experiment_folder, save_statistics, save_to_json)
 from howtotrainyourmamlpytorch_tpu.utils.tracing import (
-    JsonlLogger, StepTimer, profile_trace)
+    JsonlLogger, StepTimer, profile_trace, read_jsonl)
 
 
 class ExperimentBuilder:
@@ -169,11 +171,29 @@ class ExperimentBuilder:
         self._eval_compile_stamped = False
         # Divergence guard (resilience/guard.py): observes the outer-loss
         # scalar at dispatch-sync points; a trigger rewinds to the
-        # last-good epoch checkpoint (_perform_rewind).
+        # last-good epoch checkpoint (_perform_rewind). The grad-norm
+        # early warning lives on a SEPARATE guard instance
+        # (self._norm_guard below) so it works with rewinds disabled.
         self._guard = (DivergenceGuard(cfg.divergence_patience,
                                        cfg.divergence_spike_factor)
                        if cfg.divergence_patience > 0 else None)
         self._rewind_requested = False
+        # Training-health introspection (telemetry/health.py): the
+        # compiled step carries the diagnostics iff the knob is > 0; the
+        # host fetches them at most every N iterations, only at the
+        # dispatch-sync points below (zero extra device syncs). The
+        # grad-norm early warning gets its OWN guard instance: it is
+        # pure observability and must keep warning when the rewind
+        # guard is disabled (divergence_patience=0) — routing it
+        # through self._guard would silently tie the warning to the
+        # rewind feature.
+        self._health_every = cfg.health_metrics_every_n_steps
+        self._last_health_iter: Optional[int] = None
+        self._norm_guard = (DivergenceGuard(
+                                patience=1,
+                                grad_norm_factor=(
+                                    cfg.health_grad_norm_warn_factor))
+                            if self._health_every > 0 else None)
         # Device-resident cache of the fixed (deterministic) val/test
         # batches: transferred once, reused every validation sweep.
         self._eval_cache: Dict[str, List[Any]] = {}
@@ -410,7 +430,13 @@ class ExperimentBuilder:
                 else:
                     self.state, metrics = step_fn(self.state, batch,
                                                   jnp.float32(epoch))
-                metrics_acc.append(metrics)
+                # The per-epoch accumulator feeds only the scalar stats;
+                # the health dict is consumed at the sync points below —
+                # retaining every iteration's copy would pin its device
+                # buffers all epoch and the epoch-end stacked fetch
+                # would transfer them just to be discarded.
+                metrics_acc.append(metrics if metrics.health is None
+                                   else metrics._replace(health=None))
                 self.current_iter += 1
                 timer.tick()  # dispatch-interval under async execution;
                               # the epoch-end sync folds device time into
@@ -430,14 +456,27 @@ class ExperimentBuilder:
                     # being fetched anyway. The compiled step is never
                     # touched; with no fault plan and no guard these are
                     # two None/attribute checks per sync.
-                    if faults.maybe_fire("nan_loss",
-                                         step=self.current_iter):
+                    nan_fault = faults.maybe_fire("nan_loss",
+                                                  step=self.current_iter)
+                    if nan_fault:
                         loss_now = float("nan")
                     if faults.maybe_fire("hang_step",
                                          step=self.current_iter):
                         # Simulated wedged step (phase 'step' is the
                         # current beacon): the watchdog must kill us.
                         faults.hang()
+                    # Health fetch on its cadence: one extra transfer on
+                    # a fetch that already synced. The grad-norm warning
+                    # is observed BEFORE the loss (below), so a
+                    # divergence post-mortem reads warn -> rewind in log
+                    # order.
+                    if (self._health_every and metrics.health is not None
+                            and (self._last_health_iter is None
+                                 or self.current_iter
+                                 - self._last_health_iter
+                                 >= self._health_every)):
+                        self._observe_health(metrics.health, epoch,
+                                             nan_fault)
                     if live:
                         live_samples.append(
                             (loss_now,
@@ -527,6 +566,38 @@ class ExperimentBuilder:
                        **{f"dispatch_{k}": v for k, v in tsum.items()})
         self._emit_epoch_telemetry(epoch, timer, tsum, stats)
         return stats
+
+    def _observe_health(self, health: Dict[str, Any], epoch: int,
+                        nan_fault: bool) -> None:
+        """Fetch one in-graph health snapshot and publish it: ``health/*``
+        registry gauges + one ``health`` event row (telemetry/health.py),
+        then feed the outer-grad norm to the divergence guard's early
+        warning. Called only at dispatch-sync points on the configured
+        cadence — the device was synced by the loss fetch already.
+
+        ``nan_fault``: the ``nan_loss`` chaos fault poisons the observed
+        grad norm too — a real NaN outer loss comes from non-finite
+        gradients, so the simulated divergence must look the same to the
+        diagnostics it exists to exercise (the warn row then lands
+        strictly before the rewind row, the order a real divergence
+        produces).
+        """
+        self._last_health_iter = self.current_iter
+        fetched = dict(jax.device_get(health))
+        if nan_fault:
+            fetched["grad_norm"] = float("nan")
+        health_mod.publish_health(self.registry, self.jsonl, fetched,
+                                  iteration=self.current_iter, epoch=epoch)
+        grad_norm = float(fetched["grad_norm"])
+        if (self._norm_guard is not None
+                and self._norm_guard.observe_grad_norm(grad_norm)):
+            # Early warning only: the row + counter land NOW, before any
+            # NaN-triggered rewind — rewind/recovery semantics untouched.
+            self.jsonl.log(health_mod.GRAD_NORM_WARN_EVENT,
+                           iter=self.current_iter, epoch=epoch,
+                           grad_norm=grad_norm)
+            print(f"health: outer-grad norm warning at iter "
+                  f"{self.current_iter} (norm {grad_norm:g})", flush=True)
 
     def _emit_epoch_telemetry(self, epoch: int, timer: StepTimer,
                               tsum: Dict[str, float],
@@ -697,7 +768,8 @@ class ExperimentBuilder:
                 write_crash_bundle(
                     self._bundle_dir(), reason="preempted",
                     info={"iter": self.current_iter},
-                    registry=self.registry)
+                    registry=self.registry,
+                    process_index=jax.process_index())
             return result
         except BaseException as e:
             # Unhandled exception: the third flight-dump trigger. Not
@@ -709,7 +781,8 @@ class ExperimentBuilder:
                     reason=f"exception:{type(e).__name__}",
                     info={"error": str(e)[:500],
                           "iter": self.current_iter},
-                    registry=self.registry)
+                    registry=self.registry,
+                    process_index=jax.process_index())
             raise
         finally:
             if self._watchdog is not None:
@@ -744,6 +817,10 @@ class ExperimentBuilder:
         for name in ("resilience/rewinds", "resilience/io_retries",
                      "resilience/faults_injected"):
             self.registry.counter(name)
+        if self._health_every:
+            # Same eager-registration rule: a health-enabled run must
+            # report "0 warnings", not omit the counter.
+            self.registry.counter(health_mod.GRAD_NORM_WARN_COUNTER)
         # Save-on-signal: SIGTERM (cluster preemption notice) and SIGINT
         # (operator Ctrl-C) checkpoint 'latest' at the current iteration
         # and exit the loop cleanly; resume with
@@ -824,7 +901,8 @@ class ExperimentBuilder:
             write_crash_bundle(
                 self._bundle_dir(), reason="signal_escalation",
                 info={"signum": signum, "iter": self.current_iter},
-                registry=self.registry)
+                registry=self.registry,
+                process_index=jax.process_index())
         except Exception:
             pass
         os._exit(resilience.EXIT_PREEMPTED)
@@ -896,6 +974,13 @@ class ExperimentBuilder:
         self.ckpt.save_latest(self.state, self.current_iter,
                               write=self.is_main_process)
         self.data.set_train_salt(rewinds)
+        # Post-rewind iterations restart BELOW the poisoned window; the
+        # health cadence — and the warn guard's norm history (the
+        # post-rewind scale may legitimately differ) — restart with
+        # them.
+        self._last_health_iter = None
+        if self._norm_guard is not None:
+            self._norm_guard.reset()
         self.registry.counter("resilience/rewinds").inc()
         self.jsonl.log("rewind", epoch=tag, iter=self.current_iter,
                        rewinds=rewinds)
@@ -924,6 +1009,7 @@ class ExperimentBuilder:
             # format), one atomic rewrite per epoch.
             self.registry.write_prometheus(
                 f"{self.paths['logs']}/metrics.prom")
+        self._flush_timeline()
         if (self.cfg.use_tensorboard and self.is_main_process
                 and not self._tb_disabled):
             # Created lazily at first scalar write: an __init__-time
@@ -961,6 +1047,37 @@ class ExperimentBuilder:
               f"acc {val_stats['accuracy']:.4f} | "
               f"{train_stats['meta_tasks_per_sec']:.1f} tasks/s | "
               f"lr {train_stats['meta_lr']:.2e}")
+
+    def _flush_timeline(self) -> None:
+        """Per-epoch timeline artifacts (telemetry/trace.py): the current
+        flight ring as ``logs/flight.jsonl`` plus a Chrome-trace
+        ``logs/trace.json`` synthesized from the ring and the tail of
+        the run's events.jsonl, each atomically rewritten. Both layers
+        are bounded windows (the ring by ``flight_recorder_events``,
+        the events layer by a fixed tail) so the per-epoch cost stays
+        flat over a long run; ``scripts/trace_export.py`` rebuilds the
+        COMPLETE run's timeline offline from the same files.
+        Main-process only, and best-effort: a timeline must never kill
+        training.
+        """
+        if self._flightrec is None or not self.is_main_process:
+            return
+        try:
+            logs = self.paths["logs"]
+            self._flightrec.dump_jsonl(os.path.join(logs, "flight.jsonl"))
+            # Tail-bounded like the flight ring: re-parsing the WHOLE
+            # append-only log every epoch would grow quadratic over a
+            # long run. The per-epoch trace is the recent window;
+            # scripts/trace_export.py rebuilds the complete run offline.
+            events = (read_jsonl(self.jsonl.path, tail=4096)
+                      if os.path.exists(self.jsonl.path) else None)
+            trace_mod.write_trace(os.path.join(logs, "trace.json"),
+                                  events=events,
+                                  flight=self._flightrec.events(),
+                                  process_index=jax.process_index())
+        except Exception as e:  # noqa: BLE001 — observability only
+            logging.getLogger(__name__).warning(
+                "timeline flush failed (%s: %s)", type(e).__name__, e)
 
     # ------------------------------------------------------------------
     def run_test_protocol(self) -> Dict[str, Any]:

@@ -61,6 +61,11 @@ class TaskResult(NamedTuple):
     support_loss: jax.Array    # mean support loss over inner steps
     bn_state: State            # post-task norm state (discard at eval)
     per_step_target_losses: jax.Array  # (K,) (zeros when MSL off)
+    per_step_support_losses: jax.Array  # (K,) pre-update support loss at
+                                        # each inner step — the adaptation
+                                        # trajectory the health
+                                        # diagnostics (telemetry/health.py)
+                                        # surface per outer step
 
 
 def split_fast_slow(cfg: MAMLConfig,
@@ -319,4 +324,5 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
         support_loss=jnp.mean(s_losses),
         bn_state=bn,
         per_step_target_losses=t_losses,
+        per_step_support_losses=s_losses,
     )

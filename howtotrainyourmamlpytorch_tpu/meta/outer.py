@@ -176,6 +176,13 @@ class StepMetrics(NamedTuple):
     accuracy: jax.Array
     support_loss: jax.Array
     learning_rate: jax.Array
+    # In-graph training-health diagnostics (telemetry/health.py), a dict
+    # of small arrays — present iff cfg.health_metrics_every_n_steps > 0
+    # (a STATIC decision made at make_train_step time, so the disabled
+    # step's compiled HLO carries zero extra outputs; tier-1 pin in
+    # tests/test_health.py). None is a pytree node, not a leaf, so the
+    # experiment loop's per-epoch metric stacking is unchanged when off.
+    health: Optional[Dict[str, jax.Array]] = None
 
 
 def make_train_step(cfg: MAMLConfig, apply_fn, *,
@@ -202,6 +209,18 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
     schedule = meta_lr_schedule(cfg)
     num_steps = cfg.number_of_training_steps_per_iter
     learnable_lslr = cfg.learnable_per_layer_per_step_inner_loop_learning_rate
+    # Health diagnostics are a STATIC build decision (the watchdog
+    # zero-cost discipline): off means the step's traced graph and
+    # compiled HLO are exactly the pre-health ones — no extra aux, no
+    # wider pmean, no extra outputs (tests/test_health.py pins this
+    # structurally; tests/test_resilience.py pins bitwise weight parity).
+    # Imported here, not at module top: the telemetry package __init__
+    # pulls parallel/multihost, which imports back into meta.outer via
+    # parallel/__init__ — a cycle at import time, resolved by build time.
+    with_health = cfg.health_metrics_every_n_steps > 0
+    if with_health:
+        from howtotrainyourmamlpytorch_tpu.telemetry import (
+            health as health_mod)
 
     num_micro = cfg.task_microbatches  # >= 1, validated by the config
     if cfg.batch_size % num_micro != 0:
@@ -234,11 +253,18 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
                                   res.bn_state)
             aux = (jnp.mean(res.target_accuracy),
                    jnp.mean(res.support_loss), new_bn)
+            if with_health:
+                # Per-inner-step loss trajectories, task-shard-meaned —
+                # they ride the same aux tuple (and pmean) as the other
+                # step means, so microbatch accumulation and the mesh
+                # reduction treat them identically.
+                aux = aux + (jnp.mean(res.per_step_support_losses, axis=0),
+                             jnp.mean(res.per_step_target_losses, axis=0))
             return loss, aux
 
         trainable = {"params": state.params, "lslr": state.lslr}
         if num_micro <= 1:
-            (loss, (acc, s_loss, new_bn)), grads = jax.value_and_grad(
+            (loss, aux), grads = jax.value_and_grad(
                 batch_loss, has_aux=True)(trainable, state.bn_state, batch)
         else:
             # Gradient accumulation over task micro-batches: the memory
@@ -267,15 +293,30 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
                             lambda x: x[0], chunked)),
                     trainable, state.bn_state))
             acc_out, _ = jax.lax.scan(one_chunk, zero, chunked)
-            ((loss, (acc, s_loss, new_bn)), grads) = jax.tree.map(
+            ((loss, aux), grads) = jax.tree.map(
                 lambda a: a / num_micro, acc_out)
 
         if reduce_axes:
             # Local task-shard means -> global means: one fused pmean of
             # (grads, loss, aux). Every device then performs a bitwise-
             # identical optimizer update, keeping the state replicated.
-            (grads, loss, acc, s_loss, new_bn) = jax.lax.pmean(
-                (grads, loss, acc, s_loss, new_bn), axis_name=reduce_axes)
+            (grads, loss, aux) = jax.lax.pmean(
+                (grads, loss, aux), axis_name=reduce_axes)
+        ps_support = ps_target = None
+        if with_health:
+            acc, s_loss, new_bn, ps_support, ps_target = aux
+        else:
+            acc, s_loss, new_bn = aux
+        # Grad-side health reads the POST-pmean, PRE-clamp meta-gradient
+        # — the raw signal, before the lslr/γ/β zeroing and the clamp
+        # mutate the dict in place below. Through an optimization_barrier
+        # so the norm reductions cannot fuse into (and re-round) the
+        # grad producers; the slow parity test pins that health-on
+        # weights stay bitwise health-off (see the post-update health
+        # block below for the companion outputs-only constraint).
+        health = (health_mod.grad_health(
+                      jax.lax.optimization_barrier(grads))
+                  if with_health else None)
 
         if not learnable_lslr:
             grads["lslr"] = jax.tree.map(jnp.zeros_like, grads["lslr"])
@@ -300,11 +341,25 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
             updates, new_opt_state = optimizer.update(
                 grads, state.opt_state, trainable)
             new_trainable = optax.apply_updates(trainable, updates)
+        lr = schedule(state.step)
+        if with_health:
+            # PARITY CONSTRAINT (telemetry/health.py § update_health):
+            # post-update diagnostics consume executable OUTPUTS only
+            # (new trainables, new Adam moments, the lr scalar the
+            # metrics already carry) — an extra consumer on an internal
+            # value like the optax ``updates`` tree re-lowers the update
+            # chain's fusions, and that re-rounding amplifies through
+            # Adam's near-zero-variance denominators into real weight
+            # divergence (measured on XLA CPU; slow parity test pins
+            # bitwise on/off equality).
+            health.update(health_mod.update_health(
+                cfg, new_trainable, new_opt_state, lr,
+                ps_support, ps_target, msl_w))
         new_state = MetaTrainState(
             params=new_trainable["params"], lslr=new_trainable["lslr"],
             bn_state=new_bn, opt_state=new_opt_state, step=state.step + 1)
         metrics = StepMetrics(loss=loss, accuracy=acc, support_loss=s_loss,
-                              learning_rate=schedule(state.step))
+                              learning_rate=lr, health=health)
         return new_state, metrics
 
     return train_step

@@ -35,6 +35,7 @@ DEFAULT_CAPACITY = 256
 STACKS_FILE = "stacks.txt"
 FLIGHT_FILE = "flight.jsonl"
 CRASH_FILE = "crash.json"
+TRACE_FILE = "trace.json"
 
 
 class FlightRecorder:
@@ -111,7 +112,8 @@ def record(kind: str, **fields: Any) -> None:
 def write_crash_bundle(bundle_dir: str, *, reason: str,
                        info: Optional[Dict[str, Any]] = None,
                        recorder: Optional[FlightRecorder] = None,
-                       registry: Optional[Any] = None) -> str:
+                       registry: Optional[Any] = None,
+                       process_index: int = 0) -> str:
     """Write a crash bundle: all-thread stacks + flight ring + context.
 
     Layout (docs/RESILIENCE.md):
@@ -120,6 +122,10 @@ def write_crash_bundle(bundle_dir: str, *, reason: str,
       the "where was every thread" answer for a hang;
     * ``flight.jsonl`` — the flight recorder ring, oldest-first (absent
       when no recorder is installed);
+    * ``trace.json`` — the same ring rendered as a Chrome ``trace_event``
+      timeline (``telemetry/trace.py``; absent without a recorder), so a
+      watchdog trip yields a Perfetto-loadable picture of the last
+      seconds without any offline rebuild;
     * ``crash.json`` — reason, timestamps, the tripped phase/deadline
       info and a final registry snapshot.
 
@@ -138,6 +144,19 @@ def write_crash_bundle(bundle_dir: str, *, reason: str,
     if rec is not None:
         try:
             rec.dump_jsonl(os.path.join(bundle_dir, FLIGHT_FILE))
+        except Exception:
+            pass
+        try:
+            # Lazy import: flightrec stays stdlib-only at import time
+            # (telemetry's package __init__ pulls jax-importing modules);
+            # by the time a bundle is written the process has them loaded.
+            # process_index keeps the pid=host track layout honest on a
+            # pod (host N's bundle renders host N's track, not track 0).
+            from howtotrainyourmamlpytorch_tpu.telemetry import (
+                trace as _trace)
+            _trace.write_trace(os.path.join(bundle_dir, TRACE_FILE),
+                               flight=rec.events(),
+                               process_index=process_index)
         except Exception:
             pass
     crash: Dict[str, Any] = {"reason": reason, "ts": time.time(),

@@ -16,6 +16,12 @@ True; the caller (``ExperimentBuilder._perform_rewind``) rewinds to the
 last-good epoch checkpoint and re-seeds the train stream past the
 poisoned batch window. Patience exists so one transient spike (a hard
 batch) doesn't cost an epoch of progress.
+
+With the health subsystem enabled (telemetry/health.py,
+``health_metrics_every_n_steps``) the guard additionally observes the
+outer-grad global norm via :meth:`observe_grad_norm` — a pure EARLY
+WARNING (one log row + counter, strictly before any NaN-triggered
+rewind) that never changes recovery semantics.
 """
 
 from __future__ import annotations
@@ -36,15 +42,21 @@ class DivergenceGuard:
     design — exactly one train loop feeds it."""
 
     def __init__(self, patience: int = 2, spike_factor: float = 0.0,
-                 window: int = 32):
+                 window: int = 32, grad_norm_factor: float = 10.0):
         if patience < 1:
             raise ValueError(f"patience must be >= 1, got {patience}")
         if spike_factor != 0.0 and spike_factor <= 1.0:
             raise ValueError(
                 f"spike_factor must be 0 (off) or > 1, got {spike_factor}")
+        if grad_norm_factor != 0.0 and grad_norm_factor <= 1.0:
+            raise ValueError(
+                f"grad_norm_factor must be 0 (non-finite-only) or > 1, "
+                f"got {grad_norm_factor}")
         self.patience = int(patience)
         self.spike_factor = float(spike_factor)
+        self.grad_norm_factor = float(grad_norm_factor)
         self._history: Deque[float] = deque(maxlen=int(window))
+        self._norm_history: Deque[float] = deque(maxlen=int(window))
         self._bad_streak = 0
 
     def _is_spike(self, loss: float) -> bool:
@@ -76,8 +88,37 @@ class DivergenceGuard:
             return True
         return False
 
+    def observe_grad_norm(self, norm: float) -> bool:
+        """Feed one outer-grad global-norm scalar (the telemetry/health.py
+        diagnostic, fetched on the health cadence); True ⇒ warn NOW.
+
+        This is the EARLY-warning half of divergence detection: gradient
+        norms explode before the loss goes non-finite, so a warning here
+        lands in the log strictly before the NaN-triggered rewind — the
+        post-mortem then shows which step's gradients blew up, not just
+        that a rewind happened. A warning never changes rewind/recovery
+        semantics; it only counts (``health/grad_norm_warn``) and lets
+        the caller log. Warn on any non-finite norm, or — when
+        ``grad_norm_factor`` > 1 — on a norm above factor x the running
+        median of recent healthy norms (same median rule as the loss-
+        spike detector; bad observations stay out of the history).
+        """
+        norm = float(norm)
+        bad = not math.isfinite(norm)
+        if not bad and self.grad_norm_factor \
+                and len(self._norm_history) >= _MIN_HISTORY:
+            ordered = sorted(self._norm_history)
+            median = ordered[len(ordered) // 2]
+            bad = median > 0 and norm > self.grad_norm_factor * median
+        if bad:
+            resilience.counter_inc("health/grad_norm_warn")
+            return True
+        self._norm_history.append(norm)
+        return False
+
     def reset(self) -> None:
         """Forget streaks and history (after a rewind the loss scale may
         legitimately differ — stale medians must not re-trigger)."""
         self._bad_streak = 0
         self._history.clear()
+        self._norm_history.clear()

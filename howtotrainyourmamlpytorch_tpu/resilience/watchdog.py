@@ -255,7 +255,8 @@ class Watchdog:
         try:
             flightrec.write_crash_bundle(
                 self.bundle_dir, reason=f"hung_{info['phase']}",
-                info=info, registry=self.registry)
+                info=info, registry=self.registry,
+                process_index=self.process_index)
         except Exception:
             pass
         if self.jsonl is not None:

@@ -400,6 +400,29 @@ class ServingEngine:
         return logits
 
     # -- telemetry -------------------------------------------------------
+    def export_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Render this serving process's recent activity (serve_request/
+        compile/idle phase spans, serve_batch markers) as a Chrome-trace
+        timeline (telemetry/trace.py) from the process flight recorder.
+
+        Returns the written path, or None when no recorder is installed
+        (the engine installs one iff it owns the watchdog — a training-
+        owned process renders through the experiment loop's per-epoch
+        flush instead). Default path:
+        ``<experiment_root>/<name>/logs/trace_serve.json``.
+        """
+        rec = flightrec.get()
+        if rec is None:
+            return None
+        if path is None:
+            path = os.path.join(self.cfg.experiment_root,
+                                self.cfg.experiment_name, "logs",
+                                "trace_serve.json")
+        from howtotrainyourmamlpytorch_tpu.telemetry import trace
+        trace.write_trace(path, flight=rec.events(),
+                          process_index=jax.process_index())
+        return path
+
     def _mirror_cache_counters(self) -> None:
         """LRU counts -> monotonic registry counters (delta-mirrored:
         the cache keeps plain ints so it stays registry-agnostic)."""

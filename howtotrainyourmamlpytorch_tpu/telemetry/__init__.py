@@ -12,6 +12,12 @@ from howtotrainyourmamlpytorch_tpu.telemetry.aggregate import (
     heartbeat_rows,
     host_step_skew,
 )
+from howtotrainyourmamlpytorch_tpu.telemetry.health import (
+    GRAD_NORM_WARN_COUNTER,
+    GRAD_NORM_WARN_EVENT,
+    HEALTH_EVENT,
+    publish_health,
+)
 from howtotrainyourmamlpytorch_tpu.telemetry.instruments import (
     COMPILE_COUNT,
     COMPILE_SECONDS,
@@ -32,11 +38,18 @@ from howtotrainyourmamlpytorch_tpu.telemetry.report import (
     format_table,
     summarize_events,
 )
+from howtotrainyourmamlpytorch_tpu.telemetry.trace import (
+    build_trace,
+    validate_trace,
+    write_trace,
+)
 
 __all__ = [
     "COMPILE_COUNT", "COMPILE_SECONDS", "CompileWatcher", "Counter",
-    "FeedStallMeter", "Gauge", "Histogram", "MetricsRegistry", "SCHEMA",
-    "UNAVAILABLE", "device_memory_stats", "emit_heartbeat",
+    "FeedStallMeter", "GRAD_NORM_WARN_COUNTER", "GRAD_NORM_WARN_EVENT",
+    "Gauge", "HEALTH_EVENT", "Histogram", "MetricsRegistry", "SCHEMA",
+    "UNAVAILABLE", "build_trace", "device_memory_stats", "emit_heartbeat",
     "exponential_buckets", "format_table", "heartbeat_rows",
-    "host_step_skew", "summarize_events",
+    "host_step_skew", "publish_health", "summarize_events",
+    "validate_trace", "write_trace",
 ]

@@ -1,0 +1,210 @@
+"""Optimization-health introspection: in-graph training diagnostics.
+
+MAML++ exists because plain MAML's outer optimization is unstable
+(PAPER.md): MSL annealing, per-layer/per-step LSLR and derivative-order
+annealing all exist to tame the meta-gradient — yet until this module
+the telemetry plane only ever saw ONE scalar of that struggle, the
+outer loss. When a run NaN-rewinds we learned *that* it diverged, never
+*which layer's* gradients exploded, whether the learned LSLR rates
+collapsed or blew up, or how the MSL schedule interacted with it.
+
+This module closes that gap in two halves:
+
+* :func:`grad_health` / :func:`update_health` — pure functions traced
+  INSIDE the already-compiled train step (``meta/outer.py §
+  make_train_step``) when ``health_metrics_every_n_steps`` > 0: outer-
+  grad global norm, per-top-level-layer grad norms and update-to-param
+  ratios, per-layer LSLR min/mean/max over the trained rows (plus a
+  count of dead/negative entries), the MSL importance vector, and the
+  per-inner-step support/target loss trajectories the inner loop
+  already materializes (``TaskResult.per_step_*_losses``). Everything
+  is a handful of norms over buffers the step already holds — measured
+  noise on the step time — and with the knob at 0 the step's compiled
+  HLO carries ZERO extra outputs (tier-1 structural pin in
+  tests/test_health.py; slow bitwise weight + compile-count parity in
+  tests/test_resilience.py — the watchdog zero-cost discipline).
+
+* :func:`publish_health` — the host half: the experiment loop fetches
+  the dict at its existing dispatch-sync points (one extra transfer on
+  a fetch that syncs anyway, never an extra device sync) on the
+  configured cadence, routes scalars through the MetricsRegistry as
+  ``health/*`` gauges and logs one ``health`` event row carrying the
+  vectors. The outer-grad norm additionally feeds
+  ``DivergenceGuard.observe_grad_norm`` (resilience/guard.py), whose
+  warning fires BEFORE the NaN that triggers a rewind.
+
+``scripts/telemetry_report.py`` renders the v6 "health" section from
+these rows; docs/OBSERVABILITY.md walks a divergence post-mortem
+through them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+# events.jsonl row carrying one fetched health snapshot.
+HEALTH_EVENT = "health"
+# events.jsonl row + registry counter for the guard's grad-norm warning.
+GRAD_NORM_WARN_EVENT = "health_grad_norm_warn"
+GRAD_NORM_WARN_COUNTER = "health/grad_norm_warn"
+
+# Keys in the in-graph health dict that are vectors (logged to the
+# health row, never to scalar gauges).
+_VECTOR_KEYS = ("msl_importance", "per_step_support_loss",
+                "per_step_target_loss")
+
+_EPS = 1e-12  # update-ratio denominator guard (a zero-norm layer —
+              # e.g. a beta init — must read ratio 0/eps, not NaN)
+
+
+def _subtree_norm(tree: Any) -> jax.Array:
+    """Global L2 norm over every leaf of ``tree``, accumulated in f32."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    total = sum(jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+                for leaf in leaves)
+    return jnp.sqrt(total)
+
+
+def grad_health(grads: Dict[str, Any]) -> Dict[str, jax.Array]:
+    """Gradient-side diagnostics, computed from the POST-pmean, PRE-clamp
+    meta-gradient (the raw signal — a clamp that is doing heavy lifting
+    should be visible as grad_norm >> the clamped update, not hidden).
+
+    Keys: ``grad_norm`` (global, params ∪ lslr — the whole meta-
+    gradient) and ``grad_norm/<layer>`` per top-level parameter layer.
+    """
+    health: Dict[str, jax.Array] = {"grad_norm": _subtree_norm(grads)}
+    for name in sorted(grads["params"]):
+        health[f"grad_norm/{name}"] = _subtree_norm(grads["params"][name])
+    return health
+
+
+def _find_adam_moments(opt_state: Any):
+    """(count, mu, nu) of the first optimizer-chain entry carrying Adam
+    moments (the duck-typing ``meta/outer.py § migrate_lslr_rows`` also
+    uses); None when the optimizer has no such entry."""
+    entries = opt_state if isinstance(opt_state, tuple) else (opt_state,)
+    for entry in entries:
+        mu = getattr(entry, "mu", None)
+        nu = getattr(entry, "nu", None)
+        if mu is not None and nu is not None:
+            return getattr(entry, "count", None), mu, nu
+    return None
+
+
+def update_health(cfg: Any, new_trainable: Dict[str, Any],
+                  new_opt_state: Any, learning_rate: jax.Array,
+                  per_step_support_loss: jax.Array,
+                  per_step_target_loss: jax.Array,
+                  msl_weights: Optional[jax.Array]
+                  ) -> Dict[str, jax.Array]:
+    """Post-update diagnostics: per-layer update-to-param ratios (the
+    classic "is this layer learning or thrashing" number), LSLR row
+    statistics over the trained rows, and the per-inner-step loss
+    trajectories. ``msl_weights`` is the traced MSL importance vector
+    (None outside the MSL window — statically absent then, matching the
+    phase-keyed executables).
+
+    PARITY CONSTRAINT (the reason for the signature): everything here is
+    computed from executable OUTPUTS only — the post-update trainables
+    and the post-update Adam moments — never from internal values like
+    the optax ``updates`` tree or the donated input params. An extra
+    consumer on an internal value re-lowers the update chain's fusions,
+    and the re-rounding that causes gets amplified through Adam's
+    near-zero-variance denominators into real weight divergence
+    (measured on XLA CPU); consumers on values that are already outputs
+    leave the training computation's lowering untouched, which is what
+    keeps health-on weight-bitwise-identical to health-off
+    (tests/test_resilience.py slow parity). The Adam update is therefore
+    RECONSTRUCTED from the new moments — the same
+    ``lr·m̂/(√v̂ + eps)`` optax computed, from the same (mu, nu, count)
+    — bit-equal inputs, diagnostic-grade equal outputs.
+    """
+    health: Dict[str, jax.Array] = {}
+    moments = _find_adam_moments(new_opt_state)
+    if moments is not None and moments[0] is not None:
+        count, mu, nu = moments
+        b1, b2 = cfg.meta_adam_beta1, cfg.meta_adam_beta2
+        bc1 = 1.0 - b1 ** count.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def update_leaf(m, v):
+            mhat = m.astype(jnp.float32) / bc1
+            vhat = v.astype(jnp.float32) / bc2
+            return learning_rate * mhat / (jnp.sqrt(vhat)
+                                           + cfg.meta_adam_eps)
+
+        ratios = []
+        for name in sorted(new_trainable["params"]):
+            p = _subtree_norm(new_trainable["params"][name])
+            u = _subtree_norm(jax.tree.map(
+                update_leaf, mu["params"][name], nu["params"][name]))
+            ratio = u / (p + _EPS)
+            health[f"update_ratio/{name}"] = ratio
+            ratios.append(ratio)
+        health["update_ratio_max"] = jnp.max(jnp.stack(ratios))
+
+    # LSLR rows 0..K-1 are the rows gradients actually reach
+    # (meta/inner.py § lslr_init: the final +1 row keeps its init).
+    k = cfg.number_of_training_steps_per_iter
+    new_lslr = new_trainable["lslr"]
+    all_rows = []
+    for name in sorted(new_lslr):
+        rows = jnp.concatenate(
+            [leaf[:k].astype(jnp.float32).reshape(-1)
+             for leaf in jax.tree.leaves(new_lslr[name])])
+        health[f"lslr_min/{name}"] = jnp.min(rows)
+        health[f"lslr_mean/{name}"] = jnp.mean(rows)
+        health[f"lslr_max/{name}"] = jnp.max(rows)
+        all_rows.append(rows)
+    flat = jnp.concatenate(all_rows)
+    health["lslr_min"] = jnp.min(flat)
+    health["lslr_mean"] = jnp.mean(flat)
+    health["lslr_max"] = jnp.max(flat)
+    # Dead/negative rows: a learned per-step LR at or below zero means
+    # that (layer, step) update is off or ascending — the LSLR collapse
+    # mode the MAML++ paper's per-layer rates exist to avoid.
+    health["lslr_nonpositive"] = jnp.sum(flat <= 0.0).astype(jnp.float32)
+
+    health["per_step_support_loss"] = per_step_support_loss
+    health["per_step_target_loss"] = per_step_target_loss
+    if msl_weights is not None:
+        health["msl_importance"] = msl_weights[:k]
+    return health
+
+
+def _gauge_name(key: str) -> str:
+    """Map an in-graph health key to its registry gauge name."""
+    for prefix, fmt in (("grad_norm/", "health/layer/{}/grad_norm"),
+                        ("update_ratio/", "health/layer/{}/update_ratio"),
+                        ("lslr_min/", "health/lslr/{}/min"),
+                        ("lslr_mean/", "health/lslr/{}/mean"),
+                        ("lslr_max/", "health/lslr/{}/max")):
+        if key.startswith(prefix):
+            return fmt.format(key[len(prefix):])
+    return f"health/{key}"
+
+
+def publish_health(registry: Any, jsonl: Any, fetched: Dict[str, Any], *,
+                   iteration: int, epoch: Optional[int] = None
+                   ) -> Dict[str, Any]:
+    """Route one fetched health snapshot: scalars → ``health/*`` gauges,
+    vectors + scalars → ONE ``health`` event row (the report's source).
+    Every process may call this; the single-writer discipline rides the
+    logger's ``enabled`` flag like every other row."""
+    row: Dict[str, Any] = {"iter": iteration}
+    if epoch is not None:
+        row["epoch"] = epoch
+    for key, value in fetched.items():
+        if key in _VECTOR_KEYS:
+            row[key] = [float(v) for v in value]
+            continue
+        value = float(value)
+        row[key] = value
+        registry.gauge(_gauge_name(key)).set(value)
+    return jsonl.log(HEALTH_EVENT, **row)

@@ -16,8 +16,9 @@ import math
 from typing import Any, Dict, List, Optional, Union
 
 # v2: + "serving"; v3: + "resilience"; v4: + "data" (datastore
-# subsystem); v5: + "watchdog" (hang detection / flight recorder)
-SCHEMA = "maml_tpu_telemetry_report_v5"
+# subsystem); v5: + "watchdog" (hang detection / flight recorder);
+# v6: + "health" (optimization-health introspection, telemetry/health.py)
+SCHEMA = "maml_tpu_telemetry_report_v6"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -263,6 +264,70 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "progress_age_seconds": wd_age,
         }
 
+    # Health section (telemetry/health.py, schema v6): "health" event
+    # rows carry each fetched snapshot (last grad norm + msl vector win
+    # in log order; the per-layer ratio and lslr bounds report their
+    # run-wide extremes — a transient blow-up must not be hidden by a
+    # calm final row); the guard's warning counter accumulates
+    # reset-aware across preempt/restart segments like the watchdog's,
+    # cross-checked against explicit health_grad_norm_warn event rows.
+    # Runs without health metrics summarize to "unavailable".
+    h_seen = False
+    h_grad: Metric = UNAVAILABLE
+    h_ratio: Optional[float] = None
+    h_lslr_min: Optional[float] = None
+    h_lslr_max: Optional[float] = None
+    h_msl: Union[List[float], str] = UNAVAILABLE
+    h_warn_totals: Dict[str, float] = {}
+    h_warn_prev: Dict[str, float] = {}
+    h_warn_rows = 0
+    for e in events:
+        if e.get("event") == "health":
+            h_seen = True
+            if isinstance(e.get("grad_norm"), (int, float)):
+                h_grad = round(float(e["grad_norm"]), 6)
+            elif "grad_norm" in e:
+                h_grad = "non-finite"  # the logger nulls NaN/Inf; a
+                #                        present-but-null norm IS the
+                #                        diagnosis
+            v = e.get("update_ratio_max")
+            if isinstance(v, (int, float)):
+                h_ratio = max(h_ratio, float(v)) \
+                    if h_ratio is not None else float(v)
+            v = e.get("lslr_min")
+            if isinstance(v, (int, float)):
+                h_lslr_min = min(h_lslr_min, float(v)) \
+                    if h_lslr_min is not None else float(v)
+            v = e.get("lslr_max")
+            if isinstance(v, (int, float)):
+                h_lslr_max = max(h_lslr_max, float(v)) \
+                    if h_lslr_max is not None else float(v)
+            if isinstance(e.get("msl_importance"), list):
+                h_msl = [round(float(w), 6) for w in e["msl_importance"]]
+        elif e.get("event") == "health_grad_norm_warn":
+            h_seen = True
+            h_warn_rows += 1
+        elif e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if m.get("health/grad_norm_warn") is not None:
+                h_seen = True
+                _accumulate_counter(h_warn_totals, h_warn_prev, "warns",
+                                    float(m["health/grad_norm_warn"]))
+    health_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if h_seen:
+        health_sec = {
+            "grad_norm": h_grad,
+            "update_ratio_max": (round(h_ratio, 6)
+                                 if h_ratio is not None else UNAVAILABLE),
+            "lslr_min": (round(h_lslr_min, 6)
+                         if h_lslr_min is not None else UNAVAILABLE),
+            "lslr_max": (round(h_lslr_max, 6)
+                         if h_lslr_max is not None else UNAVAILABLE),
+            "msl_importance": h_msl,
+            "grad_norm_warns": max(int(h_warn_totals.get("warns", 0)),
+                                   h_warn_rows),
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -295,6 +360,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "resilience": resilience_sec,
         "data": data_sec,
         "watchdog": watchdog_sec,
+        "health": health_sec,
     }
 
 
@@ -325,6 +391,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("resilience", summary["resilience"]),
         ("data plane", summary["data"]),
         ("watchdog", summary["watchdog"]),
+        ("health", summary["health"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

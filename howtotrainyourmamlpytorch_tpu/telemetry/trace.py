@@ -1,0 +1,247 @@
+"""Chrome ``trace_event`` timeline export: the run's last hours as a
+picture you can scrub.
+
+The watchdog beacon stamps named phases, the flight recorder rings the
+last N events, the experiment loop logs epoch/heartbeat/checkpoint rows
+— rich timeline data with, until this module, no human-viewable
+rendering. This module synthesizes all of it into the Chrome
+``trace_event`` JSON format (the JSON Array/Object format documented by
+the Trace Event Profiling Tool spec), loadable in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``:
+
+* **flight-ring phase rows** (``resilience/flightrec.py``) become
+  complete-duration ``"X"`` spans: consecutive ``phase`` transitions
+  bound each span, so the step/feed/collective/compile/serve_request
+  cadence of the final seconds is directly visible. Non-phase ring
+  events (fault injections, serve batches, watchdog trips) become
+  instant ``"i"`` markers.
+* **events.jsonl rows** become the coarse, whole-run layer: one ``"X"``
+  span per ``train_epoch`` (the row carries ``epoch_seconds``), per-host
+  ``"i"`` markers from each ``heartbeat`` row (one track per host — a
+  straggler's rising progress age is visible at a glance), and ``"i"``
+  markers for checkpoints, rewinds, preemptions, watchdog trips and
+  grad-norm warnings.
+
+Track layout: ``pid`` = host (process index), ``tid`` = phase class
+(:data:`PHASE_TIDS`), so a pod renders as one row of phase lanes per
+host. All timestamps are unix-epoch microseconds (the ``ts`` field both
+sources already carry), so flight and JSONL layers align on one clock.
+
+Consumers: ``ExperimentBuilder`` flushes ``logs/trace.json`` (+
+``logs/flight.jsonl``) per epoch, ``write_crash_bundle`` drops a
+``trace.json`` next to ``flight.jsonl`` so a watchdog trip yields a
+directly loadable timeline, ``ServingEngine.export_trace`` renders a
+serving process, and ``scripts/trace_export.py`` rebuilds a timeline
+offline from any ``events.jsonl`` + ``flight.jsonl``.
+
+Stdlib-only by design (the telemetry_report.py rule): the CLI loads this
+module by file path so a login node without an accelerator runtime can
+render timelines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+# tid per phase class — one lane per phase kind within a host's track.
+PHASE_TIDS: Dict[str, int] = {
+    "epoch": 0,
+    "step": 1,
+    "feed": 2,
+    "collective": 3,
+    "compile": 4,
+    "serve_request": 5,
+    "idle": 6,
+    "init": 7,
+}
+HEARTBEAT_TID = 8   # per-host heartbeat markers
+MARKER_TID = 9      # instant markers (checkpoints, trips, faults, ...)
+_UNKNOWN_TID = 10   # future phase names degrade here, never crash
+
+# events.jsonl rows rendered as instant markers on the marker lane.
+_INSTANT_EVENTS = (
+    "checkpoint", "preempt_checkpoint", "rewind", "watchdog_trip",
+    "validation", "health_grad_norm_warn",
+)
+
+_VALID_PH = {"B", "E", "X", "i"}
+
+
+def _us(ts: Any) -> int:
+    return int(float(ts) * 1e6)
+
+
+def _args(row: Dict[str, Any], skip: tuple) -> Dict[str, Any]:
+    return {k: v for k, v in row.items()
+            if k not in skip and isinstance(v, (str, int, float, bool))}
+
+
+def spans_from_flight(flight: List[Dict[str, Any]],
+                      process_index: int = 0) -> List[Dict[str, Any]]:
+    """Trace events from a flight-recorder ring (oldest-first rows as
+    ``FlightRecorder.dump_jsonl``/``events()`` produce them).
+
+    Each ``phase`` row opens a span that the NEXT ring event closes (a
+    stamp is the claim "I am now doing <phase>", so the following event
+    bounds it); the final still-open phase closes at the last event's
+    timestamp with a minimum 1 µs width — it is the state the ring was
+    dumped in. Non-phase rows (faults, serve batches, trips) are instant
+    markers carrying their payload as ``args``.
+    """
+    out: List[Dict[str, Any]] = []
+    open_phase: Optional[tuple] = None  # (phase, detail, ts)
+    last_ts: Optional[float] = None
+
+    def close(end_ts: float) -> None:
+        phase, detail, start_ts = open_phase
+        out.append({
+            "name": str(phase), "cat": "phase", "ph": "X",
+            "ts": _us(start_ts),
+            "dur": max(_us(end_ts) - _us(start_ts), 1),
+            "pid": process_index,
+            "tid": PHASE_TIDS.get(str(phase), _UNKNOWN_TID),
+            "args": {"detail": detail} if detail is not None else {},
+        })
+
+    for row in flight:
+        ts = row.get("ts")
+        if ts is None:
+            continue
+        last_ts = ts
+        if row.get("kind") == "phase":
+            if open_phase is not None:
+                close(ts)
+            open_phase = (row.get("phase", "?"), row.get("detail"), ts)
+        else:
+            out.append({
+                "name": str(row.get("kind")), "cat": "flight", "ph": "i",
+                "ts": _us(ts), "pid": process_index, "tid": MARKER_TID,
+                "s": "t",  # thread-scoped instant
+                "args": _args(row, skip=("t", "ts", "kind")),
+            })
+    if open_phase is not None and last_ts is not None:
+        close(last_ts)
+    return out
+
+
+def spans_from_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Trace events from an ``events.jsonl`` stream: whole-run epoch
+    spans, per-host heartbeat markers (``pid`` = host index from the
+    gathered vectors), and instant markers for the run-lifecycle rows."""
+    out: List[Dict[str, Any]] = []
+    for row in events:
+        event = row.get("event")
+        ts = row.get("ts")
+        if ts is None:
+            continue
+        if (event == "train_epoch"
+                and isinstance(row.get("epoch_seconds"), (int, float))
+                and row["epoch_seconds"] >= 0):
+            dur = float(row["epoch_seconds"])
+            out.append({
+                "name": f"epoch {row.get('epoch')}", "cat": "epoch",
+                "ph": "X", "ts": _us(ts - dur), "dur": max(_us(dur), 1),
+                "pid": int(row.get("process_index") or 0),
+                "tid": PHASE_TIDS["epoch"],
+                "args": _args(row, skip=("ts", "event")),
+            })
+        elif event == "heartbeat":
+            means = row.get("host_mean_step_seconds") or [None]
+            ages = row.get("host_progress_age_seconds") or []
+            for host, mean in enumerate(means):
+                args: Dict[str, Any] = {"epoch": row.get("epoch"),
+                                        "iter": row.get("iter")}
+                if mean is not None:
+                    args["mean_step_seconds"] = mean
+                if host < len(ages):
+                    args["progress_age_seconds"] = ages[host]
+                if row.get("progress_phase") is not None:
+                    args["progress_phase"] = row["progress_phase"]
+                out.append({
+                    "name": "heartbeat", "cat": "heartbeat", "ph": "i",
+                    "ts": _us(ts), "pid": host, "tid": HEARTBEAT_TID,
+                    "s": "t", "args": args,
+                })
+        elif event in _INSTANT_EVENTS:
+            out.append({
+                "name": str(event), "cat": "event", "ph": "i",
+                "ts": _us(ts),
+                "pid": int(row.get("process_index") or 0),
+                "tid": MARKER_TID, "s": "t",
+                "args": _args(row, skip=("ts", "event")),
+            })
+    return out
+
+
+def build_trace(events: Optional[List[Dict[str, Any]]] = None,
+                flight: Optional[List[Dict[str, Any]]] = None,
+                process_index: int = 0) -> Dict[str, Any]:
+    """Assemble one Chrome-trace object from either or both sources.
+    Events are globally ts-sorted, which makes every (pid, tid) track
+    monotone — the invariant viewers assume and tests pin."""
+    trace_events: List[Dict[str, Any]] = []
+    if flight:
+        trace_events += spans_from_flight(flight, process_index)
+    if events:
+        trace_events += spans_from_events(events)
+    trace_events.sort(key=lambda e: (e["ts"], e["pid"], e["tid"]))
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def trace_stats(trace: Dict[str, Any]) -> Dict[str, Any]:
+    """Span/instant/host counts of a built trace (the CLI artifact's
+    payload)."""
+    rows = trace.get("traceEvents", [])
+    return {
+        "events": len(rows),
+        "spans": sum(1 for e in rows if e.get("ph") == "X"),
+        "instants": sum(1 for e in rows if e.get("ph") == "i"),
+        "hosts": len({e.get("pid") for e in rows}) if rows else 0,
+    }
+
+
+def validate_trace(trace: Dict[str, Any]) -> None:
+    """Raise ValueError unless ``trace`` is schema-valid: every event
+    has ``ph`` ∈ {B, E, X, i} with int ``ts``/``pid``/``tid``, X spans
+    carry positive ``dur``, and each (pid, tid) track's timestamps are
+    monotone. The test suite's (and CI's) single validity gate."""
+    rows = trace.get("traceEvents")
+    if not isinstance(rows, list):
+        raise ValueError("trace has no traceEvents list")
+    last_ts: Dict[tuple, int] = {}
+    for i, e in enumerate(rows):
+        if e.get("ph") not in _VALID_PH:
+            raise ValueError(f"event {i}: bad ph {e.get('ph')!r}")
+        for field in ("ts", "pid", "tid"):
+            if not isinstance(e.get(field), int):
+                raise ValueError(f"event {i}: non-int {field}")
+        if e["ph"] == "X" and not (isinstance(e.get("dur"), int)
+                                   and e["dur"] > 0):
+            raise ValueError(f"event {i}: X span without positive dur")
+        if not e.get("name"):
+            raise ValueError(f"event {i}: missing name")
+        track = (e["pid"], e["tid"])
+        if e["ts"] < last_ts.get(track, e["ts"]):
+            raise ValueError(
+                f"event {i}: ts not monotone on track pid={e['pid']} "
+                f"tid={e['tid']}")
+        last_ts[track] = e["ts"]
+
+
+def write_trace(path: str,
+                events: Optional[List[Dict[str, Any]]] = None,
+                flight: Optional[List[Dict[str, Any]]] = None,
+                process_index: int = 0) -> Dict[str, Any]:
+    """Build and atomically write ``trace.json``; returns the stats dict
+    (plus ``path``). Atomic rename so a viewer/scraper never loads a
+    torn file — the metrics.prom discipline."""
+    trace = build_trace(events=events, flight=flight,
+                        process_index=process_index)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, path)
+    return {**trace_stats(trace), "path": path}

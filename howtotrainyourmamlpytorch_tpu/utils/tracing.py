@@ -74,9 +74,16 @@ class JsonlLogger:
         return row
 
 
-def read_jsonl(path: str) -> List[Dict[str, Any]]:
+def read_jsonl(path: str,
+               tail: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Parse a JSONL file; ``tail`` parses only the last N lines (for
+    per-epoch consumers of an append-only log that grows with the run —
+    skipping the parse of old rows keeps the cost bounded)."""
     with open(path) as f:
-        return [json.loads(line) for line in f if line.strip()]
+        lines = f.readlines()
+    if tail is not None:
+        lines = lines[-tail:]
+    return [json.loads(line) for line in lines if line.strip()]
 
 
 def nearest_rank(sorted_values: Sequence[float], q: float) -> float:

@@ -22,9 +22,12 @@ Runs the ISSUE 3 acceptance scenario on a tiny synthetic config:
    'latest' resumes past the hang and finishes.
 
 The verdict requires `resilience/rewinds >= 1`, `resilience/io_retries
->= 1`, exactly one preemption, hang exit code 74 + bundle present +
-hang-restart completion, and final test accuracies (restart AND
-hang-restart) within ``--tolerance`` of the baseline.
+>= 1`, exactly one preemption, the health subsystem's grad-norm early
+warning landing strictly BEFORE the rewind in the faulted phase's log
+(ISSUE 7 — `health_grad_norm_warn` precedes `rewind`), hang exit code
+74 + bundle present + hang-restart completion, and final test
+accuracies (restart AND hang-restart) within ``--tolerance`` of the
+baseline.
 
 Artifact contract (bench.py discipline): the LAST stdout JSON line is
 authoritative — ``{"metric": "chaos_recovery", "status":
@@ -68,7 +71,11 @@ def tiny_cfg(out_dir: str, name: str, **kw):
         # Sync every iteration: the guard/fault hooks live at the
         # dispatch-sync points, and a chaos run wants tight granularity.
         dispatch_sync_every=1, live_progress=False,
-        divergence_patience=1)
+        divergence_patience=1,
+        # Health introspection ON (telemetry/health.py): the faulted
+        # phase must show the guard's grad-norm early warning landing
+        # strictly BEFORE the rewind it foreshadows.
+        health_metrics_every_n_steps=1)
     base.update(kw)
     return MAMLConfig(**base)
 
@@ -152,6 +159,30 @@ def counter_sum(snapshots, key) -> int:
     return int(sum(float(s.get(key) or 0) for s in snapshots))
 
 
+def warn_precedes_rewind(events_path: str):
+    """(warn_rows, warn_before_rewind) from a phase's events.jsonl: the
+    guard's grad-norm early warning (telemetry/health.py) must land in
+    log order strictly BEFORE the rewind it foreshadows — the ordering a
+    real divergence produces and the acceptance criterion pins."""
+    warn_idx = rewind_idx = None
+    warns = 0
+    if os.path.exists(events_path):
+        with open(events_path) as f:
+            for i, line in enumerate(f):
+                if not line.strip():
+                    continue
+                event = json.loads(line).get("event")
+                if event == "health_grad_norm_warn":
+                    warns += 1
+                    if warn_idx is None:
+                        warn_idx = i
+                elif event == "rewind" and rewind_idx is None:
+                    rewind_idx = i
+    before = (warn_idx is not None and rewind_idx is not None
+              and warn_idx < rewind_idx)
+    return warns, before
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Deterministic chaos run: inject faults, prove "
@@ -214,6 +245,12 @@ def main(argv=None) -> int:
     hang_restart_result, _ = run_phase(
         tiny_cfg(out, "chaos_hang", continue_from_epoch="latest"))
 
+    # Health early warning (ISSUE 7): the injected NaN poisons the
+    # observed grad norm too, so the faulted phase's log must read
+    # warn -> rewind in that order.
+    grad_norm_warns, warn_before_rewind = warn_precedes_rewind(
+        os.path.join(out, "chaos_faulted", "logs", "events.jsonl"))
+
     chaos_phases = [faulted_counters, restart_counters]
     rewinds = counter_sum(chaos_phases, "resilience/rewinds")
     io_retries = counter_sum(chaos_phases, "resilience/io_retries")
@@ -239,6 +276,7 @@ def main(argv=None) -> int:
 
     recovered = bool(
         preempted and rewinds >= 1 and io_retries >= 1
+        and warn_before_rewind
         and chaos_acc is not None
         and delta is not None and delta <= args.tolerance
         and hang_recovered)
@@ -257,6 +295,8 @@ def main(argv=None) -> int:
         "rewinds": rewinds,
         "io_retries": io_retries,
         "quarantined": quarantined,
+        "grad_norm_warns": grad_norm_warns,
+        "grad_norm_warn_before_rewind": warn_before_rewind,
         "preempted": preempted,
         "preempted_at_iter": (faulted_result or {}).get(
             "preempted_at_iter"),

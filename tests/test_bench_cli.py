@@ -47,6 +47,12 @@ def test_bench_quick_emits_full_capture_contract():
     # config resolves to the synthetic fallback.
     assert first["dataset_open_seconds"] > 0
     assert first["dataset_source_kind"] == "synthetic"
+    # Health keys (ISSUE 7): fail-soft null when the benched config
+    # leaves health_metrics_every_n_steps at 0 (the flagship default) —
+    # the serve-field convention. The non-null producer is the
+    # health-enabled --config leg (test below).
+    assert first["outer_grad_norm"] is None
+    assert first["health_overhead_frac"] is None
     # The authoritative LAST line is a strict superset with all three
     # measurement groups.
     for key in ("value", "run_weighted_tasks_per_sec_per_chip",
@@ -57,6 +63,38 @@ def test_bench_quick_emits_full_capture_contract():
     assert last["strict_b8_tasks_per_sec_per_chip"] > 0
     for key, val in first.items():
         assert last.get(key) == val, f"superset violated at {key}"
+
+
+@pytest.mark.slow
+def test_bench_health_enabled_config_fills_health_keys(tmp_path):
+    """A --config workload with health_metrics_every_n_steps > 0 benches
+    the health-on executable and fills outer_grad_norm (one fetched
+    step) + health_overhead_frac (a brief health-off leg) — the non-null
+    half of the fail-soft convention."""
+    cfg_path = os.path.join(REPO, "experiment_config",
+                            "mini-imagenet_maml++_5-way_5-shot_DA.json")
+    with open(cfg_path) as f:
+        cfg = json.load(f)
+    cfg["experiment_name"] = "bench_health_probe"  # not flagship-named:
+    #                          skips the run-weighted / strict-b8 legs
+    cfg["health_metrics_every_n_steps"] = 1
+    probe = tmp_path / "health_cfg.json"
+    probe.write_text(json.dumps(cfg))
+    env = dict(os.environ, MAML_JAX_PLATFORM="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--quick",
+         "--steps", "3", "--config", str(probe)],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    last = json.loads([ln for ln in r.stdout.splitlines()
+                       if ln.startswith("{")][-1])
+    assert "health_error" not in last, last
+    assert isinstance(last["outer_grad_norm"], float)
+    assert last["outer_grad_norm"] > 0
+    assert isinstance(last["health_overhead_frac"], float)
+    # Non-flagship --config: baseline ratio stays null, headline real.
+    assert last["vs_baseline"] is None
+    assert last["value"] > 0
 
 
 def test_bench_rejects_malformed_compiler_option():

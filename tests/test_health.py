@@ -1,0 +1,300 @@
+"""Optimization-health introspection units (ISSUE 7).
+
+Tier-1 keeps the cheap layers: the in-graph diagnostic math
+(telemetry/health.py) on synthetic pytrees, the config knob validation,
+the guard's grad-norm early-warning policy, the host-side publish
+routing, the STRUCTURAL zero-cost pin (health off ⇒ the compiled step
+has no extra outputs — the lowered output tree is exactly state +
+4 scalars), and a real tiny health-enabled run producing `health` rows
+and gauges. The bitwise weight + compile-count parity proof lives in
+tests/test_resilience.py's slow profile; the chaos warn-before-rewind
+proof in scripts/chaos_run.py.
+"""
+
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu import resilience
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.resilience.guard import DivergenceGuard
+from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+from howtotrainyourmamlpytorch_tpu.telemetry import health
+from howtotrainyourmamlpytorch_tpu.utils.tracing import (
+    JsonlLogger, read_jsonl)
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+# ---------------------------------------------------------------------------
+
+def test_config_health_validation():
+    with pytest.raises(ValueError, match="health_metrics_every_n_steps"):
+        MAMLConfig(health_metrics_every_n_steps=-1)
+    with pytest.raises(ValueError, match="health_grad_norm_warn_factor"):
+        MAMLConfig(health_grad_norm_warn_factor=0.5)
+    cfg = MAMLConfig()  # defaults: off, factor 10
+    assert cfg.health_metrics_every_n_steps == 0
+    assert cfg.health_grad_norm_warn_factor == 10.0
+    MAMLConfig(health_metrics_every_n_steps=50,
+               health_grad_norm_warn_factor=0.0)  # non-finite-only mode
+    # Typos get the did-you-mean treatment like every other knob.
+    with pytest.raises(ValueError, match="health_metrics_every_n_steps"):
+        MAMLConfig.from_dict({"health_metrics_every_n_step": 5})
+
+
+# ---------------------------------------------------------------------------
+# in-graph diagnostic math (pure, no jit needed)
+# ---------------------------------------------------------------------------
+
+def _toy_cfg(**kw):
+    return MAMLConfig(number_of_training_steps_per_iter=2,
+                      number_of_evaluation_steps_per_iter=2, **kw)
+
+
+def test_grad_health_norms():
+    grads = {"params": {"conv0": {"w": jnp.array([3.0, 4.0])},
+                        "linear": {"w": jnp.array([0.0])}},
+             "lslr": {"conv0": {"w": jnp.zeros(3)}}}
+    h = health.grad_health(grads)
+    assert h["grad_norm"] == pytest.approx(5.0)  # global incl. lslr zeros
+    assert h["grad_norm/conv0"] == pytest.approx(5.0)
+    assert h["grad_norm/linear"] == pytest.approx(0.0)
+    assert set(h) == {"grad_norm", "grad_norm/conv0", "grad_norm/linear"}
+
+
+def test_update_health_ratios_lslr_and_trajectories():
+    """update_health reconstructs the Adam update from the POST-update
+    moments (the parity constraint: outputs only, never the internal
+    optax updates tree) and must agree with what optax actually applied
+    — verified against a real optax.adam step."""
+    import optax
+    cfg = _toy_cfg(meta_learning_rate=0.01)
+    params = {"params": {"conv0": {"w": jnp.array([3.0, 4.0])}},
+              # K=2 trained rows + the untouched +1 row (sliced off).
+              "lslr": {"conv0": {"w": jnp.array([0.1, -0.2, 9.9])}}}
+    opt = optax.adam(0.01, b1=cfg.meta_adam_beta1,
+                     b2=cfg.meta_adam_beta2, eps=cfg.meta_adam_eps)
+    opt_state = opt.init(params)
+    grads = jax.tree.map(lambda x: 0.1 * jnp.ones_like(x), params)
+    updates, new_opt_state = opt.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+
+    ps_sup = jnp.array([1.0, 0.5])
+    ps_tgt = jnp.array([0.9, 0.4])
+    h = health.update_health(cfg, new_params, new_opt_state,
+                             jnp.float32(0.01), ps_sup, ps_tgt,
+                             jnp.array([0.5, 0.5]))
+    # Reconstructed ‖update‖/‖params‖ matches the applied update.
+    u_true = float(jnp.sqrt(jnp.sum(jnp.square(
+        updates["params"]["conv0"]["w"]))))
+    p_true = float(jnp.sqrt(jnp.sum(jnp.square(
+        new_params["params"]["conv0"]["w"]))))
+    assert h["update_ratio/conv0"] == pytest.approx(u_true / p_true,
+                                                    rel=1e-5)
+    assert h["update_ratio_max"] == h["update_ratio/conv0"]
+    # Only the K trained rows feed the stats — the +1 row's 9.9 must
+    # not. (The Adam step moved them by ~lr; compare loosely.)
+    assert h["lslr_min"] == pytest.approx(-0.2, abs=0.02)
+    assert h["lslr_max"] == pytest.approx(0.1, abs=0.02)
+    assert h["lslr_min/conv0"] == h["lslr_min"]
+    # One dead/negative row flagged.
+    assert h["lslr_nonpositive"] == pytest.approx(1.0)
+    np.testing.assert_allclose(h["per_step_support_loss"], [1.0, 0.5])
+    np.testing.assert_allclose(h["msl_importance"], [0.5, 0.5])
+    # Outside the MSL window the key is statically absent.
+    h2 = health.update_health(cfg, new_params, new_opt_state,
+                              jnp.float32(0.01), ps_sup, ps_tgt, None)
+    assert "msl_importance" not in h2
+
+
+def test_publish_health_routes_gauges_and_row(tmp_path):
+    reg = MetricsRegistry()
+    log = JsonlLogger(str(tmp_path / "events.jsonl"))
+    fetched = {"grad_norm": 2.5, "grad_norm/conv0": 2.0,
+               "update_ratio/conv0": 0.01, "update_ratio_max": 0.01,
+               "lslr_min": 0.05, "lslr_mean": 0.1, "lslr_max": 0.2,
+               "lslr_min/conv0": 0.05, "lslr_nonpositive": 0.0,
+               "per_step_support_loss": np.array([1.0, 0.5]),
+               "msl_importance": np.array([0.5, 0.5])}
+    health.publish_health(reg, log, fetched, iteration=7, epoch=1)
+    assert reg.gauge("health/grad_norm").value == 2.5
+    assert reg.gauge("health/layer/conv0/grad_norm").value == 2.0
+    assert reg.gauge("health/layer/conv0/update_ratio").value == 0.01
+    assert reg.gauge("health/lslr/conv0/min").value == 0.05
+    assert reg.gauge("health/lslr_min").value == 0.05
+    rows = read_jsonl(str(tmp_path / "events.jsonl"))
+    assert len(rows) == 1 and rows[0]["event"] == health.HEALTH_EVENT
+    assert rows[0]["iter"] == 7 and rows[0]["grad_norm"] == 2.5
+    assert rows[0]["per_step_support_loss"] == [1.0, 0.5]
+    assert rows[0]["msl_importance"] == [0.5, 0.5]
+
+
+# ---------------------------------------------------------------------------
+# guard early warning
+# ---------------------------------------------------------------------------
+
+def test_guard_grad_norm_warn_policy():
+    reg = MetricsRegistry()
+    prev = resilience.set_registry(reg)
+    try:
+        guard = DivergenceGuard(patience=1, grad_norm_factor=10.0)
+        # Non-finite warns immediately, even with no history.
+        assert guard.observe_grad_norm(float("nan"))
+        assert guard.observe_grad_norm(float("inf"))
+        # Healthy norms build the median window without warning.
+        for _ in range(6):
+            assert not guard.observe_grad_norm(1.0)
+        # Explosion past factor x median warns; a mild rise does not.
+        assert not guard.observe_grad_norm(5.0)
+        assert guard.observe_grad_norm(100.0)
+        assert reg.counter(health.GRAD_NORM_WARN_COUNTER).value == 3
+        # A warning is never a rewind: the loss-side streak is untouched.
+        assert guard._bad_streak == 0
+        # reset() clears the norm history (post-rewind scale may differ).
+        guard.reset()
+        assert not guard.observe_grad_norm(100.0)  # no history -> no warn
+    finally:
+        resilience.set_registry(prev)
+
+
+def test_guard_grad_norm_factor_validation():
+    with pytest.raises(ValueError, match="grad_norm_factor"):
+        DivergenceGuard(grad_norm_factor=0.9)
+    # 0 = non-finite-only: a finite explosion never warns.
+    guard = DivergenceGuard(grad_norm_factor=0.0)
+    for _ in range(6):
+        guard.observe_grad_norm(1.0)
+    assert not guard.observe_grad_norm(1e12)
+    assert guard.observe_grad_norm(math.inf)
+
+
+# ---------------------------------------------------------------------------
+# structural zero-cost pin + a real health-enabled run
+# ---------------------------------------------------------------------------
+
+def _tiny_cfg(tmp_path, **kw):
+    base = dict(
+        experiment_name="health", experiment_root=str(tmp_path),
+        dataset_name="synthetic_health",
+        image_height=8, image_width=8, image_channels=1,
+        num_classes_per_set=2, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2,
+        cnn_num_filters=4, num_stages=1,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_epochs=1, total_iter_per_epoch=2,
+        num_evaluation_tasks=2, max_models_to_save=1,
+        second_order=False, use_multi_step_loss_optimization=False,
+        compute_dtype="float32", dispatch_sync_every=1,
+        live_progress=False)
+    base.update(kw)
+    return MAMLConfig(**base)
+
+
+def test_health_off_adds_no_step_outputs(tmp_path):
+    """THE structural acceptance pin: with the knob at 0 the sharded
+    train step's lowered output tree is exactly the pre-health one —
+    state leaves + 4 metric scalars, zero health outputs in the HLO —
+    while the enabled build carries the diagnostics dict."""
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+        make_mesh, make_sharded_steps)
+
+    def lowered_out_leaves(cfg):
+        init, apply = make_model(cfg)
+        mesh = make_mesh(cfg, jax.devices()[:1])
+        plan = make_sharded_steps(cfg, apply, mesh)
+        state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+        from bench import synthetic_batch
+        batch = synthetic_batch(cfg, 0)
+        lowered = plan.train_steps[(False, False)].lower(
+            state, batch, jnp.float32(0))
+        out_state, out_metrics = lowered.out_info
+        return (len(jax.tree.leaves(lowered.out_info)),
+                len(jax.tree.leaves(state)), out_metrics)
+
+    cfg_off = _tiny_cfg(tmp_path)
+    n_off, n_state, metrics_off = lowered_out_leaves(cfg_off)
+    assert metrics_off.health is None          # statically absent
+    assert n_off == n_state + 4                # loss/acc/s_loss/lr only
+
+    cfg_on = _tiny_cfg(tmp_path, health_metrics_every_n_steps=1)
+    n_on, _, metrics_on = lowered_out_leaves(cfg_on)
+    assert isinstance(metrics_on.health, dict)
+    assert "grad_norm" in metrics_on.health
+    assert "per_step_target_loss" in metrics_on.health
+    assert n_on > n_off                        # diagnostics are real HLO
+    #                                            outputs when (and only
+    #                                            when) asked for
+
+
+def test_health_enabled_run_emits_rows_and_gauges(tmp_path):
+    """A real (tiny) health-enabled run: `health` event rows on the sync
+    cadence, health/* gauges in the registry, the warn counter eagerly
+    registered at 0, and the v6 report section rendered."""
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    from howtotrainyourmamlpytorch_tpu.telemetry import summarize_events
+
+    cfg = _tiny_cfg(tmp_path, health_metrics_every_n_steps=1)
+    builder = ExperimentBuilder(cfg)
+    builder.run_experiment()
+    events = read_jsonl(os.path.join(builder.paths["logs"],
+                                     "events.jsonl"))
+    rows = [e for e in events if e.get("event") == "health"]
+    assert len(rows) == 2  # every sync of the 2-iteration epoch
+    for row in rows:
+        assert row["grad_norm"] > 0
+        assert len(row["per_step_support_loss"]) == 2
+        assert len(row["per_step_target_loss"]) == 2
+    assert builder.registry.gauge("health/grad_norm").value > 0
+    assert builder.registry.gauge("health/update_ratio_max").value > 0
+    # Eager registration: a healthy run REPORTS zero warnings.
+    assert builder.registry.counter(
+        health.GRAD_NORM_WARN_COUNTER).value == 0
+    s = summarize_events(events)
+    assert s["health"]["grad_norm"] > 0
+    assert s["health"]["grad_norm_warns"] == 0
+    assert s["health"]["lslr_min"] > 0
+
+
+def test_grad_norm_warn_fires_with_rewinds_disabled(tmp_path):
+    """The early warning is observability, not recovery: with
+    divergence_patience=0 (rewind guard off) an injected NaN loss —
+    which also poisons the observed grad norm — must still produce the
+    health_grad_norm_warn row + counter, and no rewind."""
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    cfg = _tiny_cfg(tmp_path, health_metrics_every_n_steps=1,
+                    divergence_patience=0, fault_spec="nan_loss@1")
+    builder = ExperimentBuilder(cfg)
+    builder.run_experiment()
+    events = read_jsonl(os.path.join(builder.paths["logs"],
+                                     "events.jsonl"))
+    kinds = [e.get("event") for e in events]
+    assert "health_grad_norm_warn" in kinds
+    assert "rewind" not in kinds
+    assert builder.registry.counter(
+        health.GRAD_NORM_WARN_COUNTER).value == 1
+
+
+def test_health_fetch_cadence(tmp_path):
+    """health_metrics_every_n_steps thins the host fetches: with N=3
+    over a 6-iteration epoch syncing every iteration, only every third
+    sync fetches (the compiled step computes regardless — the knob
+    bounds HOST cost)."""
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    cfg = _tiny_cfg(tmp_path, total_iter_per_epoch=6,
+                    health_metrics_every_n_steps=3)
+    builder = ExperimentBuilder(cfg)
+    builder.run_experiment()
+    events = read_jsonl(os.path.join(builder.paths["logs"],
+                                     "events.jsonl"))
+    iters = [e["iter"] for e in events if e.get("event") == "health"]
+    assert iters == [1, 4]  # first sync, then every >=3 iterations

@@ -600,18 +600,27 @@ def test_nan_loss_triggers_rewind_and_run_recovers(tmp_path):
     from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
 
     cfg = _cfg(tmp_path, dispatch_sync_every=1, divergence_patience=1,
+               health_metrics_every_n_steps=1,  # ISSUE 7 early warning
                fault_spec="nan_loss@6")  # epoch 1 (iters 6..10)
     builder = ExperimentBuilder(cfg)
     result = builder.run_experiment()
     assert result["num_models"] == 2  # completed despite the NaN
     assert builder.registry.counter("resilience/rewinds").value == 1
     assert builder.ckpt.meta["rewinds"] == 1
-    # The rewind row landed in the event stream.
+    # The rewind row landed in the event stream, and the health
+    # subsystem's grad-norm warning preceded it STRICTLY in log order
+    # (the ISSUE 7 acceptance ordering) without changing any recovery
+    # semantics (the rewind happened exactly as before).
     from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
     events = read_jsonl(os.path.join(builder.paths["logs"],
                                      "events.jsonl"))
     rewinds = [e for e in events if e.get("event") == "rewind"]
     assert len(rewinds) == 1 and rewinds[0]["epoch"] == 0
+    kinds = [e.get("event") for e in events]
+    assert "health_grad_norm_warn" in kinds
+    assert kinds.index("health_grad_norm_warn") < kinds.index("rewind")
+    assert builder.registry.counter(
+        "health/grad_norm_warn").value == 1
 
 
 @pytest.mark.slow  # divergence with no checkpoint must fail loudly (~20s)
@@ -741,6 +750,55 @@ def test_watchdog_disabled_is_parity_with_enabled(tmp_path):
         e.get("progress_age_seconds") is None for e in off_beats)
 
 
+@pytest.mark.slow  # four tiny end-to-end runs (~80s), 1-core box
+def test_health_disabled_is_parity_with_enabled(tmp_path):
+    """ISSUE 7 acceptance pin (the watchdog parity pattern): health
+    metrics change NOTHING about training numerics — enabled and
+    disabled runs produce bitwise-identical final weights — and the
+    diagnostics-off build is structurally the seed build: a warm off-run
+    AFTER the health-on run compiles exactly as many executables as a
+    warm off-run before it (the on-run's different executables neither
+    polluted nor invalidated the off cache)."""
+    import jax
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    on = dict(dispatch_sync_every=1, health_metrics_every_n_steps=1)
+
+    # Run 1 (off) pays the process's cold compiles; the off-warm runs
+    # bracketing the on-run are the isolated comparison.
+    builder_cold = ExperimentBuilder(_cfg(tmp_path / "cold"))
+    builder_cold.run_experiment()
+
+    builder_off_a = ExperimentBuilder(_cfg(tmp_path / "off_a"))
+    builder_off_a.run_experiment()
+    compiles_off_a = builder_off_a.registry.counter("compile/count").value
+
+    builder_on = ExperimentBuilder(_cfg(tmp_path / "on", **on))
+    builder_on.run_experiment()
+
+    builder_off_b = ExperimentBuilder(_cfg(tmp_path / "off_b"))
+    builder_off_b.run_experiment()
+    compiles_off_b = builder_off_b.registry.counter("compile/count").value
+
+    for a, b, c, d in zip(jax.tree.leaves(builder_cold.state.params),
+                          jax.tree.leaves(builder_off_a.state.params),
+                          jax.tree.leaves(builder_on.state.params),
+                          jax.tree.leaves(builder_off_b.state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(c))
+        np.testing.assert_array_equal(np.asarray(c), np.asarray(d))
+    assert compiles_off_a == compiles_off_b
+    # The enabled run emitted health rows; the disabled runs none.
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    on_events = read_jsonl(os.path.join(builder_on.paths["logs"],
+                                        "events.jsonl"))
+    assert any(e.get("event") == "health" for e in on_events)
+    off_events = read_jsonl(os.path.join(builder_off_b.paths["logs"],
+                                         "events.jsonl"))
+    assert not any(e.get("event") == "health" for e in off_events)
+
+
 @pytest.mark.slow  # 5 tiny runs through the chaos harness (~3min), 1-core
 def test_chaos_acceptance(tmp_path, capsys):
     """THE ISSUE 3 acceptance scenario: injected NaN loss + one injected
@@ -768,6 +826,10 @@ def test_chaos_acceptance(tmp_path, capsys):
     assert artifact["preempted"] is True
     assert artifact["faults_injected"] >= 3
     assert artifact["test_accuracy_delta"] <= artifact["tolerance"]
+    # Health early warning (ISSUE 7): the faulted phase's log shows the
+    # grad-norm warn row strictly before the rewind row.
+    assert artifact["grad_norm_warns"] >= 1
+    assert artifact["grad_norm_warn_before_rewind"] is True
     # Hang phase (ISSUE 6): wedged feed -> watchdog -> exit 74 + bundle
     # (stacks + flight ring) -> restart recovered within tolerance.
     assert artifact["hang_exit_code"] == 74

@@ -21,19 +21,19 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # percentiles, tasks/sec/chip, compile count/seconds, feed-stall
 # fraction, peak memory, per-host skew; v2 adds the serving section,
 # v3 the resilience section, v4 the data-plane section, v5 the
-# watchdog section).
+# watchdog section, v6 the optimization-health section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
-    "watchdog",
+    "watchdog", "health",
 }
 
 
 def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
                          with_resilience=False, with_data=False,
-                         with_watchdog=False):
+                         with_watchdog=False, with_health=False):
     """A synthetic 2-epoch run's event stream, as the experiment loop
     writes it (train_epoch + telemetry + heartbeat per epoch); with
     ``with_serving``, a trailing serve/ registry-flush row as
@@ -120,6 +120,25 @@ def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
         # Restarted segment: fresh registry — reset-aware accumulation
         # must not double or drop the killed segment's trip.
         log.log("metrics", metrics={"watchdog/trips": 0.0})
+    if with_health:
+        # A health-enabled run (telemetry/health.py): per-fetch "health"
+        # rows (last grad norm + msl vector win; lslr bounds and the
+        # ratio report run-wide extremes), one guard warning row, and a
+        # counter row — followed by a restarted segment's reset-to-zero
+        # row the reset-aware accumulation must absorb.
+        log.log("health", iter=5, epoch=0, grad_norm=2.0,
+                update_ratio_max=0.05, lslr_min=0.08, lslr_max=0.12,
+                msl_importance=[0.6, 0.4],
+                per_step_support_loss=[1.0, 0.5],
+                per_step_target_loss=[0.9, 0.4])
+        log.log("health", iter=10, epoch=1, grad_norm=3.5,
+                update_ratio_max=0.02, lslr_min=0.09, lslr_max=0.4,
+                msl_importance=[0.7, 0.3],
+                per_step_support_loss=[0.8, 0.4],
+                per_step_target_loss=[0.7, 0.3])
+        log.log("health_grad_norm_warn", iter=11, grad_norm=99.0)
+        log.log("metrics", metrics={"health/grad_norm_warn": 1.0})
+        log.log("metrics", metrics={"health/grad_norm_warn": 0.0})
     return log.path
 
 
@@ -140,12 +159,13 @@ def test_summarize_events_fixture(tmp_path):
     assert s["peak_memory_bytes"] == 2001
     assert s["host_skew"]["hosts"] == 4
     assert s["host_skew"]["max_skew_frac"] == pytest.approx(0.1)
-    # No serve/, resilience/, data/ or watchdog rows -> the sections say
-    # so explicitly.
+    # No serve/, resilience/, data/, watchdog or health rows -> the
+    # sections say so explicitly.
     assert s["serving"] == UNAVAILABLE
     assert s["resilience"] == UNAVAILABLE
     assert s["data"] == UNAVAILABLE
     assert s["watchdog"] == UNAVAILABLE
+    assert s["health"] == UNAVAILABLE
     # The table renders every row without raising.
     table = format_table(s)
     assert "feed stall fraction" in table and "0.1" in table
@@ -272,6 +292,39 @@ def test_watchdog_section_from_heartbeats_alone():
                   "progress_age_seconds": 0.7}
 
 
+def test_summarize_events_health_section(tmp_path):
+    """health rows (the experiment loop's per-fetch publish) render the
+    v6 health section: last grad norm and msl vector, run-wide ratio
+    max / lslr bounds, and reset-aware warning accumulation cross-
+    checked against explicit warn rows."""
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    path = write_fixture_events(tmp_path / "events.jsonl",
+                                with_health=True)
+    s = summarize_events(read_jsonl(path))
+    assert set(s) == SCHEMA_KEYS
+    h = s["health"]
+    assert h["grad_norm"] == pytest.approx(3.5)        # last row wins
+    assert h["update_ratio_max"] == pytest.approx(0.05)  # run-wide max
+    assert h["lslr_min"] == pytest.approx(0.08)        # run-wide min
+    assert h["lslr_max"] == pytest.approx(0.4)         # run-wide max
+    assert h["msl_importance"] == [0.7, 0.3]           # last row wins
+    # 1 from the counter (reset row absorbed) == 1 explicit warn row.
+    assert h["grad_norm_warns"] == 1
+    assert "health" in format_table(s)
+    # Training metrics untouched by the health rows.
+    assert s["epochs"] == 2 and s["serving"] == UNAVAILABLE
+
+
+def test_health_section_nonfinite_grad_norm_visible():
+    """A NaN grad norm is nulled by the JSONL writer; the report must
+    show 'non-finite' — the diagnosis itself — not hide the row."""
+    events = [{"event": "health", "iter": 5, "grad_norm": None,
+               "update_ratio_max": 0.1}]
+    h = summarize_events(events)["health"]
+    assert h["grad_norm"] == "non-finite"
+    assert h["grad_norm_warns"] == 0
+
+
 def test_summarize_events_failsoft_markers(tmp_path):
     from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
     path = write_fixture_events(tmp_path / "events.jsonl",
@@ -343,7 +396,10 @@ def test_report_on_real_two_epoch_cpu_run(tmp_path):
         number_of_evaluation_steps_per_iter=1,
         second_order=False, use_multi_step_loss_optimization=False,
         total_epochs=2, total_iter_per_epoch=2,
-        num_evaluation_tasks=2, max_models_to_save=2)
+        num_evaluation_tasks=2, max_models_to_save=2,
+        # Health introspection on, fetched at every sync (ISSUE 7): the
+        # report's v6 section must render from a REAL pipeline.
+        dispatch_sync_every=1, health_metrics_every_n_steps=1)
     ExperimentBuilder(cfg).run_experiment()
 
     exp_dir = os.path.join(str(tmp_path), "telemetry_e2e")
@@ -372,6 +428,12 @@ def test_report_on_real_two_epoch_cpu_run(tmp_path):
     assert s["watchdog"]["last_phase"] in (
         "step", "feed", "collective", "compile", "idle")
     assert isinstance(s["watchdog"]["progress_age_seconds"], float)
+    # v6 health section: in-graph diagnostics fetched at the sync points
+    # (0 warnings on a healthy run — measured zero, not absent).
+    assert s["health"]["grad_norm"] > 0
+    assert s["health"]["update_ratio_max"] > 0
+    assert s["health"]["lslr_min"] > 0
+    assert s["health"]["grad_norm_warns"] == 0
     # The Prometheus textfile snapshot landed next to the JSONL stream.
     prom = open(os.path.join(exp_dir, "logs", "metrics.prom")).read()
     assert "# TYPE compile_count counter" in prom

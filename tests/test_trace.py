@@ -1,0 +1,333 @@
+"""Chrome-trace timeline export (ISSUE 7): telemetry/trace.py units,
+the scripts/trace_export.py CLI contract (tier-1, through the real
+entrypoint — the dataset_pack.py discipline), crash-bundle trace.json,
+and the ServingEngine export hook. The 2-epoch smoke-run acceptance
+proof is the slow test at the bottom.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.resilience import flightrec
+from howtotrainyourmamlpytorch_tpu.resilience.flightrec import (
+    FlightRecorder, write_crash_bundle)
+from howtotrainyourmamlpytorch_tpu.telemetry import trace
+from howtotrainyourmamlpytorch_tpu.utils.tracing import JsonlLogger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "scripts", "trace_export.py")
+
+
+def _flight_rows(t0=1000.0):
+    """A synthetic ring dump: the step/feed/compile/collective cadence a
+    real run stamps, plus a fault marker."""
+    rows = []
+    seq = [("feed", "train"), ("compile", "(False, False)"), ("step", 0),
+           ("feed", "train"), ("step", 1), ("collective", "barrier:x"),
+           ("step", 2)]
+    for i, (phase, detail) in enumerate(seq):
+        rows.append({"t": float(i), "ts": t0 + i, "kind": "phase",
+                     "phase": phase, "detail": detail})
+    rows.append({"t": 7.0, "ts": t0 + 7, "kind": "fault",
+                 "fault": "nan_loss", "step": 5})
+    return rows
+
+
+def _assert_valid(tr):
+    trace.validate_trace(tr)
+    for e in tr["traceEvents"]:
+        assert e["ph"] in {"B", "E", "X", "i"}
+
+
+# ---------------------------------------------------------------------------
+# builder units
+# ---------------------------------------------------------------------------
+
+def test_spans_from_flight_phases_and_markers():
+    events = trace.spans_from_flight(_flight_rows(), process_index=3)
+    spans = [e for e in events if e["ph"] == "X"]
+    # 7 phase stamps -> 7 spans (the final open phase closes at the last
+    # ring event's timestamp).
+    assert [s["name"] for s in spans] == [
+        "feed", "compile", "step", "feed", "step", "collective", "step"]
+    assert spans[0]["dur"] == 1_000_000  # 1s between stamps, in µs
+    assert all(s["pid"] == 3 for s in spans)
+    # One tid per phase class.
+    assert spans[1]["tid"] == trace.PHASE_TIDS["compile"]
+    assert spans[5]["tid"] == trace.PHASE_TIDS["collective"]
+    marks = [e for e in events if e["ph"] == "i"]
+    assert len(marks) == 1 and marks[0]["name"] == "fault"
+    assert marks[0]["args"]["fault"] == "nan_loss"
+    _assert_valid(trace.build_trace(flight=_flight_rows()))
+
+
+def test_spans_from_events_epochs_heartbeats_markers():
+    events = [
+        {"ts": 2000.0, "event": "train_epoch", "epoch": 0,
+         "epoch_seconds": 10.0, "train_loss": 1.0},
+        {"ts": 2001.0, "event": "heartbeat", "epoch": 0, "iter": 5,
+         "host_mean_step_seconds": [0.1, 0.2],
+         "host_progress_age_seconds": [0.5, 9.0],
+         "progress_phase": "step"},
+        {"ts": 2002.0, "event": "checkpoint", "epoch": 0, "iter": 5},
+        {"ts": 2003.0, "event": "watchdog_trip", "phase": "feed",
+         "process_index": 1},
+        {"ts": 2004.0, "event": "telemetry"},  # not a timeline row
+    ]
+    out = trace.spans_from_events(events)
+    epoch = [e for e in out if e["ph"] == "X"]
+    assert len(epoch) == 1 and epoch[0]["name"] == "epoch 0"
+    assert epoch[0]["ts"] == int(1990.0 * 1e6)  # start = ts - duration
+    assert epoch[0]["dur"] == int(10.0 * 1e6)
+    beats = [e for e in out if e["name"] == "heartbeat"]
+    # One marker per host, on that host's track.
+    assert [b["pid"] for b in beats] == [0, 1]
+    assert beats[1]["args"]["progress_age_seconds"] == 9.0
+    marks = {e["name"] for e in out if e["ph"] == "i"}
+    assert {"checkpoint", "watchdog_trip"} <= marks
+    trip = next(e for e in out if e["name"] == "watchdog_trip")
+    assert trip["pid"] == 1
+    _assert_valid(trace.build_trace(events=events))
+
+
+def test_build_trace_merges_sources_and_sorts():
+    tr = trace.build_trace(events=[{"ts": 999.0, "event": "checkpoint"}],
+                           flight=_flight_rows(t0=1000.0))
+    _assert_valid(tr)
+    ts = [e["ts"] for e in tr["traceEvents"]]
+    assert ts == sorted(ts)
+    stats = trace.trace_stats(tr)
+    assert stats["spans"] == 7 and stats["instants"] == 2
+    assert stats["hosts"] == 1
+
+
+def test_validate_trace_rejects_bad_traces():
+    with pytest.raises(ValueError, match="traceEvents"):
+        trace.validate_trace({})
+    with pytest.raises(ValueError, match="bad ph"):
+        trace.validate_trace({"traceEvents": [
+            {"name": "x", "ph": "Z", "ts": 1, "pid": 0, "tid": 0}]})
+    with pytest.raises(ValueError, match="positive dur"):
+        trace.validate_trace({"traceEvents": [
+            {"name": "x", "ph": "X", "ts": 1, "pid": 0, "tid": 0}]})
+    with pytest.raises(ValueError, match="monotone"):
+        trace.validate_trace({"traceEvents": [
+            {"name": "a", "ph": "i", "ts": 5, "pid": 0, "tid": 0},
+            {"name": "b", "ph": "i", "ts": 4, "pid": 0, "tid": 0}]})
+    # Different tracks may interleave freely.
+    trace.validate_trace({"traceEvents": [
+        {"name": "a", "ph": "i", "ts": 5, "pid": 0, "tid": 0},
+        {"name": "b", "ph": "i", "ts": 4, "pid": 0, "tid": 1}]})
+
+
+def test_write_trace_atomic_and_stats(tmp_path):
+    path = str(tmp_path / "sub" / "trace.json")
+    stats = trace.write_trace(path, flight=_flight_rows())
+    assert stats["path"] == path and stats["spans"] == 7
+    tr = json.load(open(path))
+    _assert_valid(tr)
+    assert not [p for p in os.listdir(tmp_path / "sub")
+                if ".tmp." in p]  # atomic rename left no temp file
+
+
+# ---------------------------------------------------------------------------
+# crash bundle + serving engine wiring
+# ---------------------------------------------------------------------------
+
+def test_crash_bundle_includes_trace(tmp_path):
+    """Satellite pin: a watchdog trip's bundle now carries a directly
+    loadable trace.json next to flight.jsonl (best-effort, like
+    stacks.txt) — and still degrades to no trace without a recorder."""
+    rec = FlightRecorder(16)
+    for phase in ("feed", "step", "feed", "step"):
+        rec.record("phase", phase=phase, detail=1)
+    prev = flightrec.install(rec)
+    try:
+        bundle = write_crash_bundle(str(tmp_path / "b"), reason="test")
+    finally:
+        flightrec.install(prev)
+    tr = json.load(open(os.path.join(bundle, flightrec.TRACE_FILE)))
+    _assert_valid(tr)
+    names = [e["name"] for e in tr["traceEvents"] if e["ph"] == "X"]
+    assert names == ["feed", "step", "feed", "step"]
+    # No recorder -> no trace.json (same contract as flight.jsonl).
+    bundle2 = write_crash_bundle(str(tmp_path / "b2"), reason="test")
+    assert not os.path.exists(os.path.join(bundle2, flightrec.TRACE_FILE))
+
+
+def test_serving_engine_export_trace(tmp_path):
+    """ServingEngine renders its own recorder (installed iff it owns the
+    watchdog); a training-owned process returns None and defers to the
+    experiment loop's per-epoch flush."""
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.serve.engine import ServingEngine
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    cfg = MAMLConfig(
+        experiment_name="trace_serve", experiment_root=str(tmp_path),
+        dataset_name="synthetic",
+        image_height=8, image_width=8, image_channels=1,
+        num_classes_per_set=2, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2,
+        cnn_num_filters=4, num_stages=1,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        serve_batch_tasks=1, compute_dtype="float32")
+    model_init, _ = make_model(cfg)
+    state = init_train_state(cfg, model_init, jax.random.PRNGKey(0))
+    with ServingEngine(cfg, state) as engine:
+        assert flightrec.get() is not None  # engine owns the recorder
+        path = engine.export_trace()
+        assert path == os.path.join(str(tmp_path), "trace_serve",
+                                    "logs", "trace_serve.json")
+        _assert_valid(json.load(open(path)))
+        # Explicit path override wins.
+        alt = engine.export_trace(str(tmp_path / "alt.json"))
+        _assert_valid(json.load(open(alt)))
+    # Recorder restored on close; with none installed, export declines.
+    with ServingEngine(cfg.replace(watchdog_serve_timeout_s=0.0),
+                       state) as engine2:
+        assert engine2.export_trace() is None
+
+
+# ---------------------------------------------------------------------------
+# CLI contract (tier-1, real entrypoint)
+# ---------------------------------------------------------------------------
+
+def _write_fixture_logs(logs):
+    os.makedirs(logs, exist_ok=True)
+    jl = JsonlLogger(os.path.join(logs, "events.jsonl"))
+    jl.log("train_epoch", epoch=0, iter=10, epoch_seconds=5.0,
+           train_loss=1.0)
+    jl.log("heartbeat", epoch=0, iter=10,
+           host_mean_step_seconds=[0.1, 0.2], skew_frac=0.5, hosts=2)
+    jl.log("checkpoint", epoch=0, iter=10)
+    with open(os.path.join(logs, "flight.jsonl"), "w") as f:
+        for row in _flight_rows():
+            f.write(json.dumps(row) + "\n")
+
+
+def test_cli_artifact_schema_and_valid_trace(tmp_path):
+    """Tier-1 rot guard: subprocess over a fixture logs dir; the LAST
+    stdout line is the artifact (the repo's CLI contract), the written
+    trace is schema-valid and carries step/feed/collective/compile
+    spans plus one pid per host."""
+    logs = str(tmp_path / "logs")
+    _write_fixture_logs(logs)
+    r = subprocess.run([sys.executable, CLI, logs],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-1000:]
+    art = json.loads(r.stdout.strip().splitlines()[-1])
+    assert art["metric"] == "trace_export"
+    assert art["spans"] == 8          # 7 phase spans + 1 epoch span
+    assert art["instants"] >= 3       # fault + 2 heartbeats + checkpoint
+    assert art["hosts"] == 2          # heartbeat vector spans two hosts
+    assert art["events_rows"] == 3 and art["flight_rows"] == 8
+    tr = json.load(open(art["out"]))
+    _assert_valid(tr)
+    names = {e["name"] for e in tr["traceEvents"] if e["ph"] == "X"}
+    assert {"step", "feed", "collective", "compile"} <= names
+    # No jax import on the login-node path.
+    assert "jax" not in r.stderr
+
+
+def test_cli_flight_only_and_events_only(tmp_path):
+    flight = tmp_path / "flight.jsonl"
+    with open(flight, "w") as f:
+        for row in _flight_rows():
+            f.write(json.dumps(row) + "\n")
+    r = subprocess.run([sys.executable, CLI, str(tmp_path),
+                        "--process-index", "2"],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-1000:]
+    art = json.loads(r.stdout.strip().splitlines()[-1])
+    assert art["spans"] == 7 and art["events_rows"] == 0
+    tr = json.load(open(art["out"]))
+    assert all(e["pid"] == 2 for e in tr["traceEvents"])
+
+    events_only = tmp_path / "ev"
+    os.makedirs(events_only)
+    JsonlLogger(str(events_only / "events.jsonl")).log(
+        "train_epoch", epoch=0, epoch_seconds=1.0)
+    r2 = subprocess.run([sys.executable, CLI,
+                        str(events_only / "events.jsonl")],
+                        capture_output=True, text=True, timeout=120,
+                        cwd=REPO)
+    assert r2.returncode == 0
+    art2 = json.loads(r2.stdout.strip().splitlines()[-1])
+    assert art2["spans"] == 1 and art2["flight_rows"] == 0
+
+
+def test_cli_errors_are_json(tmp_path):
+    r = subprocess.run([sys.executable, CLI, str(tmp_path / "nothing")],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == 1
+    assert "error" in json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_cli_discovers_crash_bundle_flight(tmp_path):
+    """After a watchdog trip the ring copy lives in the crash bundle;
+    the CLI must find it without flags — a tripped run's timeline is
+    one command away."""
+    logs = tmp_path / "logs"
+    bundle = logs / "crash_bundle"
+    os.makedirs(bundle)
+    JsonlLogger(str(logs / "events.jsonl")).log(
+        "watchdog_trip", phase="feed", process_index=0)
+    with open(bundle / "flight.jsonl", "w") as f:
+        for row in _flight_rows():
+            f.write(json.dumps(row) + "\n")
+    r = subprocess.run([sys.executable, CLI, str(logs)],
+                       capture_output=True, text=True, timeout=120,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-1000:]
+    art = json.loads(r.stdout.strip().splitlines()[-1])
+    assert art["spans"] == 7 and art["flight_rows"] == 8
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the 2-epoch smoke run renders end-to-end
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # real 2-epoch CPU run (~25s, 1 core)
+def test_trace_export_on_real_two_epoch_run(tmp_path):
+    """THE ISSUE 7 trace acceptance: a 2-epoch smoke run, then the CLI
+    emits a valid Chrome trace with step, feed, collective AND compile
+    spans (ph ∈ {X,i}, monotone ts per track, one pid per host)."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    builder = ExperimentBuilder(_cfg(tmp_path, dispatch_sync_every=1,
+                                     health_metrics_every_n_steps=1))
+    builder.run_experiment()
+    exp_dir = os.path.join(str(tmp_path), "smoke")
+    # The per-epoch flush left both timeline artifacts in logs/.
+    assert os.path.exists(os.path.join(exp_dir, "logs", "flight.jsonl"))
+    assert os.path.exists(os.path.join(exp_dir, "logs", "trace.json"))
+
+    out = str(tmp_path / "rebuilt.json")
+    r = subprocess.run([sys.executable, CLI, exp_dir, "--out", out],
+                       capture_output=True, text=True, timeout=300,
+                       cwd=REPO)
+    assert r.returncode == 0, r.stderr[-1500:]
+    art = json.loads(r.stdout.strip().splitlines()[-1])
+    assert art["metric"] == "trace_export"
+    assert art["spans"] > 0 and art["hosts"] == 1
+    tr = json.load(open(out))
+    _assert_valid(tr)
+    span_names = {e["name"] for e in tr["traceEvents"] if e["ph"] == "X"}
+    assert {"step", "feed", "collective", "compile"} <= span_names
+    assert any(n.startswith("epoch") for n in span_names)
+    # The health-enabled run's markers rode along.
+    instant_names = {e["name"] for e in tr["traceEvents"]
+                     if e["ph"] == "i"}
+    assert "heartbeat" in instant_names and "checkpoint" in instant_names
