@@ -391,6 +391,36 @@ def main() -> int:
     except FloatingPointError as e:
         print(json.dumps({"error": str(e)}))
         return 1
+    # Checkpoint keys (ckpt/ subsystem, docs/CHECKPOINT.md): the cost of
+    # ONE synchronous epoch save of this workload's state through the
+    # real CheckpointManager path (device_get + msgpack + MAMLCKP1
+    # framing + fsync'd atomic write + manifest commit, to a temp dir),
+    # and the fraction of one epoch a synchronous save would stall the
+    # training thread — the number ckpt_async=1 exists to erase
+    # (blocking_frac ~ save / (save + epoch) at this measured rate).
+    # Fail-soft null: a broken temp mount must not zero the capture.
+    ckpt_save_seconds = ckpt_blocking_frac = None
+    try:
+        import shutil
+        import tempfile
+        from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+            CheckpointManager)
+        ckpt_dir = tempfile.mkdtemp(prefix="bench_ckpt_")
+        try:
+            # Fresh state: the timed loop DONATED the benched one.
+            st_ckpt = init_train_state(cfg, init, jax.random.PRNGKey(0))
+            t0 = time.perf_counter()
+            CheckpointManager(ckpt_dir).save(st_ckpt, 0, 0, 0.0)
+            ckpt_save_seconds = round(time.perf_counter() - t0, 6)
+            epoch_seconds = (cfg.total_iter_per_epoch * cfg.batch_size
+                             / (per_chip * n_dev))
+            ckpt_blocking_frac = round(
+                ckpt_save_seconds / (ckpt_save_seconds + epoch_seconds),
+                6)
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+    except Exception:  # noqa: BLE001 — observability key, never fatal
+        pass
     # The baseline estimate is for the FLAGSHIP workload (either batch
     # variant); a ratio against it means nothing for other configs.
     is_flagship = cfg.experiment_name.startswith(
@@ -433,6 +463,11 @@ def main() -> int:
         # headline print, when enabled.
         "outer_grad_norm": None,
         "health_overhead_frac": None,
+        # Checkpoint keys (ckpt/ subsystem): one measured synchronous
+        # save of THIS workload's state + the epoch fraction it would
+        # stall (fail-soft null on error, measured above).
+        "ckpt_save_seconds": ckpt_save_seconds,
+        "ckpt_blocking_frac": ckpt_blocking_frac,
     }
     if cfg.health_metrics_every_n_steps > 0:
         # The headline executable ALREADY computes the diagnostics

@@ -1,0 +1,29 @@
+"""Checkpoint lifecycle subsystem (docs/CHECKPOINT.md).
+
+Closes the train→publish→serve loop around the checkpoint directory:
+
+* :mod:`~.writer` — async double-buffered saves (`CheckpointWriter`):
+  host-side snapshot on the training thread, file writes on a bounded
+  background queue, drained on preempt/exit. ``ckpt_async=0`` keeps the
+  synchronous path bitwise-identical.
+* :mod:`~.manifest` — the committed-checkpoint manifest
+  (``MANIFEST.json``): pending→committed records with bytes+CRC, the
+  O(records) resume index, and the GC sweep for tmp/pending/corrupt
+  leftovers.
+* :mod:`~.registry` — the model registry (``REGISTRY.json``): training
+  publishes committed checkpoints with val metrics; ``ServingEngine``
+  polls it and hot-swaps after a canary pass.
+
+``manifest`` and ``registry`` are stdlib-only (file-path loadable by the
+jax-free ``scripts/ckpt_admin.py``); ``writer`` pulls in the jax-side
+``CheckpointManager`` and is therefore imported by its consumers
+directly, NOT from this ``__init__`` — keeping the package importable
+from ``utils/checkpoint.py`` without a cycle.
+"""
+
+from __future__ import annotations
+
+from howtotrainyourmamlpytorch_tpu.ckpt.manifest import Manifest
+from howtotrainyourmamlpytorch_tpu.ckpt.registry import ModelRegistry
+
+__all__ = ["Manifest", "ModelRegistry"]

@@ -1,0 +1,188 @@
+"""Model registry: the publish half of the train→publish→serve loop.
+
+Training (via ``ckpt/writer.py``) publishes each COMMITTED epoch
+checkpoint here with its validation metrics; a long-lived
+``ServingEngine`` polls :meth:`ModelRegistry.latest` and hot-swaps to a
+newly published version after a canary pass (``serve/engine.py §
+maybe_hot_swap``) — closing the loop that used to require a server
+restart. The registry is one ``REGISTRY.json`` next to the checkpoints:
+
+    {"schema": ..., "next_version": N, "versions": [
+        {"version", "tag", "epoch", "iter", "val_acc", "fingerprint",
+         "status": "live" | "retired" | "rolled_back", "reason",
+         "published_ts"}]}
+
+``version`` is a monotonically increasing integer — the poll primitive
+is "is there a live version newer than mine". ``fingerprint`` is the
+checkpoint-file content fingerprint (``ckpt/manifest.py §
+file_fingerprint``, the same value ``CheckpointManager.fingerprint``
+computes), which lets a serving process recognize "this version IS the
+bytes I already loaded" and adopt it without a pointless swap.
+
+Statuses: ``live`` (servable), ``retired`` (the checkpoint file fell out
+of ``max_to_keep`` retention — the publisher reconciles on each publish),
+``rolled_back`` (an operator or canary verdict withdrew it;
+``scripts/ckpt_admin.py rollback`` writes it, serving engines only count
+their local rejections). ``latest()`` returns the newest LIVE version.
+
+Single-writer by contract (training process 0, or the admin CLI against
+a dead run); pollers construct fresh instances (or call :meth:`reload`)
+and never write. Stdlib-only so ``scripts/ckpt_admin.py`` can load it by
+file path on a login node.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from_path_ok = True  # marker: this module has no package-relative imports
+
+REGISTRY_FILE = "REGISTRY.json"
+SCHEMA = "maml_model_registry_v1"
+LIVE = "live"
+RETIRED = "retired"
+ROLLED_BACK = "rolled_back"
+
+# Re-implemented here rather than imported so the module stays loadable
+# by file path (no package-relative imports); mirrors
+# manifest.atomic_write_json step for step (tmp + fsync(file) + rename +
+# best-effort fsync(dir)) — keep the two in lockstep.
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+class ModelRegistry:
+    """``REGISTRY.json`` in a checkpoint directory (or any directory —
+    the records carry their own checkpoint ``directory`` field when
+    published from elsewhere)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self.path = os.path.join(directory, REGISTRY_FILE)
+        self.versions: List[Dict[str, Any]] = []
+        self.next_version = 1
+        self.loaded = False
+        self.reload()
+
+    def reload(self) -> "ModelRegistry":
+        """Re-read from disk (the poll primitive — cheap: one small
+        file). Damage degrades to an empty registry, never an error: a
+        serving process must keep serving its current version through a
+        torn registry write."""
+        self.versions = []
+        self.next_version = 1
+        self.loaded = False
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return self
+        if isinstance(doc.get("versions"), list):
+            self.versions = [dict(v) for v in doc["versions"]
+                             if isinstance(v, dict)]
+            self.next_version = int(doc.get("next_version")
+                                    or len(self.versions) + 1)
+            self.loaded = True
+        return self
+
+    def _write(self) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        _atomic_write_json(self.path, {
+            "schema": SCHEMA,
+            "next_version": self.next_version,
+            "versions": self.versions,
+        })
+        self.loaded = True
+
+    # -- writer side ----------------------------------------------------
+    def publish(self, *, tag, epoch: Optional[int] = None,
+                iteration: int = 0, val_acc: Optional[float] = None,
+                fingerprint: Optional[int] = None,
+                directory: Optional[str] = None) -> Dict[str, Any]:
+        """Register one committed checkpoint as a servable version."""
+        rec = {
+            "version": self.next_version,
+            "tag": str(tag),
+            "epoch": int(epoch) if epoch is not None else None,
+            "iter": int(iteration),
+            "val_acc": float(val_acc) if val_acc is not None else None,
+            "fingerprint": (int(fingerprint) if fingerprint is not None
+                            else None),
+            "status": LIVE,
+            "reason": None,
+            "published_ts": time.time(),
+        }
+        if directory is not None:
+            rec["directory"] = directory
+        self.versions.append(rec)
+        self.next_version += 1
+        self._write()
+        return rec
+
+    def retire_missing(self, ckpt_directory: str) -> List[int]:
+        """Mark live versions whose checkpoint file no longer exists
+        (retention-pruned or externally deleted) as ``retired`` so
+        pollers never chase a dead file. Returns the retired version
+        ids. The publisher calls this on each publish."""
+        retired = []
+        for rec in self.versions:
+            if rec.get("status") != LIVE:
+                continue
+            path = os.path.join(ckpt_directory,
+                                f"train_model_{rec['tag']}.ckpt")
+            if not os.path.isfile(path):
+                rec["status"] = RETIRED
+                rec["reason"] = "checkpoint file missing"
+                retired.append(rec["version"])
+        if retired:
+            self._write()
+        return retired
+
+    def rollback(self, version: int, reason: str = "") -> Dict[str, Any]:
+        """Withdraw a published version (operator action — the admin
+        CLI's ``rollback``). Pollers treat it like it never existed."""
+        rec = self.get(version)
+        if rec is None:
+            raise KeyError(f"no version {version} in {self.path}")
+        rec["status"] = ROLLED_BACK
+        rec["reason"] = reason or "rolled back"
+        self._write()
+        return rec
+
+    # -- poller side ----------------------------------------------------
+    def get(self, version: int) -> Optional[Dict[str, Any]]:
+        for rec in self.versions:
+            if int(rec.get("version") or -1) == int(version):
+                return rec
+        return None
+
+    def latest(self) -> Optional[Dict[str, Any]]:
+        """Newest LIVE version, or None. 'Newest' is by version number —
+        publish order, the only order the single writer defines."""
+        live = [r for r in self.versions if r.get("status") == LIVE]
+        return max(live, key=lambda r: int(r.get("version") or 0),
+                   default=None)

@@ -1,0 +1,253 @@
+"""Async double-buffered checkpoint writer.
+
+``CheckpointManager.save`` serializes and writes the whole state on the
+calling thread — at every epoch boundary, which on a pod means one slow
+NFS write stalls ALL hosts at the next collective. This writer splits a
+save into its two halves:
+
+* **snapshot + bookkeeping** (caller thread, every process): the state
+  is fetched host-side and framed (``CheckpointManager.encode`` —
+  ``jax.device_get`` + msgpack + MAMLCKP1 framing), and the in-memory
+  bookkeeping is updated exactly as the synchronous path would (every
+  process needs ``top_epochs`` for the ensemble protocol, so this half
+  must stay synchronous and uniform);
+* **file writes** (one background daemon thread, writer process only):
+  the framed bytes, the manifest pending→committed transition, the
+  'latest' link, retention pruning and ``state.json`` — all through the
+  same ``CheckpointManager`` code the synchronous path runs, so the
+  on-disk result is byte-identical.
+
+The queue is bounded at depth 1 (double buffering: one save in flight,
+at most one waiting). When a THIRD save arrives before the first
+finishes, ``ckpt_queue_policy`` decides: ``block`` (default) waits —
+degrading toward today's synchronous behavior, never losing a
+checkpoint — while ``skip`` drops the new save's FILE write (counted as
+``ckpt/skipped_saves``; bookkeeping still updates, and every consumer of
+``top_epochs`` filters by ``has_checkpoint``). ``ckpt_async=0`` skips
+all of this: ``save`` delegates straight to the manager on the calling
+thread, bitwise-identical to the pre-subsystem path.
+
+Progress contract (resilience/watchdog.py): the CALLER-thread waits —
+synchronous saves, a ``block``-policy enqueue, ``drain`` — run under a
+``ckpt`` watchdog phase, so a save wedged on dead storage trips
+``watchdog_ckpt_timeout_s`` instead of hanging the run forever. The
+background thread never stamps the process beacon (a worker stamping
+would clobber the train loop's live phase — the PR-5 warmup-thread
+rule); its activity is visible through ``ckpt_write`` flight-recorder
+events and the ``ckpt/*`` counters instead.
+
+Preemption safety: ``save_latest`` (the SIGTERM snapshot path and the
+divergence-rewind rewrite) first **drains** the queue, then writes
+synchronously — a preempted run never exits with its newest snapshot
+still sitting in a queue, and a rewind can never read around an
+in-flight epoch write.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import warnings
+from typing import Any, Optional
+
+from howtotrainyourmamlpytorch_tpu.resilience import counter_inc, watchdog
+from howtotrainyourmamlpytorch_tpu.resilience import flightrec
+
+SAVES = "ckpt/saves"
+SAVE_SECONDS = "ckpt/save_seconds"
+BLOCKED_SECONDS = "ckpt/blocked_seconds"
+SKIPPED_SAVES = "ckpt/skipped_saves"
+WRITE_ERRORS = "ckpt/write_errors"
+PUBLISHED = "ckpt/published"
+
+QUEUE_POLICIES = ("block", "skip")
+
+
+class CheckpointWriter:
+    """The save facade the experiment loop goes through.
+
+    Wraps (never replaces) a ``CheckpointManager``: loads, bookkeeping
+    queries and quarantine/fallback stay on the manager; only the save
+    path is routed here so ``ckpt_async`` can move the file writes off
+    the training thread.
+    """
+
+    def __init__(self, manager: Any, *, async_saves: bool = False,
+                 queue_policy: str = "block", publish: bool = False):
+        if queue_policy not in QUEUE_POLICIES:
+            raise ValueError(f"queue_policy must be one of "
+                             f"{QUEUE_POLICIES}, got {queue_policy!r}")
+        self.manager = manager
+        self.async_saves = bool(async_saves)
+        self.queue_policy = queue_policy
+        # Whether THIS writer publishes committed epoch saves to the
+        # model registry (main process only — publish is a write).
+        self.publish = bool(publish)
+        # Depth-1 queue: one job in flight (popped by the worker), at
+        # most one waiting — the "double buffer".
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        self.last_error: Optional[str] = None
+        self._registry = None  # lazy ModelRegistry (publish=True only)
+
+    # -- worker lifecycle ------------------------------------------------
+    def _ensure_thread(self) -> None:
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, daemon=True, name="ckpt-writer")
+                self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:  # close() sentinel
+                self._queue.task_done()
+                return
+            try:
+                self._run_job(*job)
+            except Exception as e:  # noqa: BLE001 — an async write
+                # failure (post-retry) must not kill the worker: later
+                # saves may succeed, and training owns no try/except
+                # around a background thread. Loud: counter + warning +
+                # last_error, and the next committed save supersedes.
+                self.last_error = f"{type(e).__name__}: {e}"
+                counter_inc(WRITE_ERRORS)
+                warnings.warn(f"async checkpoint write failed "
+                              f"({self.last_error}); the previous "
+                              f"committed checkpoint remains current")
+            finally:
+                self._queue.task_done()
+
+    def _run_job(self, data: bytes, epoch: int, current_iter: int,
+                 val_acc: float, keep, meta) -> None:
+        t0 = time.perf_counter()
+        flightrec.record("ckpt_write", epoch=int(epoch),
+                         iter=int(current_iter), bytes=len(data))
+        self.manager.write_epoch_files(data, epoch, current_iter, val_acc,
+                                       keep=keep, meta=meta)
+        dt = time.perf_counter() - t0
+        counter_inc(SAVES)
+        counter_inc(SAVE_SECONDS, dt)
+        self._maybe_publish(epoch, current_iter, val_acc)
+
+    # -- save API (mirrors CheckpointManager) ------------------------------
+    def save(self, state, epoch: int, current_iter: int, val_acc: float,
+             write: bool = True) -> None:
+        """Epoch save. Sync mode delegates verbatim; async mode runs the
+        bookkeeping half here and hands the file half to the worker."""
+        mgr = self.manager
+        if not self.async_saves:
+            if write:
+                t0 = time.perf_counter()
+                with watchdog.phase("ckpt", detail=int(epoch)):
+                    mgr.save(state, epoch, current_iter, val_acc,
+                             write=True)
+                counter_inc(SAVES)
+                counter_inc(SAVE_SECONDS, time.perf_counter() - t0)
+                self._maybe_publish(epoch, current_iter, val_acc)
+            else:
+                mgr.save(state, epoch, current_iter, val_acc, write=False)
+            return
+        # Async: the host snapshot happens NOW (the state the caller
+        # passed is the state that gets saved — later training steps
+        # mutate a different buffer), bookkeeping updates synchronously
+        # on every process, only the IO is deferred.
+        data = mgr.encode(state) if write else None
+        mgr.record_save(epoch, current_iter, val_acc)
+        if not write:
+            return
+        # Freeze the write job's view: the retention set and the meta
+        # dict as of THIS save — the live meta keeps mutating under
+        # later epochs while the job waits.
+        keep = {int(e) for e in mgr.top_epochs(mgr.max_to_keep)}
+        meta = json.loads(json.dumps(mgr.meta))
+        self._enqueue((data, int(epoch), int(current_iter),
+                       float(val_acc), keep, meta))
+
+    def save_latest(self, state, current_iter: int,
+                    write: bool = True) -> None:
+        """The preemption/rewind snapshot: ALWAYS synchronous, after a
+        drain — callers proceed only once the snapshot is durable (a
+        SIGTERM exit with the newest state still queued would lose it,
+        and a rewind must not race an in-flight epoch write)."""
+        self.drain()
+        with watchdog.phase("ckpt", detail="latest"):
+            self.manager.save_latest(state, current_iter, write=write)
+
+    def _enqueue(self, job) -> bool:
+        self._ensure_thread()
+        if self.queue_policy == "skip":
+            try:
+                self._queue.put_nowait(job)
+                return True
+            except queue.Full:
+                counter_inc(SKIPPED_SAVES)
+                flightrec.record("ckpt_skip", epoch=job[1])
+                warnings.warn(
+                    f"checkpoint queue full: skipped epoch {job[1]} save "
+                    f"(ckpt_queue_policy='skip'; storage is slower than "
+                    f"the epoch cadence)")
+                return False
+        t0 = time.perf_counter()
+        with watchdog.phase("ckpt", detail="blocked"):
+            self._queue.put(job)
+        blocked = time.perf_counter() - t0
+        if blocked > 0:
+            counter_inc(BLOCKED_SECONDS, blocked)
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+    def drain(self) -> None:
+        """Block until every enqueued write has been processed. The
+        quiesce point the preempt path, a rewind, the test protocol's
+        cross-host barrier and ``close`` all go through."""
+        if self._thread is None:
+            return
+        with watchdog.phase("ckpt", detail="drain"):
+            self._queue.join()
+
+    def close(self) -> None:
+        """Drain and stop the worker thread (idempotent). The writer
+        stays usable afterwards in synchronous-delegate terms only —
+        a later async save would start a fresh thread."""
+        if self._thread is None:
+            return
+        self.drain()
+        self._queue.put(None)
+        self._queue.join()
+        self._thread.join(timeout=10)
+        with self._lock:
+            self._thread = None
+
+    # -- registry publish --------------------------------------------------
+    def _maybe_publish(self, epoch: int, current_iter: int,
+                       val_acc: float) -> None:
+        """Publish the just-committed epoch checkpoint to the model
+        registry (REGISTRY.json next to the checkpoints) and retire any
+        live versions whose files retention has since pruned. Best-
+        effort: the registry is the serving plane's feed, and a failure
+        to publish must never fail training."""
+        if not self.publish:
+            return
+        try:
+            from howtotrainyourmamlpytorch_tpu.ckpt.registry import (
+                ModelRegistry)
+            if self._registry is None:
+                self._registry = ModelRegistry(self.manager.directory)
+            reg = self._registry.reload()
+            reg.publish(tag=str(int(epoch)), epoch=int(epoch),
+                        iteration=int(current_iter),
+                        val_acc=float(val_acc),
+                        fingerprint=self.manager.fingerprint(int(epoch)))
+            reg.retire_missing(self.manager.directory)
+            counter_inc(PUBLISHED)
+            flightrec.record("ckpt_publish", epoch=int(epoch),
+                             val_acc=float(val_acc))
+        except Exception as e:  # noqa: BLE001
+            warnings.warn(f"model-registry publish failed for epoch "
+                          f"{epoch} ({type(e).__name__}: {e}); serving "
+                          f"keeps polling the previous version")

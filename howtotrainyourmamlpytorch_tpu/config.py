@@ -254,6 +254,55 @@ class MAMLConfig:
                                            # (0 = the eval step count; must
                                            # stay within the checkpoint's
                                            # LSLR/BN per-step rows)
+    serve_registry_poll_s: float = 30.0    # min seconds between model-
+                                           # registry polls in
+                                           # ServingEngine.maybe_hot_swap
+                                           # (each poll is one small JSON
+                                           # read; 0 = poll on every call)
+    serve_canary_episodes: int = 2         # pinned probe episodes the
+                                           # hot-swap canary adapts +
+                                           # predicts on BOTH versions
+                                           # before swapping (capped at
+                                           # serve_batch_tasks — one
+                                           # compiled batch each)
+    serve_canary_acc_drop: float = 0.1     # max probe-accuracy drop
+                                           # (candidate vs live) the
+                                           # canary tolerates; the gate
+                                           # only bites when the LIVE
+                                           # version beats chance by
+                                           # more than this (probes the
+                                           # live model can't solve
+                                           # carry no accuracy signal);
+                                           # any non-finite candidate
+                                           # output fails regardless
+    serve_canary_latency_factor: float = 3.0
+                                           # max candidate/live adapt-
+                                           # latency ratio the canary
+                                           # tolerates (generous: the
+                                           # candidate's first batch may
+                                           # pay cache warmth, not a
+                                           # compile — executables are
+                                           # shared)
+
+    # ---- checkpoint lifecycle (ckpt/ subsystem, docs/CHECKPOINT.md) ----
+    ckpt_async: int = 0                    # 1 = epoch saves snapshot host-
+                                           # side and write on a background
+                                           # thread (bounded queue, depth
+                                           # 1); 0 = today's synchronous
+                                           # path, bitwise-identical
+    ckpt_queue_policy: str = "block"       # full-queue policy for async
+                                           # saves: 'block' waits (never
+                                           # loses a checkpoint; degrades
+                                           # toward synchronous), 'skip'
+                                           # drops the new save's file
+                                           # write (counted as
+                                           # ckpt/skipped_saves)
+    ckpt_publish: bool = True              # publish each committed epoch
+                                           # checkpoint (+ val acc +
+                                           # fingerprint) to REGISTRY.json
+                                           # so a ServingEngine can poll
+                                           # and hot-swap; main process
+                                           # only, best-effort
 
     # ---- optimization-health introspection (telemetry/health.py,
     # docs/OBSERVABILITY.md) --------------------------------------------
@@ -348,6 +397,14 @@ class MAMLConfig:
                                            # (an IDLE engine never trips —
                                            # only in-flight work is
                                            # deadlined)
+    watchdog_ckpt_timeout_s: float = 1800.0
+                                           # a checkpoint save the TRAIN
+                                           # thread waits on: a sync save,
+                                           # a 'block'-policy enqueue, the
+                                           # preempt/exit drain (ckpt/
+                                           # writer.py) — a save wedged on
+                                           # dead storage must trip, not
+                                           # hang the pod forever
     watchdog_poll_interval_s: float = 0.0  # monitor poll period; 0 = auto
                                            # (min enabled deadline / 4,
                                            # clamped to [0.05, 5] s)
@@ -450,9 +507,26 @@ class MAMLConfig:
                       "watchdog_collective_timeout_s",
                       "watchdog_compile_timeout_s",
                       "watchdog_serve_timeout_s",
+                      "watchdog_ckpt_timeout_s",
                       "watchdog_poll_interval_s"):
             if getattr(self, field) < 0:
                 raise ValueError(f"{field} must be >= 0 (0 = disabled)")
+        if self.ckpt_async not in (0, 1):
+            raise ValueError(
+                f"ckpt_async must be 0 (synchronous) or 1 (background "
+                f"writer), got {self.ckpt_async}")
+        if self.ckpt_queue_policy not in ("block", "skip"):
+            raise ValueError(
+                f"ckpt_queue_policy must be 'block' or 'skip', got "
+                f"{self.ckpt_queue_policy!r}")
+        if self.serve_registry_poll_s < 0:
+            raise ValueError("serve_registry_poll_s must be >= 0")
+        if self.serve_canary_episodes < 1:
+            raise ValueError("serve_canary_episodes must be >= 1")
+        if self.serve_canary_acc_drop < 0:
+            raise ValueError("serve_canary_acc_drop must be >= 0")
+        if self.serve_canary_latency_factor <= 0:
+            raise ValueError("serve_canary_latency_factor must be > 0")
         if self.flight_recorder_events < 1:
             raise ValueError("flight_recorder_events must be >= 1")
         if self.fault_spec:

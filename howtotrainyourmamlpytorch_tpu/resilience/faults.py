@@ -47,6 +47,12 @@ KINDS = (
                         # collective deadline (call-counted)
     "hang_step",        # the train loop sleeps at a dispatch-sync point
                         # at a train iteration
+    "kill_in_ckpt_write",  # os._exit(137) after a checkpoint tmp write
+                        # but BEFORE its atomic rename — a simulated
+                        # SIGKILL mid-save (call-counted over checkpoint
+                        # file writes; utils/checkpoint.py §
+                        # _write_bytes_atomic). Recovery must resume
+                        # from the last COMMITTED manifest entry.
 )
 
 # How long a hang_* fault sleeps (seconds). Long enough to overrun any

@@ -5,8 +5,8 @@ themselves; this module handles the ones that don't — a collective stuck
 because one peer died, a wedged data feed, a compile that never returns.
 Every long-running layer stamps a named phase on a process-wide
 :class:`ProgressBeacon` (``step``, ``feed``, ``collective``, ``compile``,
-``serve_request`` — host-side Python only, never inside a compiled
-executable), and a daemon :class:`Watchdog` thread checks the age of the
+``serve_request``, ``ckpt`` — host-side Python only, never inside a
+compiled executable), and a daemon :class:`Watchdog` thread checks the age of the
 *current* phase against that phase's deadline from config
 (``watchdog_step_timeout_s`` & friends; ``0`` disables a phase; compile
 phases get a separate, much larger budget so first-step compiles don't
@@ -41,6 +41,11 @@ PHASE_TIMEOUT_FIELDS = {
     "collective": "watchdog_collective_timeout_s",
     "compile": "watchdog_compile_timeout_s",
     "serve_request": "watchdog_serve_timeout_s",
+    # Checkpoint saves the TRAIN thread waits on (sync save, a
+    # 'block'-policy enqueue, the preempt/exit drain — ckpt/writer.py).
+    # The async writer's background thread never stamps the beacon; only
+    # caller-thread waits run under this deadline.
+    "ckpt": "watchdog_ckpt_timeout_s",
 }
 
 TRIPS_COUNTER = "watchdog/trips"

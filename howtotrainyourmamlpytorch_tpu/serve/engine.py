@@ -24,6 +24,21 @@ renders as the "serving" section.
 Single-process by design: serving replicates the (frozen) train state
 over the local mesh; multi-host serving would shard the mesh's ``dcn``
 axis exactly like training, but the queue/cache are per-process.
+
+Hot-swap (ckpt/ subsystem, docs/CHECKPOINT.md): a long-lived engine no
+longer serves its birth checkpoint forever. ``maybe_hot_swap`` polls the
+model registry (``REGISTRY.json`` the training writer publishes into),
+loads a newly published version OFF the request path, runs a canary —
+pinned probe episodes adapted + predicted on BOTH versions, compared on
+accuracy, adapt latency and finiteness — and atomically swaps the live
+state on pass. The adapted-params LRU is invalidated by construction:
+cache keys fold in the checkpoint-fingerprint context, so every entry
+adapted under the old weights misses under the new ones. A canary fail
+keeps the live version, counts ``serve/hot_swap_rollbacks`` and pins the
+rejected version so the next poll doesn't retry it. In-flight/queued
+requests are never dropped either way — the swap happens between
+``step`` calls, and whichever state is live when a group dequeues serves
+it.
 """
 
 from __future__ import annotations
@@ -39,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from howtotrainyourmamlpytorch_tpu import resilience
+from howtotrainyourmamlpytorch_tpu.ckpt.registry import ModelRegistry
 from howtotrainyourmamlpytorch_tpu.resilience import flightrec, watchdog
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.meta.outer import (
@@ -56,7 +72,7 @@ from howtotrainyourmamlpytorch_tpu.serve.cache import (
 from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
 from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
-    LATEST, CheckpointManager)
+    LATEST, CheckpointManager, CorruptCheckpointError)
 from howtotrainyourmamlpytorch_tpu.utils.tracing import JsonlLogger
 
 # Batch occupancy lives in [1/B, 1]; the registry's default exponential
@@ -132,6 +148,19 @@ class ServingEngine:
         # asserts on this, independent of registry wiring.
         self.adapt_invocations = 0
         self._cache_mirrored = (0, 0, 0)  # hits, misses, evictions
+        # Hot-swap state (maybe_hot_swap): the registry directory is set
+        # by from_checkpoint (it knows where the checkpoints live);
+        # engines built from a bare state never poll. Counters are
+        # eagerly registered so every flush row (and the report's
+        # checkpoint section) shows "0 swaps", not an absent key.
+        self._registry_dir: Optional[str] = None
+        self._model_version: Optional[int] = None
+        self._state_fingerprint: Optional[int] = None
+        self._rejected_versions: set = set()
+        self._last_registry_poll: Optional[float] = None
+        self._canary_probes: Optional[List[FewShotRequest]] = None
+        self.registry.counter("serve/hot_swaps")
+        self.registry.counter("serve/hot_swap_rollbacks")
         # Watchdog (resilience/watchdog.py): a serving process hangs the
         # same ways a training one does (wedged device, stuck transfer),
         # so the engine enforces watchdog_serve_timeout_s on each
@@ -171,8 +200,13 @@ class ServingEngine:
         if directory is None:
             directory = os.path.join(cfg.experiment_root,
                                      cfg.experiment_name, "saved_models")
+        # quarantine=False + sweep_stale=False: a serving process may
+        # attach to a LIVE training run's directory — it must never GC
+        # the writer's in-flight tmp files nor rename files the writer
+        # owns (read-only consumer discipline).
         ckpt = CheckpointManager(directory,
-                                 max_to_keep=cfg.max_models_to_save)
+                                 max_to_keep=cfg.max_models_to_save,
+                                 quarantine=False, sweep_stale=False)
         model_init, _ = make_model(cfg)
         template = init_train_state(cfg, model_init,
                                     jax.random.PRNGKey(cfg.seed))
@@ -180,8 +214,14 @@ class ServingEngine:
         state, _meta = ckpt.load(template, tag)
         state = migrate_lslr_rows(cfg, state)
         state = reconcile_loaded_shapes(cfg, state, template_shapes)
-        return cls(cfg, state, devices=devices, registry=registry,
-                   state_context=f"ckpt:{tag}:{ckpt.fingerprint(tag)}")
+        fingerprint = ckpt.fingerprint(tag)
+        engine = cls(cfg, state, devices=devices, registry=registry,
+                     state_context=f"ckpt:{tag}:{fingerprint}")
+        # Arm hot-swap: the checkpoint directory doubles as the model-
+        # registry location (REGISTRY.json next to the ckpt files).
+        engine._registry_dir = directory
+        engine._state_fingerprint = fingerprint
+        return engine
 
     def close(self) -> None:
         """Detach the process-wide compile listener and restore the
@@ -354,14 +394,18 @@ class ServingEngine:
 
     # -- compiled-step wrappers ------------------------------------------
     def _run_adapt(self, batch: Dict[str, np.ndarray],
-                   record: bool = True) -> AdaptedTask:
+                   record: bool = True,
+                   state: Optional[MetaTrainState] = None) -> AdaptedTask:
         """One compiled adapt-only step over a padded miss batch; timed
         with a hard sync so the histogram measures device time, not
-        dispatch time. ``record=False`` (warmup) keeps compile-dominated
-        calls out of the steady-state metrics."""
+        dispatch time. ``record=False`` (warmup, canary) keeps compile-
+        dominated and off-path calls out of the steady-state metrics.
+        ``state`` overrides the live state (the canary adapts under a
+        CANDIDATE version without touching what serving uses)."""
+        state = self.state if state is None else state
         t0 = time.perf_counter()
         adapted = self.steps.adapt(
-            self.state.params, self.state.lslr, self.state.bn_state,
+            state.params, state.lslr, state.bn_state,
             batch["support_x"], batch["support_y"], batch["support_w"])
         jax.block_until_ready(adapted.support_loss)
         if record:
@@ -374,9 +418,11 @@ class ServingEngine:
     def _run_predict(self, entries: List[Any],
                      group: List[FewShotRequest],
                      bucket: Tuple[int, int],
-                     record: bool = True) -> np.ndarray:
+                     record: bool = True,
+                     state: Optional[MetaTrainState] = None) -> np.ndarray:
         """One compiled predict step over the group's adapted params
         (batch padded by replicating entry 0)."""
+        state = self.state if state is None else state
         b = self.cfg.serve_batch_tasks
         q_b = bucket[1]
         h, w, c = self.cfg.image_shape
@@ -391,13 +437,230 @@ class ServingEngine:
         for i in range(len(group), b):
             qx[i] = qx[0]
         t0 = time.perf_counter()
-        logits = self.steps.predict(self.state.params, fast_stack,
+        logits = self.steps.predict(state.params, fast_stack,
                                     bn_stack, qx)
         logits = np.asarray(jax.device_get(logits))
         if record:
             self.registry.histogram("serve/predict_seconds").observe(
                 time.perf_counter() - t0)
         return logits
+
+    # -- hot-swap (model registry + canary) -------------------------------
+    def maybe_hot_swap(self, now: Optional[float] = None,
+                       force: bool = False) -> Optional[Dict[str, Any]]:
+        """Poll the model registry; canary + swap a newly published
+        version. Call from the serving loop BETWEEN ``step`` calls — the
+        load/canary/swap never touches an in-flight batch, so queued
+        requests are served (by whichever version is live when their
+        group dequeues), never dropped.
+
+        Returns None when there is nothing to do (no registry, poll
+        interval not elapsed, no new live version, version already
+        rejected); otherwise a dict with ``swapped`` and the canary
+        verdict. ``force`` bypasses the poll rate limit (tests, an
+        operator 'swap now' endpoint).
+        """
+        if self._registry_dir is None:
+            return None
+        t = time.monotonic() if now is None else now
+        if (not force and self._last_registry_poll is not None
+                and t - self._last_registry_poll
+                < self.cfg.serve_registry_poll_s):
+            return None
+        self._last_registry_poll = t
+        try:
+            rec = ModelRegistry(self._registry_dir).latest()
+        except Exception:  # noqa: BLE001 — a torn registry read must
+            # not break serving; the next poll re-reads.
+            self.registry.counter("serve/registry_errors").inc()
+            return None
+        if rec is None:
+            return None
+        version = int(rec.get("version") or 0)
+        if (self._model_version is not None
+                and version <= self._model_version) \
+                or version in self._rejected_versions:
+            return None
+        if (rec.get("fingerprint") is not None
+                and rec["fingerprint"] == self._state_fingerprint):
+            # The published version IS the bytes already being served
+            # (the engine was started from the checkpoint the trainer
+            # then published) — adopt the version number, skip the swap.
+            self._model_version = version
+            return None
+        # Load + canary + swap run under the serve_request deadline: a
+        # wedged device transfer or stuck canary batch during a swap is
+        # the same silent-hang class a wedged step() is, and must trip
+        # the watchdog instead of idling forever. (The canary reuses
+        # warmed executables; an unwarmed engine's first canary pays the
+        # compile like an unwarmed step() would.)
+        with watchdog.phase("serve_request", detail=f"hot_swap:{version}"):
+            return self._decide_swap(rec, version)
+
+    def _decide_swap(self, rec: Dict[str, Any],
+                     version: int) -> Optional[Dict[str, Any]]:
+        try:
+            candidate = self._load_version(rec)
+        except Exception as e:  # noqa: BLE001
+            # Only PROVABLY bad bytes (CRC-failed frame) pin the version
+            # rejected. Everything else — flaky NFS reads, and even
+            # FileNotFoundError (a stale NFS dirent can serve the new
+            # registry while ENOENT-ing the just-renamed ckpt) — retries
+            # on the next poll: a genuinely pruned file keeps failing
+            # cheaply until the publisher's retire_missing marks it, and
+            # a transient hiccup on the FINAL published version must not
+            # strand a long-lived engine on stale weights forever.
+            permanent = isinstance(e, CorruptCheckpointError)
+            if permanent:
+                self._rejected_versions.add(version)
+            self.registry.counter("serve/hot_swap_load_errors").inc()
+            flightrec.record("hot_swap_load_error", version=version,
+                             permanent=permanent,
+                             error=f"{type(e).__name__}: {e}"[:200])
+            return {"version": version, "swapped": False,
+                    "reason": f"load failed: {type(e).__name__}: {e}"}
+        verdict = self._run_canary(candidate)
+        if verdict["pass"]:
+            # Atomic from the request path's perspective: state, cache
+            # context and fingerprint flip together between steps. Old
+            # cache entries die by key (the fingerprint context), not by
+            # an explicit clear — the LRU evicts them as traffic warms
+            # the new version's entries.
+            self.state = candidate
+            self._fp_context = (f"ckpt:{rec['tag']}:"
+                                f"{rec.get('fingerprint')}")
+            self._state_fingerprint = rec.get("fingerprint")
+            self._model_version = version
+            self.registry.counter("serve/hot_swaps").inc()
+            flightrec.record("hot_swap", version=version, tag=rec["tag"])
+            return {"version": version, "swapped": True,
+                    "canary": verdict}
+        self._rejected_versions.add(version)
+        self.registry.counter("serve/hot_swap_rollbacks").inc()
+        flightrec.record("hot_swap_rollback", version=version,
+                         reason=verdict["reason"])
+        return {"version": version, "swapped": False, "canary": verdict}
+
+    def _load_version(self, rec: Dict[str, Any]) -> MetaTrainState:
+        """Load a published version through the same migrate/reconcile
+        chain ``from_checkpoint`` uses, replicated over the mesh. Runs
+        off the request path (between steps), so the transfer cost never
+        shows in a request's latency."""
+        directory = rec.get("directory") or self._registry_dir
+        ckpt = CheckpointManager(directory,
+                                 max_to_keep=self.cfg.max_models_to_save,
+                                 quarantine=False, sweep_stale=False)
+        tag = rec["tag"]
+        tag = int(tag) if str(tag).isdigit() else tag
+        template = init_train_state(self.cfg, self.model_init,
+                                    jax.random.PRNGKey(self.cfg.seed))
+        template_shapes = state_leaf_shapes(template)
+        state, _meta = ckpt.load(template, tag)
+        state = migrate_lslr_rows(self.cfg, state)
+        state = reconcile_loaded_shapes(self.cfg, state, template_shapes)
+        return jax.device_put(state, replicated_sharding(self.mesh))
+
+    def _probe_episodes(self) -> List[FewShotRequest]:
+        """Pinned canary probes: deterministic synthetic episodes at the
+        first bucket's geometry and the configured wire dtype, built
+        once per engine — the SAME episodes judge every candidate, so
+        canary verdicts are comparable across swaps."""
+        if self._canary_probes is not None:
+            return self._canary_probes
+        cfg = self.cfg
+        s_b, q_b = self.batcher.buckets[0]
+        h, w, c = cfg.image_shape
+        n = cfg.num_classes_per_set
+        dtype = np.uint8 if cfg.transfer_images_uint8 else np.float32
+        rng = np.random.RandomState(cfg.seed)
+        count = max(1, min(cfg.serve_canary_episodes,
+                           cfg.serve_batch_tasks))
+        probes = []
+        for _ in range(count):
+            if cfg.transfer_images_uint8:
+                sx = rng.randint(0, 256, (s_b, h, w, c)).astype(np.uint8)
+                qx = rng.randint(0, 256, (q_b, h, w, c)).astype(np.uint8)
+            else:
+                sx = rng.randn(s_b, h, w, c).astype(np.float32)
+                qx = rng.randn(q_b, h, w, c).astype(np.float32)
+            sy = np.arange(s_b, dtype=np.int32) % n
+            probes.append(FewShotRequest(
+                support_x=sx, support_y=sy, query_x=qx,
+                deadline=float("inf")))
+        self._canary_probes = probes
+        return probes
+
+    def _canary_eval(self, state: MetaTrainState) -> Dict[str, Any]:
+        """Adapt + predict the pinned probes under ``state`` (one
+        compiled batch each — the SAME executables serving uses, so no
+        new compile). Returns probe accuracy (labels are the probes' own
+        query positions modulo N — identical for both versions, so the
+        COMPARISON is meaningful even on synthetic pixels), adapt
+        latency, and finiteness."""
+        probes = self._probe_episodes()
+        bucket = self.batcher.buckets[0]
+        batch = pad_group(probes, bucket, self.cfg.serve_batch_tasks,
+                          self.cfg.image_shape)
+        t0 = time.perf_counter()
+        adapted = self._run_adapt(batch, record=False, state=state)
+        adapt_seconds = time.perf_counter() - t0
+        entries = [jax.tree.map(lambda x, j=j: x[j], adapted)
+                   for j in range(len(probes))]
+        logits = self._run_predict(entries, probes, bucket,
+                                   record=False, state=state)
+        n = self.cfg.num_classes_per_set
+        correct = total = 0
+        finite = bool(np.isfinite(
+            np.asarray(jax.device_get(adapted.support_loss))).all())
+        for i, req in enumerate(probes):
+            lg = np.asarray(logits[i, :req.num_query])
+            finite = finite and bool(np.isfinite(lg).all())
+            labels = np.arange(req.num_query) % n
+            correct += int((np.argmax(lg, axis=-1) == labels).sum())
+            total += req.num_query
+        return {"accuracy": correct / max(total, 1),
+                "adapt_seconds": adapt_seconds,
+                "finite": finite}
+
+    def _run_canary(self, candidate: MetaTrainState) -> Dict[str, Any]:
+        """The swap gate: candidate vs live on the pinned probes. Fails
+        on any non-finite candidate output, an accuracy drop beyond
+        ``serve_canary_acc_drop``, or adapt latency beyond
+        ``serve_canary_latency_factor`` x live (+5ms slack so micro-
+        second-scale tiny-model latencies can't flake the ratio).
+
+        The accuracy gate only bites when the LIVE version demonstrably
+        beats chance on the probes (by more than the tolerance): probes
+        the live model itself cannot solve carry no accuracy signal —
+        two unrelated checkpoints scoring near 1/N on noise differ by
+        sampling luck, and a gate on that luck would roll back good
+        versions at random (and, via the rejected-version pin, refuse
+        them forever)."""
+        cfg = self.cfg
+        live = self._canary_eval(self.state)
+        cand = self._canary_eval(candidate)
+        verdict = {"live": live, "candidate": cand, "pass": False,
+                   "reason": "ok"}
+        chance = 1.0 / cfg.num_classes_per_set
+        acc_signal = (live["accuracy"]
+                      > chance + cfg.serve_canary_acc_drop)
+        if not cand["finite"]:
+            verdict["reason"] = "candidate produced non-finite outputs"
+        elif (acc_signal and cand["accuracy"]
+                < live["accuracy"] - cfg.serve_canary_acc_drop):
+            verdict["reason"] = (
+                f"probe accuracy dropped {live['accuracy']:.4f} -> "
+                f"{cand['accuracy']:.4f} (> {cfg.serve_canary_acc_drop})")
+        elif cand["adapt_seconds"] > (live["adapt_seconds"]
+                                      * cfg.serve_canary_latency_factor
+                                      + 0.005):
+            verdict["reason"] = (
+                f"adapt latency {cand['adapt_seconds']:.4f}s vs live "
+                f"{live['adapt_seconds']:.4f}s (> x"
+                f"{cfg.serve_canary_latency_factor})")
+        else:
+            verdict["pass"] = True
+        return verdict
 
     # -- telemetry -------------------------------------------------------
     def export_trace(self, path: Optional[str] = None) -> Optional[str]:

@@ -17,8 +17,10 @@ from typing import Any, Dict, List, Optional, Union
 
 # v2: + "serving"; v3: + "resilience"; v4: + "data" (datastore
 # subsystem); v5: + "watchdog" (hang detection / flight recorder);
-# v6: + "health" (optimization-health introspection, telemetry/health.py)
-SCHEMA = "maml_tpu_telemetry_report_v6"
+# v6: + "health" (optimization-health introspection, telemetry/health.py);
+# v7: + "checkpoint" (ckpt/ lifecycle subsystem: async saves, GC,
+# serving hot-swap)
+SCHEMA = "maml_tpu_telemetry_report_v7"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -328,6 +330,44 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                                    h_warn_rows),
         }
 
+    # Checkpoint section (ckpt/ subsystem, schema v7): the writer's
+    # counters ride registry "metrics" rows like resilience/* and
+    # accumulate with the same reset detection — a preempted-and-
+    # restarted run's saves from the killed segment must still count.
+    # The hot-swap counters are serve-side (a serving process's flushed
+    # rows) but belong to the same lifecycle story. save/blocked seconds
+    # are counters of SECONDS (not histograms) so they merge across
+    # segments by the same rule. Runs predating the subsystem summarize
+    # the section to "unavailable".
+    _CKPT_KEYS = {
+        "saves": "ckpt/saves",
+        "save_seconds": "ckpt/save_seconds",
+        "blocked_seconds": "ckpt/blocked_seconds",
+        "skipped_saves": "ckpt/skipped_saves",
+        "gc_deletes": "ckpt/gc_deletes",
+        "hot_swaps": "serve/hot_swaps",
+        "rollbacks": "serve/hot_swap_rollbacks",
+    }
+    ckpt_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    c_totals: Dict[str, float] = {}
+    c_prev: Dict[str, float] = {}
+    for e in events:
+        if e.get("event") != "metrics":
+            continue
+        m = e.get("metrics") or {}
+        if not any(k.startswith("ckpt/") for k in m) \
+                and "serve/hot_swaps" not in m:
+            continue
+        for key in _CKPT_KEYS.values():
+            if m.get(key) is None:
+                continue
+            _accumulate_counter(c_totals, c_prev, key, float(m[key]))
+        ckpt_sec = {
+            label: (round(c_totals.get(key, 0.0), 3)
+                    if label.endswith("_seconds")
+                    else int(c_totals.get(key, 0)))
+            for label, key in _CKPT_KEYS.items()}
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -361,6 +401,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "data": data_sec,
         "watchdog": watchdog_sec,
         "health": health_sec,
+        "checkpoint": ckpt_sec,
     }
 
 
@@ -392,6 +433,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("data plane", summary["data"]),
         ("watchdog", summary["watchdog"]),
         ("health", summary["health"]),
+        ("checkpoint", summary["checkpoint"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

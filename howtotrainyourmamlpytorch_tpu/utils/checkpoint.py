@@ -18,6 +18,15 @@ writes retry transient IO with backoff; and ``load_latest_or_fallback``
 QUARANTINES an unreadable checkpoint (rename to ``*.corrupt``, drop its
 bookkeeping) so every later resume skips it instead of re-attempting the
 same damaged bytes.
+
+Lifecycle (docs/CHECKPOINT.md): every write fsyncs before its atomic
+rename (a host crash cannot commit a zero-length or torn file under a
+valid name) and transitions a ``MANIFEST.json`` record pending →
+committed (``ckpt/manifest.py``); resume prefers committed manifest
+records, and the writer-process constructor sweeps stale ``*.tmp``
+leftovers and pending records from a killed writer. The save path is
+split into ``encode`` / ``record_save`` / ``write_epoch_files`` halves
+so ``ckpt/writer.py`` can move the file half onto a background thread.
 """
 
 from __future__ import annotations
@@ -30,6 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 from flax import serialization
 
+from howtotrainyourmamlpytorch_tpu.ckpt import manifest as manifest_mod
 from howtotrainyourmamlpytorch_tpu.resilience import (
     counter_inc, faults, retry_io)
 from howtotrainyourmamlpytorch_tpu.utils.storage import (
@@ -40,7 +50,9 @@ LATEST = "latest"
 # Framed checkpoint layout: magic ‖ crc32(payload) ‖ len(payload) ‖ payload.
 # Files without the magic are pre-framing checkpoints and load as raw
 # payload — old checkpoints stay resumable, they just skip CRC coverage.
-_MAGIC = b"MAMLCKP1"
+# The magic constant lives in ckpt/manifest.py (the jax-free verifier
+# shares it) — one definition, two consumers.
+_MAGIC = manifest_mod.CKPT_MAGIC
 _HEADER_LEN = len(_MAGIC) + 4 + 8
 
 
@@ -77,7 +89,23 @@ def _write_bytes_atomic(path: str, data: bytes) -> None:
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
+        # Durability before atomicity: os.replace is atomic against
+        # CONCURRENT readers, but without the fsync a host crash can
+        # commit a zero-length or torn tmp under the valid name (the
+        # rename can reach disk before the data does).
+        f.flush()
+        os.fsync(f.fileno())
+    if faults.maybe_fire("kill_in_ckpt_write"):
+        # Simulated SIGKILL mid-save (chaos: ``kill_in_ckpt_write@N``,
+        # call-counted over checkpoint-file writes): the tmp bytes are
+        # durable but the rename — the commit point — never happens.
+        # Restart must resume from the last COMMITTED manifest entry
+        # and GC must sweep the tmp + pending record. 137 = the shell's
+        # SIGKILL convention, so the chaos harness can pin it.
+        os._exit(137)
     os.replace(tmp, path)
+    # Best-effort: make the directory entry (the rename) durable too.
+    manifest_mod.fsync_dir(os.path.dirname(path))
 
 
 @retry_io("checkpoint read")
@@ -92,7 +120,8 @@ class CheckpointManager:
     """Manages ``train_model_<epoch>.ckpt`` files + ``state.json``."""
 
     def __init__(self, directory: str, max_to_keep: int = 5,
-                 quarantine: bool = True):
+                 quarantine: bool = True,
+                 sweep_stale: Optional[bool] = None):
         self.directory = directory
         self.max_to_keep = max_to_keep
         # Whether THIS process may rename/delete damaged files during
@@ -101,6 +130,20 @@ class CheckpointManager:
         self.quarantine = quarantine
         os.makedirs(directory, exist_ok=True)
         self._meta_path = os.path.join(directory, "state.json")
+        # Committed-checkpoint manifest (ckpt/manifest.py): pending →
+        # committed records around every file write; resume prefers
+        # committed records. Absent/damaged manifests degrade every
+        # consumer to the pre-manifest directory-scan behavior.
+        self.manifest = manifest_mod.Manifest(directory)
+        # Startup GC: sweep ``*.tmp`` leftovers (a killed writer — incl.
+        # the stranded ``latest.tmp`` link path) and pending records
+        # whose write never committed. Writer-process only (default:
+        # follows ``quarantine``): a read-only consumer (a serving
+        # engine attaching to a LIVE run's directory) must never delete
+        # the live writer's in-flight tmp.
+        do_sweep = quarantine if sweep_stale is None else sweep_stale
+        if do_sweep:
+            self._sweep_stale()
         # Whether bookkeeping came from disk: a checkpoint FILE without
         # state.json (partial copy) must not be silently resumed with
         # default meta — that restarts iteration/schedules/ensemble
@@ -117,6 +160,23 @@ class CheckpointManager:
                          "val_acc_per_epoch": {}, "iter_at_epoch": {},
                          "best_val_acc": 0.0, "best_val_epoch": -1,
                          "rewinds": 0}
+
+    def _sweep_stale(self) -> None:
+        """GC the leftovers a killed writer strands: ``*.tmp`` files and
+        ``pending`` manifest records (their final-path files, if any,
+        hold the PREVIOUS committed bytes — renames are atomic — so only
+        the record is dropped, never the file). ``*.corrupt`` quarantine
+        leftovers are deliberately left for forensics; the admin CLI's
+        ``gc`` removes them."""
+        swept = manifest_mod.sweep(self.manifest, keep_tags=None,
+                                   remove_corrupt=False)
+        n = len(swept["deleted_files"]) + len(swept["dropped_records"])
+        if n:
+            counter_inc("ckpt/gc_deletes", n)
+            warnings.warn(
+                f"checkpoint GC swept {swept['deleted_files']} and "
+                f"pending record(s) {swept['dropped_records']} (a "
+                f"previous writer died mid-save)", stacklevel=3)
 
     # -- paths ----------------------------------------------------------
     def _ckpt_path(self, tag) -> str:
@@ -137,6 +197,79 @@ class CheckpointManager:
                 f.write(bytes([byte[0] ^ 0xFF]))
 
     # -- save -----------------------------------------------------------
+    # The save is split into three halves so ckpt/writer.py can run the
+    # file half on a background thread: ``encode`` (host snapshot, caller
+    # thread), ``record_save`` (in-memory bookkeeping, every process,
+    # caller thread), ``write_epoch_files`` (all IO — writer process,
+    # any thread). ``save`` composes them synchronously; the on-disk
+    # result is identical either way.
+    def encode(self, state) -> bytes:
+        """Host-side snapshot: fetch + msgpack + MAMLCKP1 framing. After
+        this returns, the bytes are independent of later device-side
+        training steps."""
+        return _frame_payload(serialization.to_bytes(jax.device_get(state)))
+
+    def record_save(self, epoch: int, current_iter: int,
+                    val_acc: float) -> None:
+        """Bookkeeping half of an epoch save (no IO)."""
+        self.meta["current_iter"] = int(current_iter)
+        self.meta["current_epoch"] = int(epoch)
+        self.meta["val_acc_per_epoch"][str(epoch)] = float(val_acc)
+        self.meta["iter_at_epoch"][str(epoch)] = int(current_iter)
+        if val_acc >= self.meta["best_val_acc"]:
+            self.meta["best_val_acc"] = float(val_acc)
+            self.meta["best_val_epoch"] = int(epoch)
+
+    def write_epoch_files(self, data: bytes, epoch: int,
+                          current_iter: int, val_acc: float,
+                          keep=None, meta: Optional[Dict[str, Any]] = None
+                          ) -> None:
+        """File half of an epoch save: the epoch checkpoint (manifest
+        pending → committed), the 'latest' link, retention pruning and
+        ``state.json``. ``keep``/``meta`` freeze an async job's view;
+        the synchronous path passes neither and uses the live state."""
+        meta = self.meta if meta is None else meta
+        crc = zlib.crc32(data)
+        epoch_path = self._ckpt_path(epoch)
+        # Manifest discipline vs fsync budget: only the epoch tag's
+        # ``begin`` is flushed before the write (THE kill breadcrumb);
+        # both commits, the latest record and the prune drops batch
+        # into ONE durable rewrite at the end — a kill inside the
+        # window leaves either the pending breadcrumb or a stale-but-
+        # self-consistent previous manifest, both of which resume
+        # handles, and the sync save path pays 2 manifest fsyncs per
+        # epoch instead of 4+.
+        self.manifest.begin(str(int(epoch)), epoch=int(epoch),
+                            iteration=int(current_iter),
+                            val_acc=float(val_acc))
+        self._atomic_write(epoch_path, data)
+        self.manifest.commit(str(int(epoch)), nbytes=len(data), crc=crc,
+                             flush=False)
+        # 'latest' is a hard link to the epoch file (atomic via tmp
+        # link + rename) — one full write per save instead of two.
+        # Filesystems without hard links (gcsfuse, some NFS/overlay
+        # mounts) fall back to a second full write.
+        self.manifest.begin(LATEST, epoch=int(epoch),
+                            iteration=int(current_iter),
+                            val_acc=float(val_acc), flush=False)
+        latest_tmp = self._ckpt_path(LATEST) + ".tmp"
+        if os.path.exists(latest_tmp):
+            os.remove(latest_tmp)
+        try:
+            os.link(epoch_path, latest_tmp)
+        except OSError:
+            with open(latest_tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(latest_tmp, self._ckpt_path(LATEST))
+        manifest_mod.fsync_dir(self.directory)
+        self.manifest.commit(LATEST, nbytes=len(data), crc=crc,
+                             flush=False)
+        self._prune(keep, flush=False)
+        self.manifest.flush()
+        save_to_json(self._meta_path, meta)
+
     def save(self, state, epoch: int, current_iter: int,
              val_acc: float, write: bool = True) -> None:
         """Write the epoch checkpoint + latest, update bookkeeping, prune
@@ -147,35 +280,10 @@ class CheckpointManager:
         ensemble test protocol, but exactly one may touch the shared
         filesystem.
         """
+        data = self.encode(state) if write else None
+        self.record_save(epoch, current_iter, val_acc)
         if write:
-            data = _frame_payload(
-                serialization.to_bytes(jax.device_get(state)))
-            epoch_path = self._ckpt_path(epoch)
-            self._atomic_write(epoch_path, data)
-            # 'latest' is a hard link to the epoch file (atomic via tmp
-            # link + rename) — one full write per save instead of two.
-            # Filesystems without hard links (gcsfuse, some NFS/overlay
-            # mounts) fall back to a second full write.
-            latest_tmp = self._ckpt_path(LATEST) + ".tmp"
-            if os.path.exists(latest_tmp):
-                os.remove(latest_tmp)
-            try:
-                os.link(epoch_path, latest_tmp)
-            except OSError:
-                with open(latest_tmp, "wb") as f:
-                    f.write(data)
-            os.replace(latest_tmp, self._ckpt_path(LATEST))
-
-        self.meta["current_iter"] = int(current_iter)
-        self.meta["current_epoch"] = int(epoch)
-        self.meta["val_acc_per_epoch"][str(epoch)] = float(val_acc)
-        self.meta["iter_at_epoch"][str(epoch)] = int(current_iter)
-        if val_acc >= self.meta["best_val_acc"]:
-            self.meta["best_val_acc"] = float(val_acc)
-            self.meta["best_val_epoch"] = int(epoch)
-        if write:
-            self._prune()
-            save_to_json(self._meta_path, self.meta)
+            self.write_epoch_files(data, epoch, current_iter, val_acc)
 
     def save_latest(self, state, current_iter: int,
                     write: bool = True) -> None:
@@ -187,19 +295,29 @@ class CheckpointManager:
         self.meta["current_iter"] = int(current_iter)
         if not write:
             return
-        self._atomic_write(
-            self._ckpt_path(LATEST),
-            _frame_payload(serialization.to_bytes(jax.device_get(state))))
+        data = self.encode(state)
+        self.manifest.begin(LATEST, iteration=int(current_iter))
+        self._atomic_write(self._ckpt_path(LATEST), data)
+        self.manifest.commit(LATEST, nbytes=len(data),
+                             crc=zlib.crc32(data))
         save_to_json(self._meta_path, self.meta)
 
-    def _prune(self) -> None:
-        keep = {int(e) for e in self.top_epochs(self.max_to_keep)}
+    def _prune(self, keep=None, flush: bool = True) -> None:
+        if keep is None:
+            keep = {int(e) for e in self.top_epochs(self.max_to_keep)}
+        keep = {int(e) for e in keep}
+        pruned = []
         for name in self._ckpt_files_on_disk():
             tag = name[len("train_model_"):-len(".ckpt")]
             if tag == LATEST or not tag.isdigit():
                 continue
             if int(tag) not in keep:
                 os.remove(os.path.join(self.directory, name))
+                pruned.append(tag)
+        # One durable manifest rewrite for the whole prune, not one per
+        # file — each rewrite is an fsync round trip on the save path
+        # (write_epoch_files batches it further into its final flush).
+        self.manifest.remove_many(pruned, flush=flush)
 
     # -- load -----------------------------------------------------------
     def load(self, template_state, tag=LATEST):
@@ -238,6 +356,7 @@ class CheckpointManager:
             os.replace(path, path + ".corrupt")
         except OSError:
             return
+        self.manifest.remove(str(tag))
         counter_inc("resilience/quarantined")
         warnings.warn(
             f"quarantined unreadable checkpoint {os.path.basename(path)} "
@@ -269,11 +388,42 @@ class CheckpointManager:
 
         Returns ``(state, meta, tag)`` where ``tag`` is ``'latest'`` or
         the epoch actually loaded.
+
+        Manifest preference (docs/CHECKPOINT.md): a candidate whose
+        manifest record is still ``pending`` is skipped outright (the
+        write never committed — on the writer process the startup sweep
+        already dropped it, but a non-writer host may still see it), and
+        a COMMITTED record lets damage be detected by one
+        ``os.path.getsize`` probe against the recorded byte count
+        instead of a full read-and-CRC attempt. Tags without a record
+        (pre-manifest directories) behave exactly as before.
         """
         def brief(e: Exception) -> str:
             # msgpack's ExtraData repr embeds the remaining (multi-MB)
             # buffer — keep messages human-sized.
             return f"{type(e).__name__}: {str(e)[:160]}"
+
+        def manifest_verdict(tag) -> Optional[Tuple[str, bool]]:
+            """(reason, damaged) the manifest alone can prove, else
+            None. ``damaged=True`` means the file's bytes provably
+            disagree with a committed record (quarantine it);
+            ``damaged=False`` means an uncommitted write (skip WITHOUT
+            quarantine — the final-path file, if any, holds the
+            previous committed version)."""
+            rec = self.manifest.get(str(tag))
+            if rec is None:
+                return None
+            if rec.get("status") != manifest_mod.COMMITTED:
+                return ("manifest records an uncommitted (pending) "
+                        "write", False)
+            try:
+                size = os.path.getsize(self._ckpt_path(tag))
+            except OSError:
+                return None  # missing file: the load attempt reports it
+            if size != int(rec.get("bytes") or 0):
+                return (f"size {size} != manifest-committed "
+                        f"{rec.get('bytes')} bytes", True)
+            return None
 
         failures = []
         if not self.meta_from_disk:
@@ -283,20 +433,34 @@ class CheckpointManager:
             failures.append((LATEST, "state.json missing — resume "
                                      "iteration unknown"))
         else:
-            try:
-                state, meta = self.load(template_state, LATEST)
-                return state, meta, LATEST
-            except Exception as e:  # missing file or corrupt bytes (the
-                # msgpack/flax error types vary) — both are
-                # external-damage modes, e.g. a partial rsync
-                failures.append((LATEST, brief(e)))
-                if not isinstance(e, FileNotFoundError):
+            verdict = manifest_verdict(LATEST)
+            if verdict is not None:
+                reason, damaged = verdict
+                failures.append((LATEST, reason))
+                if damaged:
                     self._quarantine(LATEST)
+            else:
+                try:
+                    state, meta = self.load(template_state, LATEST)
+                    return state, meta, LATEST
+                except Exception as e:  # missing file or corrupt bytes
+                    # (the msgpack/flax error types vary) — both are
+                    # external-damage modes, e.g. a partial rsync
+                    failures.append((LATEST, brief(e)))
+                    if not isinstance(e, FileNotFoundError):
+                        self._quarantine(LATEST)
         epochs = sorted(
             (int(e) for e in self.meta["iter_at_epoch"]
              if self.has_checkpoint(int(e))),
             key=lambda e: self.meta["iter_at_epoch"][str(e)], reverse=True)
         for epoch in epochs:
+            verdict = manifest_verdict(epoch)
+            if verdict is not None:
+                reason, damaged = verdict
+                failures.append((epoch, reason))
+                if damaged:
+                    self._quarantine(epoch)
+                continue
             try:
                 state, meta = self.load(template_state, epoch)
             except Exception as e:
@@ -378,17 +542,11 @@ class CheckpointManager:
         Not a full hash — a deliberate cost/coverage trade (multi-MB reads
         per host per resume vs 128 bytes); size+boundary bytes catch
         truncation and version skew, not a midfile bitflip. -1 = unreadable.
+        The algorithm lives in ``ckpt/manifest.py § file_fingerprint`` so
+        the jax-free admin CLI and the model registry compute the same
+        value for the same bytes.
         """
-        path = self._ckpt_path(tag)
-        try:
-            size = os.path.getsize(path)
-            with open(path, "rb") as f:
-                head = f.read(64)
-                f.seek(max(size - 64, 0))
-                tail = f.read(64)
-        except OSError:
-            return -1
-        return zlib.crc32(size.to_bytes(8, "little") + head + tail)
+        return manifest_mod.file_fingerprint(self._ckpt_path(tag))
 
     def has_any_checkpoint(self) -> bool:
         """Any checkpoint FILE at all — a disk scan, deliberately not the

@@ -26,6 +26,7 @@ import json
 import os
 from typing import Any, Dict, List, Sequence
 
+from howtotrainyourmamlpytorch_tpu.ckpt.manifest import fsync_dir
 from howtotrainyourmamlpytorch_tpu.resilience import faults, retry_io
 
 
@@ -85,7 +86,15 @@ def save_to_json(path: str, obj: Any) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(obj, f, indent=2)
+        # Durability before atomicity (docs/CHECKPOINT.md): resume
+        # hard-depends on state.json — a crash that commits the rename
+        # before the data would leave a torn file under the valid name
+        # and brick every restart while the (fsync'd) checkpoints are
+        # fine.
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))  # best-effort
 
 
 @retry_io("json read")

@@ -13,7 +13,15 @@ Runs the ISSUE 3 acceptance scenario on a tiny synthetic config:
    snapshot). The phase ends "preempted".
 3. **restart** — resume from 'latest' with NO faults; the run completes
    epoch 1 and the test protocol.
-4. **hang** (ISSUE 6) — a SUBPROCESS run (the watchdog kills its whole
+4. **ckpt_kill** (ISSUE 8) — a SUBPROCESS run with ``ckpt_async=1`` and
+   an injected SIGKILL-equivalent DURING the second epoch-checkpoint
+   write (``kill_in_ckpt_write@2`` — after the tmp bytes, before the
+   atomic rename; exit 137): the manifest must show epoch 0's entries
+   committed and epoch 1's stranded ``pending``, then a clean restart
+   must resume from the last COMMITTED entry (epoch 0's iteration),
+   sweep the pending record + ``*.tmp``, quarantine NOTHING (every
+   surviving file is good) and finish through the test protocol.
+5. **hang** (ISSUE 6) — a SUBPROCESS run (the watchdog kills its whole
    process with ``os._exit``) with an injected wedged data feed
    (``hang_feed@N``) and a tight ``watchdog_feed_timeout_s``: the
    watchdog must trip within its deadline, write a crash bundle
@@ -24,10 +32,11 @@ Runs the ISSUE 3 acceptance scenario on a tiny synthetic config:
 The verdict requires `resilience/rewinds >= 1`, `resilience/io_retries
 >= 1`, exactly one preemption, the health subsystem's grad-norm early
 warning landing strictly BEFORE the rewind in the faulted phase's log
-(ISSUE 7 — `health_grad_norm_warn` precedes `rewind`), hang exit code
-74 + bundle present + hang-restart completion, and final test
-accuracies (restart AND hang-restart) within ``--tolerance`` of the
-baseline.
+(ISSUE 7 — `health_grad_norm_warn` precedes `rewind`), the ckpt_kill
+phase recovering from the last committed manifest entry (ISSUE 8 —
+`ckpt_kill_*` keys), hang exit code 74 + bundle present + hang-restart
+completion, and final test accuracies (restart, ckpt-kill-restart AND
+hang-restart) within ``--tolerance`` of the baseline.
 
 Artifact contract (bench.py discipline): the LAST stdout JSON line is
 authoritative — ``{"metric": "chaos_recovery", "status":
@@ -155,6 +164,77 @@ def run_hang_phase(out: str, platform: str):
     }
 
 
+def run_ckpt_kill_phase(out: str, platform: str):
+    """The ISSUE 8 kill-during-save scenario, in a subprocess (the
+    injected fault ends its process with ``os._exit(137)``).
+
+    With ``ckpt_async=1``, epoch 0 saves (committed by the background
+    writer), then ``kill_in_ckpt_write@2`` kills the process after epoch
+    1's tmp bytes are written but BEFORE the atomic rename — the
+    classic torn-save window. Returns the phase's pre-restart facts:
+    exit code, the manifest's committed/pending view, tmp leftovers.
+    """
+    import glob
+    cfg = tiny_cfg(out, "chaos_ckpt", fault_spec="kill_in_ckpt_write@2",
+                   ckpt_async=1)
+    cfg_path = os.path.join(out, "chaos_ckpt_config.json")
+    os.makedirs(out, exist_ok=True)
+    with open(cfg_path, "w") as f:
+        json.dump(cfg.to_dict(), f)
+    env = dict(os.environ)
+    env.pop("MAML_FAULTS", None)  # the plan must come from the config
+    if platform:
+        env["MAML_JAX_PLATFORM"] = platform
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "train_maml_system.py"),
+         "--name_of_args_json_file", cfg_path],
+        env=env, capture_output=True, text=True, timeout=900)
+
+    saved = os.path.join(out, "chaos_ckpt", "saved_models")
+    committed_iter = None
+    pending = []
+    manifest_path = os.path.join(saved, "MANIFEST.json")
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            records = json.load(f).get("records", {})
+        pending = [t for t, r in records.items()
+                   if r.get("status") != "committed"]
+        committed = [r for r in records.values()
+                     if r.get("status") == "committed"]
+        if committed:
+            committed_iter = max(int(r.get("iter") or 0)
+                                 for r in committed)
+    return {
+        "exit_code": proc.returncode,
+        "committed_iter": committed_iter,
+        "pending_before_restart": len(pending),
+        "tmp_before_restart": len(glob.glob(
+            os.path.join(saved, "*.tmp"))),
+        "stderr_tail": proc.stderr[-800:] if proc.returncode != 137
+        else None,
+    }
+
+
+def ckpt_dir_state(out: str):
+    """Post-restart checkpoint-directory facts: pending records, tmp
+    leftovers, quarantine files — all of which recovery must have left
+    at zero."""
+    import glob
+    saved = os.path.join(out, "chaos_ckpt", "saved_models")
+    pending = 0
+    manifest_path = os.path.join(saved, "MANIFEST.json")
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            records = json.load(f).get("records", {})
+        pending = sum(1 for r in records.values()
+                      if r.get("status") != "committed")
+    return {
+        "pending": pending,
+        "tmp": len(glob.glob(os.path.join(saved, "*.tmp"))),
+        "corrupt": len(glob.glob(os.path.join(saved, "*.corrupt"))),
+    }
+
+
 def counter_sum(snapshots, key) -> int:
     return int(sum(float(s.get(key) or 0) for s in snapshots))
 
@@ -234,6 +314,20 @@ def main(argv=None) -> int:
     restart_result, restart_counters = run_phase(
         tiny_cfg(out, "chaos_faulted", continue_from_epoch="latest"))
 
+    # Kill-during-save scenario (ISSUE 8): async writer + SIGKILL mid-
+    # write -> restart from the last COMMITTED manifest entry.
+    print(json.dumps({"phase": "ckpt_kill",
+                      "spec": "kill_in_ckpt_write@2",
+                      "status": "running"}), flush=True)
+    ckpt_kill = run_ckpt_kill_phase(
+        out, platform or os.environ.get("JAX_PLATFORMS", ""))
+    print(json.dumps({"phase": "ckpt_kill_restart", "status": "running"}),
+          flush=True)
+    ckpt_restart_result, ckpt_restart_counters = run_phase(
+        tiny_cfg(out, "chaos_ckpt", continue_from_epoch="latest",
+                 ckpt_async=1))
+    ckpt_dir_after = ckpt_dir_state(out)
+
     # Hang scenario (ISSUE 6): wedged feed -> watchdog trip -> exit 74 +
     # crash bundle, then a clean restart resumes past the hang.
     print(json.dumps({"phase": "hang", "spec": "hang_feed@5",
@@ -274,15 +368,39 @@ def main(argv=None) -> int:
         and hang["watchdog_trips"] >= 1
         and hang_delta is not None and hang_delta <= args.tolerance)
 
+    # ISSUE 8 gate: the kill landed mid-write (exit 137, a pending
+    # record + tmp stranded), the restart resumed from the last
+    # COMMITTED manifest entry (epoch 0's boundary — iteration
+    # total_iter_per_epoch), finished the run, GC swept the wreckage,
+    # and NO good file was quarantined along the way.
+    ckpt_acc = (ckpt_restart_result or {}).get("test_accuracy_mean")
+    ckpt_delta = (abs(ckpt_acc - base_acc)
+                  if base_acc is not None and ckpt_acc is not None
+                  else None)
+    ckpt_kill_recovered = bool(
+        ckpt_kill["exit_code"] == 137
+        and ckpt_kill["pending_before_restart"] >= 1
+        and ckpt_kill["committed_iter"] == 4  # total_iter_per_epoch:
+        #   epoch 0's boundary — the last committed entry
+        and ckpt_acc is not None
+        and ckpt_delta is not None and ckpt_delta <= args.tolerance
+        and ckpt_dir_after["pending"] == 0
+        and ckpt_dir_after["tmp"] == 0
+        and ckpt_dir_after["corrupt"] == 0
+        and counter_sum([ckpt_restart_counters],
+                        "resilience/quarantined") == 0)
+
     recovered = bool(
         preempted and rewinds >= 1 and io_retries >= 1
         and warn_before_rewind
         and chaos_acc is not None
         and delta is not None and delta <= args.tolerance
+        and ckpt_kill_recovered
         and hang_recovered)
     # Recoveries: one per distinct fault class the run survived.
     recoveries = (int(preempted) + int(rewinds >= 1)
-                  + int(io_retries >= 1) + int(hang_recovered))
+                  + int(io_retries >= 1) + int(ckpt_kill_recovered)
+                  + int(hang_recovered))
 
     artifact = {
         "metric": "chaos_recovery",
@@ -304,6 +422,21 @@ def main(argv=None) -> int:
         "chaos_test_accuracy": chaos_acc,
         "test_accuracy_delta": (round(delta, 6)
                                 if delta is not None else None),
+        "ckpt_kill_exit_code": ckpt_kill["exit_code"],
+        "ckpt_kill_committed_iter": ckpt_kill["committed_iter"],
+        "ckpt_kill_pending_before_restart":
+            ckpt_kill["pending_before_restart"],
+        "ckpt_kill_tmp_before_restart": ckpt_kill["tmp_before_restart"],
+        "ckpt_kill_pending_after_restart": ckpt_dir_after["pending"],
+        "ckpt_kill_tmp_after_restart": ckpt_dir_after["tmp"],
+        "ckpt_kill_quarantined": counter_sum(
+            [ckpt_restart_counters], "resilience/quarantined"),
+        "ckpt_kill_stderr_tail": ckpt_kill["stderr_tail"],
+        "ckpt_kill_test_accuracy": ckpt_acc,
+        "ckpt_kill_test_accuracy_delta": (round(ckpt_delta, 6)
+                                          if ckpt_delta is not None
+                                          else None),
+        "ckpt_kill_recovered": ckpt_kill_recovered,
         "hang_exit_code": hang["hang_exit_code"],
         "hang_stacks_dumped": hang["stacks_dumped"],
         "hang_flight_rows": hang["flight_rows"],

@@ -53,6 +53,12 @@ def test_bench_quick_emits_full_capture_contract():
     # health-enabled --config leg (test below).
     assert first["outer_grad_norm"] is None
     assert first["health_overhead_frac"] is None
+    # Checkpoint keys (ISSUE 8): one real synchronous save is timed
+    # against a temp dir — always measured (fail-soft null only on a
+    # broken temp mount), and the epoch-stall fraction is a proper
+    # fraction.
+    assert first["ckpt_save_seconds"] > 0
+    assert 0 <= first["ckpt_blocking_frac"] < 1
     # The authoritative LAST line is a strict superset with all three
     # measurement groups.
     for key in ("value", "run_weighted_tasks_per_sec_per_chip",

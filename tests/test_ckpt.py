@@ -1,0 +1,799 @@
+"""Checkpoint lifecycle subsystem (ckpt/, docs/CHECKPOINT.md): async
+double-buffered writer, committed manifest + GC, model registry, serving
+hot-swap, and the jax-free admin CLI.
+
+Tier-1: manifest/registry/writer units, durability (fsync-before-
+rename, stale-tmp sweep), manifest-preferred fallback, the
+kill-in-ckpt-write fault site (subprocess), canary pass/fail/rollback,
+fingerprint-keyed cache invalidation, the admin-CLI artifact contract.
+Slow: async-vs-sync full-run + resume bitwise parity; hot-swap under
+live synthetic load with zero dropped requests.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu import resilience
+from howtotrainyourmamlpytorch_tpu.ckpt import manifest as manifest_mod
+from howtotrainyourmamlpytorch_tpu.ckpt.registry import ModelRegistry
+from howtotrainyourmamlpytorch_tpu.ckpt.writer import CheckpointWriter
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+    CheckpointManager)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = MAMLConfig(image_height=8, image_width=8, image_channels=1,
+                 num_classes_per_set=2, cnn_num_filters=4, num_stages=1,
+                 number_of_training_steps_per_iter=2,
+                 number_of_evaluation_steps_per_iter=2,
+                 compute_dtype="float32")
+
+
+def _state():
+    init, _ = make_model(CFG)
+    return init_train_state(CFG, init, jax.random.PRNGKey(0))
+
+
+@pytest.fixture
+def res_registry():
+    """A fresh metrics registry installed as the process resilience
+    registry for the test's duration (ckpt/* counters land here)."""
+    reg = MetricsRegistry()
+    prev = resilience.set_registry(reg)
+    yield reg
+    resilience.set_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# manifest
+# ---------------------------------------------------------------------------
+
+def test_manifest_pending_committed_transitions(tmp_path):
+    man = manifest_mod.Manifest(str(tmp_path))
+    assert not man.loaded and man.records == {}
+    man.begin("3", epoch=3, iteration=40, val_acc=0.5)
+    # The pending record is on DISK immediately (the crash breadcrumb).
+    reread = manifest_mod.Manifest(str(tmp_path))
+    assert reread.get(3)["status"] == manifest_mod.PENDING
+    assert reread.get("3")["iter"] == 40
+    assert reread.pending() and not reread.committed()
+    man.commit("3", nbytes=128, crc=0xDEAD)
+    reread = manifest_mod.Manifest(str(tmp_path))
+    rec = reread.get("3")
+    assert rec["status"] == manifest_mod.COMMITTED
+    assert rec["bytes"] == 128 and rec["crc"] == 0xDEAD
+    assert reread.latest_committed()["tag"] == "3"
+    # 'latest' outranks an epoch at the same iteration.
+    man.begin("latest", iteration=40)
+    man.commit("latest", nbytes=128, crc=1)
+    assert manifest_mod.Manifest(
+        str(tmp_path)).latest_committed()["tag"] == "latest"
+
+
+def test_manifest_damage_degrades_to_empty(tmp_path):
+    (tmp_path / manifest_mod.MANIFEST_FILE).write_text("{not json")
+    man = manifest_mod.Manifest(str(tmp_path))
+    assert not man.loaded and man.records == {}
+    # ...and stays writable (the next transition rewrites it whole).
+    man.begin("0", iteration=1)
+    assert manifest_mod.Manifest(str(tmp_path)).loaded
+
+
+def test_manifest_sweep_rules(tmp_path):
+    d = str(tmp_path)
+    man = manifest_mod.Manifest(d)
+    # committed with file; committed with file, outside retention;
+    # committed whose file vanished; pending whose final file exists
+    # (holds the PREVIOUS version — must survive); plus tmp/corrupt
+    # debris.
+    for tag, data in (("1", b"a" * 10), ("2", b"b" * 10),
+                      ("latest", b"a" * 10)):
+        (tmp_path / f"train_model_{tag}.ckpt").write_bytes(data)
+        man.begin(tag, iteration=int(tag) if tag.isdigit() else 9)
+        man.commit(tag, nbytes=10, crc=0)
+    man.begin("9", iteration=90)
+    man.commit("9", nbytes=10, crc=0)  # file never written ("vanished")
+    (tmp_path / "train_model_5.ckpt").write_bytes(b"previous-good")
+    man.begin("5", iteration=50)       # pending: killed mid-write
+    (tmp_path / "train_model_5.ckpt.tmp").write_bytes(b"torn")
+    (tmp_path / "train_model_0.ckpt.corrupt").write_bytes(b"x")
+
+    swept = manifest_mod.sweep(man, keep_tags=["2"], remove_corrupt=True)
+    assert "train_model_5.ckpt.tmp" in swept["deleted_files"]
+    assert "train_model_0.ckpt.corrupt" in swept["deleted_files"]
+    assert "train_model_1.ckpt" in swept["deleted_files"]  # retention
+    assert set(swept["dropped_records"]) == {"1", "5", "9"}
+    # The pending tag's FINAL file survives (previous committed bytes).
+    assert (tmp_path / "train_model_5.ckpt").exists()
+    assert (tmp_path / "train_model_2.ckpt").exists()
+    assert (tmp_path / "train_model_latest.ckpt").exists()
+    reread = manifest_mod.Manifest(d)
+    assert set(reread.records) == {"2", "latest"}
+    # Dry-run reports without touching.
+    (tmp_path / "train_model_7.ckpt.tmp").write_bytes(b"t")
+    dry = manifest_mod.sweep(reread, dry_run=True)
+    assert dry["deleted_files"] == ["train_model_7.ckpt.tmp"]
+    assert (tmp_path / "train_model_7.ckpt.tmp").exists()
+
+
+def test_verify_record_detects_damage(tmp_path):
+    d = str(tmp_path)
+    man = manifest_mod.Manifest(d)
+    data = b"payload-bytes"
+    (tmp_path / "train_model_1.ckpt").write_bytes(data)
+    man.begin("1", iteration=10)
+    assert not manifest_mod.verify_record(d, man.get("1"))["ok"]  # pending
+    import zlib
+    man.commit("1", nbytes=len(data), crc=zlib.crc32(data))
+    assert manifest_mod.verify_record(d, man.get("1"))["ok"]
+    (tmp_path / "train_model_1.ckpt").write_bytes(data[:-1])
+    assert "size" in manifest_mod.verify_record(d, man.get("1"))["reason"]
+    (tmp_path / "train_model_1.ckpt").write_bytes(b"Xayload-bytes")
+    assert "crc" in manifest_mod.verify_record(d, man.get("1"))["reason"]
+
+
+# ---------------------------------------------------------------------------
+# model registry
+# ---------------------------------------------------------------------------
+
+def test_registry_publish_poll_rollback(tmp_path):
+    d = str(tmp_path)
+    reg = ModelRegistry(d)
+    assert reg.latest() is None
+    v1 = reg.publish(tag="0", epoch=0, iteration=10, val_acc=0.4,
+                     fingerprint=111)
+    v2 = reg.publish(tag="1", epoch=1, iteration=20, val_acc=0.6,
+                     fingerprint=222)
+    assert (v1["version"], v2["version"]) == (1, 2)
+    # A fresh poller sees the same truth.
+    poller = ModelRegistry(d)
+    assert poller.latest()["version"] == 2
+    assert poller.get(1)["fingerprint"] == 111
+    # Rollback withdraws v2; the newest remaining live version wins.
+    reg.rollback(2, reason="canary failed in staging")
+    assert ModelRegistry(d).latest()["version"] == 1
+    with pytest.raises(KeyError):
+        reg.rollback(99)
+    # retire_missing: v1's file does not exist in the directory.
+    assert reg.retire_missing(d) == [1]
+    assert ModelRegistry(d).latest() is None
+    # Damage degrades to empty, never an error (pollers keep serving).
+    (tmp_path / "REGISTRY.json").write_text("{torn")
+    assert ModelRegistry(d).latest() is None
+
+
+# ---------------------------------------------------------------------------
+# durability + startup sweep
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_fsyncs_before_replace(tmp_path, monkeypatch):
+    """The satellite durability fix: file fsync'd BEFORE os.replace
+    (and the directory after, best-effort) — a crash cannot commit a
+    torn file under a valid name."""
+    from howtotrainyourmamlpytorch_tpu.utils import checkpoint as ckpt_mod
+    calls = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(os, "fsync",
+                        lambda fd: calls.append("fsync") or real_fsync(fd))
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: calls.append("replace") or real_replace(a, b))
+    path = str(tmp_path / "x.ckpt")
+    ckpt_mod._write_bytes_atomic(path, b"bytes")
+    assert open(path, "rb").read() == b"bytes"
+    assert "fsync" in calls and "replace" in calls
+    assert calls.index("fsync") < calls.index("replace")
+
+
+def test_manager_init_sweeps_stale_tmp_and_pending(tmp_path, res_registry):
+    d = str(tmp_path)
+    mgr = CheckpointManager(d)
+    state = _state()
+    mgr.save(state, epoch=0, current_iter=10, val_acc=0.5)
+    # Strand what a killed writer leaves: a latest-link tmp and a
+    # pending record for an epoch whose write never committed.
+    (tmp_path / "train_model_latest.ckpt.tmp").write_bytes(b"stranded")
+    mgr.manifest.begin("1", epoch=1, iteration=20, val_acc=0.6)
+    (tmp_path / "train_model_1.ckpt.tmp").write_bytes(b"torn")
+
+    with pytest.warns(UserWarning, match="GC swept"):
+        mgr2 = CheckpointManager(d)
+    assert not (tmp_path / "train_model_latest.ckpt.tmp").exists()
+    assert not (tmp_path / "train_model_1.ckpt.tmp").exists()
+    assert mgr2.manifest.get("1") is None
+    assert mgr2.manifest.get("0")["status"] == manifest_mod.COMMITTED
+    assert res_registry.counter("ckpt/gc_deletes").value > 0
+    # A read-only consumer (serving attaching to a LIVE run) must not
+    # sweep the writer's in-flight tmp.
+    (tmp_path / "train_model_2.ckpt.tmp").write_bytes(b"in-flight")
+    CheckpointManager(d, sweep_stale=False)
+    assert (tmp_path / "train_model_2.ckpt.tmp").exists()
+
+
+def test_save_records_manifest_commits(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(_state(), epoch=0, current_iter=10, val_acc=0.5)
+    man = manifest_mod.Manifest(str(tmp_path))
+    for tag in ("0", "latest"):
+        rec = man.get(tag)
+        assert rec["status"] == manifest_mod.COMMITTED
+        assert manifest_mod.verify_record(str(tmp_path), rec)["ok"]
+    assert man.get("0")["val_acc"] == 0.5
+    # Pruning an epoch drops its manifest record too (top-1 by val acc:
+    # epoch 2 wins, epochs 0 and 1 are pruned).
+    mgr2 = CheckpointManager(str(tmp_path), max_to_keep=1)
+    for e in (1, 2):
+        mgr2.save(_state(), epoch=e, current_iter=e * 10,
+                  val_acc=0.5 + 0.1 * e)
+    man = manifest_mod.Manifest(str(tmp_path))
+    assert man.get("2") is not None
+    assert man.get("0") is None and man.get("1") is None
+
+
+# ---------------------------------------------------------------------------
+# async writer
+# ---------------------------------------------------------------------------
+
+class _StubManager:
+    """Manager double for queue-policy units: write_epoch_files blocks
+    on a gate so the test controls when the worker frees a queue slot."""
+
+    def __init__(self, directory):
+        self.directory = directory
+        self.max_to_keep = 5
+        self.meta = {"current_iter": 0}
+        self.gate = threading.Event()
+        self.written = []
+
+    def encode(self, state):
+        return b"encoded:%d" % state
+
+    def record_save(self, epoch, current_iter, val_acc):
+        self.meta["current_iter"] = current_iter
+
+    def top_epochs(self, k=None):
+        return []
+
+    def write_epoch_files(self, data, epoch, current_iter, val_acc,
+                          keep=None, meta=None):
+        self.gate.wait(timeout=30)
+        self.written.append((epoch, data))
+
+    def save_latest(self, state, current_iter, write=True):
+        self.written.append(("latest", self.encode(state)))
+
+    def fingerprint(self, tag):
+        return 0
+
+
+def test_async_skip_policy_drops_and_counts(tmp_path, res_registry):
+    mgr = _StubManager(str(tmp_path))
+    w = CheckpointWriter(mgr, async_saves=True, queue_policy="skip")
+    w.save(1, 0, 10, 0.1)   # worker picks this up, blocks on the gate
+    time.sleep(0.05)
+    w.save(2, 1, 20, 0.2)   # fills the depth-1 queue
+    with pytest.warns(UserWarning, match="skipped"):
+        w.save(3, 2, 30, 0.3)  # queue full -> skipped, counted
+    assert res_registry.counter("ckpt/skipped_saves").value == 1
+    # Bookkeeping still advanced for the skipped save (uniform across
+    # processes; consumers filter by has_checkpoint).
+    assert mgr.meta["current_iter"] == 30
+    mgr.gate.set()
+    w.close()
+    assert [e for e, _ in mgr.written] == [0, 1]  # epoch 2 skipped
+    assert res_registry.counter("ckpt/saves").value == 2
+
+
+def test_async_block_policy_waits_and_counts(tmp_path, res_registry):
+    mgr = _StubManager(str(tmp_path))
+    w = CheckpointWriter(mgr, async_saves=True, queue_policy="block")
+    w.save(1, 0, 10, 0.1)
+    time.sleep(0.05)
+    w.save(2, 1, 20, 0.2)
+    threading.Timer(0.25, mgr.gate.set).start()
+    t0 = time.perf_counter()
+    w.save(3, 2, 30, 0.3)  # blocks until the worker frees a slot
+    assert time.perf_counter() - t0 > 0.1
+    w.close()
+    assert [e for e, _ in mgr.written] == [0, 1, 2]  # nothing lost
+    assert res_registry.counter("ckpt/blocked_seconds").value > 0.1
+    assert res_registry.counter("ckpt/skipped_saves").value == 0
+
+
+def test_save_latest_drains_queue_first(tmp_path):
+    """Preemption safety: save_latest must not run until every queued
+    epoch write landed — SIGTERM never loses the newest snapshot."""
+    mgr = _StubManager(str(tmp_path))
+    w = CheckpointWriter(mgr, async_saves=True)
+    w.save(1, 0, 10, 0.1)
+    threading.Timer(0.2, mgr.gate.set).start()
+    w.save_latest(7, 15)  # must block on the drain, then write latest
+    assert [e for e, _ in mgr.written] == [0, "latest"]
+    w.close()
+
+
+def test_sync_mode_delegates_without_thread(tmp_path, res_registry):
+    mgr = CheckpointManager(str(tmp_path))
+    w = CheckpointWriter(mgr, async_saves=False)
+    w.save(_state(), 0, 10, 0.5)
+    assert w._thread is None  # ckpt_async=0 installs nothing
+    assert mgr.has_checkpoint(0) and mgr.has_checkpoint("latest")
+    assert res_registry.counter("ckpt/saves").value == 1
+    assert res_registry.counter("ckpt/save_seconds").value > 0
+    w.close()  # no-op
+
+
+def test_async_save_produces_identical_files(tmp_path):
+    """The on-disk result of an async save is byte-identical to the
+    synchronous path's (same encode, same write code)."""
+    state = _state()
+    sync_dir, async_dir = str(tmp_path / "sync"), str(tmp_path / "async")
+    ms = CheckpointManager(sync_dir)
+    ms.save(state, 0, 10, 0.5)
+    ma = CheckpointManager(async_dir)
+    w = CheckpointWriter(ma, async_saves=True)
+    w.save(state, 0, 10, 0.5)
+    w.close()
+    for name in ("train_model_0.ckpt", "train_model_latest.ckpt",
+                 "state.json"):
+        a = open(os.path.join(sync_dir, name), "rb").read()
+        b = open(os.path.join(async_dir, name), "rb").read()
+        assert a == b, name
+    # Manifests agree on everything but incidental key order.
+    msan = manifest_mod.Manifest(sync_dir).records
+    masn = manifest_mod.Manifest(async_dir).records
+    assert msan == masn
+
+
+def test_async_writer_publishes_to_registry(tmp_path, res_registry):
+    mgr = CheckpointManager(str(tmp_path))
+    w = CheckpointWriter(mgr, async_saves=True, publish=True)
+    w.save(_state(), 0, 10, 0.5)
+    w.close()
+    reg = ModelRegistry(str(tmp_path))
+    rec = reg.latest()
+    assert rec["tag"] == "0" and rec["val_acc"] == 0.5
+    assert rec["fingerprint"] == mgr.fingerprint(0)
+    assert res_registry.counter("ckpt/published").value == 1
+
+
+# ---------------------------------------------------------------------------
+# manifest-preferred fallback
+# ---------------------------------------------------------------------------
+
+def test_fallback_skips_pending_candidate_without_reading(tmp_path):
+    """A pending manifest record disqualifies its tag WITHOUT a read
+    attempt and WITHOUT quarantining the file (it holds the previous
+    committed bytes — 'no quarantine of a good file')."""
+    d = str(tmp_path)
+    mgr = CheckpointManager(d)
+    state = _state()
+    mgr.save(state, 0, 10, 0.3)
+    mgr.save(state, 1, 20, 0.4)
+    os.remove(mgr._ckpt_path("latest"))   # force the epoch fallback
+    # Epoch 1's record regresses to pending (a kill between begin and
+    # rename, as seen by a NON-writer process that doesn't sweep).
+    mgr.manifest.begin("1", epoch=1, iteration=20, val_acc=0.4)
+    from howtotrainyourmamlpytorch_tpu.utils import checkpoint as ckpt_mod
+    reads = []
+    orig = ckpt_mod._read_bytes
+    ckpt_mod_read = lambda p: reads.append(p) or orig(p)  # noqa: E731
+    mgr2 = CheckpointManager(d, sweep_stale=False)
+    try:
+        ckpt_mod._read_bytes = ckpt_mod_read
+        with pytest.warns(UserWarning, match="resuming from epoch 0"):
+            _, meta, tag = mgr2.load_latest_or_fallback(_state())
+    finally:
+        ckpt_mod._read_bytes = orig
+    assert tag == 0 and meta["current_iter"] == 10
+    # Epoch 1's bytes were never touched, never quarantined.
+    assert not any("train_model_1.ckpt" in p for p in reads)
+    assert os.path.exists(mgr._ckpt_path(1))
+    assert not os.path.exists(mgr._ckpt_path(1) + ".corrupt")
+
+
+def test_fallback_size_mismatch_via_manifest_quarantines(tmp_path):
+    """A committed record whose file size disagrees is provably damaged:
+    detected by one getsize probe (no full read), quarantined, and the
+    fallback moves on."""
+    d = str(tmp_path)
+    mgr = CheckpointManager(d)
+    state = _state()
+    mgr.save(state, 0, 10, 0.3)
+    mgr.save(state, 1, 20, 0.4)
+    # Replace 'latest' with truncated content (external damage: partial
+    # copy/NFS truncation). Break the hard link first — truncating in
+    # place would damage epoch 1's file through the shared inode.
+    latest = mgr._ckpt_path("latest")
+    data = open(latest, "rb").read()
+    os.remove(latest)
+    open(latest, "wb").write(data[:100])
+    mgr2 = CheckpointManager(d, sweep_stale=False)
+    with pytest.warns(UserWarning):
+        _, meta, tag = mgr2.load_latest_or_fallback(_state())
+    assert tag == 1
+    assert os.path.exists(mgr._ckpt_path("latest") + ".corrupt")
+
+
+# ---------------------------------------------------------------------------
+# kill_in_ckpt_write fault site (the chaos phase's unit-sized half)
+# ---------------------------------------------------------------------------
+
+def test_kill_in_ckpt_write_leaves_pending_and_tmp(tmp_path):
+    """The fault kills AFTER the durable tmp write, BEFORE the rename:
+    exit 137, a pending manifest record, a ``*.tmp`` leftover, and NO
+    file under the final name. (Subprocess: the fault is os._exit.)"""
+    script = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys; sys.path.insert(0, {REPO!r})
+from howtotrainyourmamlpytorch_tpu.resilience import faults
+from howtotrainyourmamlpytorch_tpu.utils.checkpoint import CheckpointManager
+faults.configure("kill_in_ckpt_write@1")
+mgr = CheckpointManager({str(tmp_path)!r})
+mgr.save({{"w": [1.0, 2.0]}}, epoch=0, current_iter=10, val_acc=0.5)
+print("UNREACHABLE")
+"""
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, timeout=300,
+                       cwd=REPO)
+    assert r.returncode == 137, (r.returncode, r.stderr[-500:])
+    assert "UNREACHABLE" not in r.stdout
+    man = manifest_mod.Manifest(str(tmp_path))
+    assert man.get("0")["status"] == manifest_mod.PENDING
+    assert os.path.exists(tmp_path / "train_model_0.ckpt.tmp")
+    assert not os.path.exists(tmp_path / "train_model_0.ckpt")
+    # Restart-side GC: a fresh writer-process manager sweeps both.
+    with pytest.warns(UserWarning, match="GC swept"):
+        CheckpointManager(str(tmp_path))
+    assert not os.path.exists(tmp_path / "train_model_0.ckpt.tmp")
+    assert manifest_mod.Manifest(str(tmp_path)).get("0") is None
+
+
+# ---------------------------------------------------------------------------
+# admin CLI (jax-free, artifact contract)
+# ---------------------------------------------------------------------------
+
+def test_ckpt_admin_cli_contract(tmp_path):
+    d = str(tmp_path / "saved_models")
+    mgr = CheckpointManager(d)
+    state = _state()
+    for e in range(2):
+        mgr.save(state, e, (e + 1) * 10, 0.1 * (e + 1))
+    (tmp_path / "saved_models" / "junk.ckpt.tmp").write_bytes(b"x")
+
+    # jax-free pin: a booby-trapped jax package on PYTHONPATH makes ANY
+    # jax import in the CLI process a loud failure.
+    trap = tmp_path / "trap"
+    trap.mkdir()
+    (trap / "jax.py").write_text(
+        "raise ImportError('ckpt_admin must not import jax')")
+    env = dict(os.environ, PYTHONPATH=str(trap))
+    cli = os.path.join(REPO, "scripts", "ckpt_admin.py")
+
+    def run(*args):
+        r = subprocess.run([sys.executable, cli, *args],
+                           capture_output=True, text=True, timeout=120,
+                           env=env, cwd=REPO)
+        lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+        return r.returncode, json.loads(lines[-1])
+
+    rc, art = run("list", str(tmp_path))  # experiment-dir resolution
+    assert rc == 0 and art["metric"] == "ckpt_admin"
+    assert art["command"] == "list" and art["ok"]
+    assert art["records"] == 3 and art["committed"] == 3  # 0, 1, latest
+
+    rc, art = run("verify", d)
+    assert rc == 0 and art["ok"] and art["verified"] == 3
+    # Damage one file: verify must fail with exit 1.
+    path = os.path.join(d, "train_model_0.ckpt")
+    data = bytearray(open(path, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(data))
+    rc, art = run("verify", d)
+    assert rc == 1 and not art["ok"]
+    assert art["bad"][0]["tag"] == "0"
+
+    rc, art = run("publish", d, "--tag", "1")
+    assert rc == 0 and art["version"] == 1
+    # Refuses an unverifiable tag.
+    rc, art = run("publish", d, "--tag", "0")
+    assert rc == 1 and "verify failed" in art["error"]
+
+    rc, art = run("rollback", d, "--version", "1")
+    assert rc == 0 and art["live_version"] is None
+
+    rc, art = run("gc", d, "--max-to-keep", "1", "--dry-run")
+    assert rc == 0 and art["dry_run"] and art["deleted_files"] >= 1
+    assert os.path.exists(os.path.join(d, "junk.ckpt.tmp"))
+    rc, art = run("gc", d, "--max-to-keep", "1")
+    assert rc == 0 and not art["dry_run"]
+    assert not os.path.exists(os.path.join(d, "junk.ckpt.tmp"))
+    assert art["kept_tags"] == ["1"]
+
+
+# ---------------------------------------------------------------------------
+# serving hot-swap (tiny compiles; one shared engine per module run)
+# ---------------------------------------------------------------------------
+
+def _swap_cfg(root):
+    return MAMLConfig(
+        experiment_name="swap", experiment_root=str(root),
+        dataset_name="synthetic_swap",
+        image_height=10, image_width=10, image_channels=1,
+        num_classes_per_set=3, num_samples_per_class=1,
+        num_target_samples=2, batch_size=2, cnn_num_filters=4,
+        num_stages=2, number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2, second_order=False,
+        use_multi_step_loss_optimization=False,
+        serve_batch_tasks=2, serve_default_deadline_ms=0.0,
+        serve_cache_capacity=8,
+        # Probes are random pixels: both versions sit near chance
+        # accuracy, so the unit canary gates on FINITENESS (and a very
+        # loose latency ratio), not on noisy probe accuracy. The
+        # accuracy/latency verdict logic is pinned separately with a
+        # stubbed _canary_eval.
+        serve_canary_acc_drop=1.0, serve_canary_latency_factor=50.0,
+        compute_dtype="float32")
+
+
+def _poison_nan(state):
+    """Every float leaf -> NaN (a provably canary-failing version)."""
+    def bad(x):
+        x = np.asarray(x)
+        return (np.full_like(x, np.nan)
+                if np.issubdtype(x.dtype, np.floating) else x)
+    return jax.tree.map(bad, state)
+
+
+def _nudge(state):
+    """A slightly different (finite) version — canary must pass it."""
+    def shift(x):
+        x = np.asarray(x)
+        return (x + np.float32(0.01)
+                if np.issubdtype(x.dtype, np.floating) else x)
+    return jax.tree.map(shift, state)
+
+
+def _swap_req(cfg, seed):
+    from howtotrainyourmamlpytorch_tpu.serve.batcher import FewShotRequest
+    rng = np.random.RandomState(seed)
+    n, k, t = (cfg.num_classes_per_set, cfg.num_samples_per_class,
+               cfg.num_target_samples)
+    h, w, c = cfg.image_shape
+    return FewShotRequest(
+        support_x=rng.randint(0, 256, (n * k, h, w, c)).astype(np.uint8),
+        support_y=(np.arange(n * k) % n).astype(np.int32),
+        query_x=rng.randint(0, 256, (n * t, h, w, c)).astype(np.uint8))
+
+
+@pytest.fixture(scope="module")
+def swap_env(tmp_path_factory):
+    from howtotrainyourmamlpytorch_tpu.serve import ServingEngine
+
+    root = tmp_path_factory.mktemp("swap_root")
+    cfg = _swap_cfg(root)
+    directory = str(root / "swap" / "saved_models")
+    init, _ = make_model(cfg)
+    state0 = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    mgr = CheckpointManager(directory,
+                            max_to_keep=cfg.max_models_to_save)
+    mgr.save(state0, epoch=0, current_iter=10, val_acc=0.5)
+    ModelRegistry(directory).publish(
+        tag="0", epoch=0, iteration=10, val_acc=0.5,
+        fingerprint=mgr.fingerprint(0))
+    engine = ServingEngine.from_checkpoint(cfg, directory,
+                                           devices=jax.devices()[:1])
+    yield {"engine": engine, "mgr": mgr, "cfg": cfg, "dir": directory,
+           "state0": state0}
+    engine.close()
+
+
+def test_hot_swap_adopts_matching_fingerprint(swap_env):
+    """A published version whose fingerprint IS the bytes already being
+    served is adopted (version number tracked) without a swap."""
+    eng = swap_env["engine"]
+    assert eng.maybe_hot_swap(force=True) is None
+    assert eng._model_version == 1
+    assert eng.registry.counter("serve/hot_swaps").value == 0
+
+
+def test_hot_swap_poll_rate_limit(swap_env):
+    eng = swap_env["engine"]
+    eng._last_registry_poll = 1000.0
+    # Inside the poll interval: no registry read, no decision.
+    assert eng.maybe_hot_swap(now=1000.0 + 1.0) is None
+    assert eng._last_registry_poll == 1000.0
+    # force bypasses the limit (and finds nothing new).
+    assert eng.maybe_hot_swap(now=1000.0 + 1.0, force=True) is None
+    assert eng._last_registry_poll == 1001.0
+
+
+def test_hot_swap_canary_fail_rolls_back(swap_env):
+    """A published version that produces non-finite outputs must NOT go
+    live: the engine keeps serving the old version, counts the
+    rollback, and never retries the rejected version."""
+    eng, mgr = swap_env["engine"], swap_env["mgr"]
+    mgr.save(_poison_nan(swap_env["state0"]), epoch=1, current_iter=20,
+             val_acc=0.9)
+    ModelRegistry(swap_env["dir"]).publish(
+        tag="1", epoch=1, iteration=20, val_acc=0.9,
+        fingerprint=mgr.fingerprint(1))
+    old_ctx = eng._fp_context
+    out = eng.maybe_hot_swap(force=True)
+    assert out is not None and out["swapped"] is False
+    assert "non-finite" in out["canary"]["reason"]
+    assert eng.registry.counter("serve/hot_swap_rollbacks").value == 1
+    assert eng.registry.counter("serve/hot_swaps").value == 0
+    assert eng._fp_context == old_ctx and eng._model_version == 1
+    # The rejected version is pinned: the next poll is a no-op.
+    assert eng.maybe_hot_swap(force=True) is None
+    # Serving still works on the live (old) version.
+    eng.submit(_swap_req(swap_env["cfg"], seed=1))
+    (resp,) = eng.drain()
+    assert resp.error is None
+    assert np.isfinite(resp.logits).all()
+
+
+def test_hot_swap_canary_pass_swaps_and_invalidates_cache(swap_env):
+    """The happy path: a finite new version passes the canary, goes
+    live between steps, and every adapted-params cache entry keyed
+    under the old checkpoint fingerprint misses afterwards — no stale
+    adaptation is ever served from the new weights' cache."""
+    eng, mgr, cfg = swap_env["engine"], swap_env["mgr"], swap_env["cfg"]
+    # Prime the cache under the CURRENT version.
+    req = _swap_req(cfg, seed=2)
+    eng.submit(req)
+    (r1,) = eng.drain()
+    assert not r1.cache_hit
+    eng.submit(_swap_req(cfg, seed=2))
+    (r2,) = eng.drain()
+    assert r2.cache_hit  # same support set: hit under the old version
+
+    mgr.save(_nudge(swap_env["state0"]), epoch=2, current_iter=30,
+             val_acc=0.6)
+    ModelRegistry(swap_env["dir"]).publish(
+        tag="2", epoch=2, iteration=30, val_acc=0.6,
+        fingerprint=mgr.fingerprint(2))
+    old_ctx = eng._fp_context
+    out = eng.maybe_hot_swap(force=True)
+    assert out is not None and out["swapped"] is True, out
+    assert eng.registry.counter("serve/hot_swaps").value == 1
+    assert eng._fp_context != old_ctx
+    assert eng._model_version == 3
+
+    # The SAME support set now misses (fingerprint-keyed invalidation)
+    # and re-adapts under the new weights — without any error.
+    adapt_before = eng.adapt_invocations
+    eng.submit(_swap_req(cfg, seed=2))
+    (r3,) = eng.drain()
+    assert r3.error is None
+    assert not r3.cache_hit
+    assert eng.adapt_invocations == adapt_before + 1
+
+
+def test_hot_swap_canary_verdict_logic(swap_env, monkeypatch):
+    """The accuracy/latency comparison rules, pinned against stubbed
+    canary measurements (the probe-based path above can only pin
+    finiteness deterministically)."""
+    eng = swap_env["engine"]
+    monkeypatch.setattr(eng, "cfg", eng.cfg.replace(
+        serve_canary_acc_drop=0.1, serve_canary_latency_factor=2.0))
+    measurements = {}
+    monkeypatch.setattr(
+        eng, "_canary_eval",
+        lambda state: measurements[id(state)])
+    live, cand = object(), object()
+    monkeypatch.setattr(eng, "state", live, raising=False)
+
+    def verdict(live_m, cand_m):
+        measurements.clear()
+        measurements[id(live)] = live_m
+        measurements[id(cand)] = cand_m
+        return eng._run_canary(cand)
+
+    ok = {"accuracy": 0.9, "adapt_seconds": 0.1, "finite": True}
+    assert verdict(ok, dict(ok))["pass"]
+    # Small degradation within tolerance passes.
+    assert verdict(ok, {**ok, "accuracy": 0.85,
+                        "adapt_seconds": 0.15})["pass"]
+    v = verdict(ok, {**ok, "accuracy": 0.7})
+    assert not v["pass"] and "accuracy" in v["reason"]
+    v = verdict(ok, {**ok, "adapt_seconds": 0.5})
+    assert not v["pass"] and "latency" in v["reason"]
+    v = verdict(ok, {**ok, "finite": False})
+    assert not v["pass"] and "non-finite" in v["reason"]
+    # Chance guard: when the LIVE version is itself at/near chance on
+    # the probes (1/3-way here), accuracy carries no signal — a lower
+    # candidate number is sampling luck and must NOT roll back.
+    near_chance = {**ok, "accuracy": 0.4}
+    assert verdict(near_chance, {**ok, "accuracy": 0.0})["pass"]
+
+
+# ---------------------------------------------------------------------------
+# slow proofs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # four tiny end-to-end runs (~80s), 1-core box
+def test_async_vs_sync_full_run_bitwise_parity(tmp_path):
+    """THE ckpt_async acceptance pin: a full run's final weights AND its
+    pause->resume trajectory are bitwise-identical with the async writer
+    on vs off — the background thread moves IO, never math. The final
+    'latest' checkpoint FILES are also byte-identical across modes."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    finals = {}
+    for mode in (0, 1):
+        root = tmp_path / f"mode{mode}"
+        kw = dict(ckpt_async=mode, ckpt_queue_policy="block")
+        b1 = ExperimentBuilder(_cfg(root, total_epochs_before_pause=1,
+                                    **kw))
+        r1 = b1.run_experiment()
+        assert "paused_at_iter" in r1
+        b2 = ExperimentBuilder(_cfg(root, continue_from_epoch="latest",
+                                    **kw))
+        b2.run_experiment()
+        latest = os.path.join(str(root), "smoke", "saved_models",
+                              "train_model_latest.ckpt")
+        finals[mode] = (b2.state, open(latest, "rb").read())
+
+    for a, b in zip(jax.tree.leaves(finals[0][0].params),
+                    jax.tree.leaves(finals[1][0].params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert finals[0][1] == finals[1][1]
+
+
+@pytest.mark.slow  # live-load hot swap (~compiles + 40 steps)
+def test_hot_swap_under_load_zero_dropped_requests(tmp_path):
+    """Acceptance: a hot swap under live synthetic load answers EVERY
+    submitted request (no drops, no errors) — the swap lands between
+    batches, and queued requests are served by whichever version is
+    live when their group dequeues."""
+    from howtotrainyourmamlpytorch_tpu.serve import ServingEngine
+
+    cfg = _swap_cfg(tmp_path)
+    directory = str(tmp_path / "swap" / "saved_models")
+    init, _ = make_model(cfg)
+    state0 = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    mgr = CheckpointManager(directory)
+    mgr.save(state0, epoch=0, current_iter=10, val_acc=0.5)
+    ModelRegistry(directory).publish(
+        tag="0", epoch=0, iteration=10, val_acc=0.5,
+        fingerprint=mgr.fingerprint(0))
+    with ServingEngine.from_checkpoint(
+            cfg, directory, devices=jax.devices()[:1]) as eng:
+        eng.warmup()
+        submitted = 0
+        responses = []
+        swapped = None
+        for i in range(20):
+            eng.submit(_swap_req(cfg, seed=100 + i))
+            submitted += 1
+            if i == 10:
+                # Mid-load publish + swap decision between steps.
+                mgr.save(_nudge(state0), epoch=1, current_iter=20,
+                         val_acc=0.6)
+                ModelRegistry(directory).publish(
+                    tag="1", epoch=1, iteration=20, val_acc=0.6,
+                    fingerprint=mgr.fingerprint(1))
+                swapped = eng.maybe_hot_swap(force=True)
+            responses.extend(eng.step())
+        responses.extend(eng.drain())
+
+    assert swapped is not None and swapped["swapped"] is True, swapped
+    assert len(responses) == submitted
+    assert all(r.error is None for r in responses)
+    assert all(np.isfinite(r.logits).all() for r in responses)

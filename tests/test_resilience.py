@@ -714,7 +714,7 @@ def test_watchdog_disabled_is_parity_with_enabled(tmp_path):
     off = {f: 0.0 for f in (
         "watchdog_step_timeout_s", "watchdog_feed_timeout_s",
         "watchdog_collective_timeout_s", "watchdog_compile_timeout_s",
-        "watchdog_serve_timeout_s")}
+        "watchdog_serve_timeout_s", "watchdog_ckpt_timeout_s")}
     # Run 1 (disabled) pays the process's cold compiles; runs 2 and 3
     # are equally cache-warm, so comparing THEIR counts isolates the
     # watchdog: if the beacon injected anything into traced code, the

@@ -21,19 +21,21 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # percentiles, tasks/sec/chip, compile count/seconds, feed-stall
 # fraction, peak memory, per-host skew; v2 adds the serving section,
 # v3 the resilience section, v4 the data-plane section, v5 the
-# watchdog section, v6 the optimization-health section).
+# watchdog section, v6 the optimization-health section, v7 the
+# checkpoint-lifecycle section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
-    "watchdog", "health",
+    "watchdog", "health", "checkpoint",
 }
 
 
 def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
                          with_resilience=False, with_data=False,
-                         with_watchdog=False, with_health=False):
+                         with_watchdog=False, with_health=False,
+                         with_checkpoint=False):
     """A synthetic 2-epoch run's event stream, as the experiment loop
     writes it (train_epoch + telemetry + heartbeat per epoch); with
     ``with_serving``, a trailing serve/ registry-flush row as
@@ -139,6 +141,22 @@ def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
         log.log("health_grad_norm_warn", iter=11, grad_norm=99.0)
         log.log("metrics", metrics={"health/grad_norm_warn": 1.0})
         log.log("metrics", metrics={"health/grad_norm_warn": 0.0})
+    if with_checkpoint:
+        # A killed-and-restarted run (counter reset between segments) +
+        # a serving process's flush carrying the hot-swap counters: the
+        # v7 checkpoint section must total across all of it.
+        log.log("metrics", metrics={"ckpt/saves": 2.0,
+                                    "ckpt/save_seconds": 0.5,
+                                    "ckpt/blocked_seconds": 0.1,
+                                    "ckpt/skipped_saves": 1.0,
+                                    "ckpt/gc_deletes": 0.0})
+        log.log("metrics", metrics={"ckpt/saves": 1.0,  # restart: reset
+                                    "ckpt/save_seconds": 0.25,
+                                    "ckpt/blocked_seconds": 0.0,
+                                    "ckpt/skipped_saves": 0.0,
+                                    "ckpt/gc_deletes": 2.0})
+        log.log("metrics", metrics={"serve/hot_swaps": 2.0,
+                                    "serve/hot_swap_rollbacks": 1.0})
     return log.path
 
 
@@ -166,6 +184,7 @@ def test_summarize_events_fixture(tmp_path):
     assert s["data"] == UNAVAILABLE
     assert s["watchdog"] == UNAVAILABLE
     assert s["health"] == UNAVAILABLE
+    assert s["checkpoint"] == UNAVAILABLE
     # The table renders every row without raising.
     table = format_table(s)
     assert "feed stall fraction" in table and "0.1" in table
@@ -315,6 +334,31 @@ def test_summarize_events_health_section(tmp_path):
     assert s["epochs"] == 2 and s["serving"] == UNAVAILABLE
 
 
+def test_summarize_events_checkpoint_section(tmp_path):
+    """ckpt/* + hot-swap metric rows (the experiment loop's per-epoch
+    flush and a serving process's flush) render the v7 checkpoint
+    section; counters accumulate reset-aware across preempt/restart
+    segments like the resilience section's."""
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    path = write_fixture_events(tmp_path / "events.jsonl",
+                                with_checkpoint=True)
+    s = summarize_events(read_jsonl(path))
+    assert set(s) == SCHEMA_KEYS
+    ck = s["checkpoint"]
+    assert ck["saves"] == 3            # 2 (killed segment) + 1 (restart)
+    assert ck["save_seconds"] == pytest.approx(0.75)
+    assert ck["blocked_seconds"] == pytest.approx(0.1)
+    assert ck["skipped_saves"] == 1
+    assert ck["gc_deletes"] == 2
+    assert ck["hot_swaps"] == 2
+    assert ck["rollbacks"] == 1
+    assert "checkpoint" in format_table(s)
+    # Training metrics untouched by the checkpoint rows. (The hot-swap
+    # flush is a serve/* row, so the serving section renders too — a
+    # hot-swapping process IS a serving process.)
+    assert s["epochs"] == 2 and s["serving"] != UNAVAILABLE
+
+
 def test_health_section_nonfinite_grad_norm_visible():
     """A NaN grad norm is nulled by the JSONL writer; the report must
     show 'non-finite' — the diagnosis itself — not hide the row."""
@@ -434,6 +478,12 @@ def test_report_on_real_two_epoch_cpu_run(tmp_path):
     assert s["health"]["update_ratio_max"] > 0
     assert s["health"]["lslr_min"] > 0
     assert s["health"]["grad_norm_warns"] == 0
+    # v7 checkpoint section: every epoch saved synchronously through
+    # the writer (0 skips/blocks on the sync path — measured zeros).
+    assert s["checkpoint"]["saves"] == 2
+    assert s["checkpoint"]["save_seconds"] > 0
+    assert s["checkpoint"]["skipped_saves"] == 0
+    assert s["checkpoint"]["blocked_seconds"] == 0
     # The Prometheus textfile snapshot landed next to the JSONL stream.
     prom = open(os.path.join(exp_dir, "logs", "metrics.prom")).read()
     assert "# TYPE compile_count counter" in prom

@@ -350,6 +350,7 @@ def test_config_watchdog_validation():
                   "watchdog_collective_timeout_s",
                   "watchdog_compile_timeout_s",
                   "watchdog_serve_timeout_s",
+                  "watchdog_ckpt_timeout_s",
                   "watchdog_poll_interval_s"):
         with pytest.raises(ValueError, match=field):
             MAMLConfig(**{field: -1.0})
@@ -364,13 +365,14 @@ def test_config_watchdog_validation():
     off = cfg.replace(**{f: 0.0 for f in (
         "watchdog_step_timeout_s", "watchdog_feed_timeout_s",
         "watchdog_collective_timeout_s", "watchdog_compile_timeout_s",
-        "watchdog_serve_timeout_s")})
+        "watchdog_serve_timeout_s", "watchdog_ckpt_timeout_s")})
     assert not watchdog.watchdog_enabled(off)
 
 
 _ALL_TIMEOUTS = ("watchdog_step_timeout_s", "watchdog_feed_timeout_s",
                  "watchdog_collective_timeout_s",
-                 "watchdog_compile_timeout_s", "watchdog_serve_timeout_s")
+                 "watchdog_compile_timeout_s", "watchdog_serve_timeout_s",
+                 "watchdog_ckpt_timeout_s")
 
 
 def test_run_installs_watchdog_iff_enabled(tmp_path, monkeypatch):
