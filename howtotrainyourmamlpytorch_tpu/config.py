@@ -412,6 +412,49 @@ class MAMLConfig:
                                            # flight recorder dumped as
                                            # flight.jsonl into crash
                                            # bundles
+    # Pod fault domain (resilience/cluster.py, docs/RESILIENCE.md §
+    # Pod fault domain): peer-death detection + attributed abort.
+    # 0 = off (the default): nothing is installed and every hook site
+    # is one None check — the watchdog zero-cost discipline.
+    require_mesh: int = 0                  # 1 = a mesh_shape this
+                                           # process set cannot realize
+                                           # is a hard ValueError
+                                           # instead of the warn-and-
+                                           # fallback-to-(1,1) path —
+                                           # pod profiles MUST fail
+                                           # loudly (a silently-single-
+                                           # device "pod run" burns a
+                                           # reservation measuring
+                                           # nothing); laptop configs
+                                           # keep the fallback
+    cluster_collective_timeout_s: float = 0.0
+                                           # per-collective budget armed
+                                           # by the watchdog thread: a
+                                           # host-level collective
+                                           # stranded past this consults
+                                           # the peer leases, emits a
+                                           # peer_lost row naming the
+                                           # suspect host(s) and exits
+                                           # EXIT_PEER_LOST (73) so the
+                                           # scheduler restarts the
+                                           # WHOLE job. 0 = cluster
+                                           # subsystem off. Should be
+                                           # well below
+                                           # watchdog_collective_timeout_s
+                                           # and above the slowest
+                                           # legitimate collective
+    cluster_lease_interval_s: float = 5.0  # min seconds between
+                                           # heartbeat-lease touches
+                                           # (mtime-stamped file under
+                                           # <experiment>/cluster/);
+                                           # only used when the
+                                           # subsystem is on
+    cluster_peer_stalled_s: float = 0.0    # lease age past which a peer
+                                           # counts as stalled; 0 =
+                                           # auto: 3 x lease interval
+    cluster_peer_dead_s: float = 0.0       # lease age past which a peer
+                                           # counts as dead; 0 = auto:
+                                           # cluster_collective_timeout_s
 
     # Keys found in a loaded JSON that we accepted-and-ignored (for logging).
     ignored_keys: Tuple[str, ...] = ()
@@ -529,6 +572,24 @@ class MAMLConfig:
             raise ValueError("serve_canary_latency_factor must be > 0")
         if self.flight_recorder_events < 1:
             raise ValueError("flight_recorder_events must be >= 1")
+        if self.require_mesh not in (0, 1):
+            raise ValueError(
+                f"require_mesh must be 0 (warn + fall back to a single-"
+                f"device mesh) or 1 (fail loudly), got {self.require_mesh}")
+        for field in ("cluster_collective_timeout_s",
+                      "cluster_peer_stalled_s", "cluster_peer_dead_s"):
+            if getattr(self, field) < 0:
+                raise ValueError(f"{field} must be >= 0 (0 = disabled/auto)")
+        if self.cluster_lease_interval_s <= 0:
+            raise ValueError(
+                "cluster_lease_interval_s must be > 0 (the lease cadence "
+                "exists whenever the cluster subsystem is on)")
+        if (self.cluster_peer_stalled_s > 0 and self.cluster_peer_dead_s > 0
+                and self.cluster_peer_dead_s < self.cluster_peer_stalled_s):
+            raise ValueError(
+                f"cluster_peer_dead_s {self.cluster_peer_dead_s} < "
+                f"cluster_peer_stalled_s {self.cluster_peer_stalled_s}: "
+                f"a dead peer must first be stalled")
         if self.fault_spec:
             # Parse-validate now: a typo'd chaos spec that silently
             # injects nothing would "prove" recovery that never ran.

@@ -40,16 +40,16 @@ from howtotrainyourmamlpytorch_tpu.meta.outer import (
     reconcile_loaded_shapes, state_leaf_shapes)
 from howtotrainyourmamlpytorch_tpu.models import make_model
 from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
-    make_mesh, make_sharded_steps, replicated_sharding)
+    make_mesh, make_sharded_steps, replicate_state)
 from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
     abort_all_if_any, agree_int_from_main, any_process_true,
-    any_process_true_each, barrier)
+    any_process_true_each, barrier, gather_host_ints)
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
     LATEST, CheckpointManager)
 from howtotrainyourmamlpytorch_tpu.ckpt.writer import CheckpointWriter
 from howtotrainyourmamlpytorch_tpu import resilience
 from howtotrainyourmamlpytorch_tpu.resilience import (
-    DivergenceGuard, faults, flightrec, watchdog)
+    DivergenceGuard, cluster, faults, flightrec, watchdog)
 from howtotrainyourmamlpytorch_tpu.resilience.flightrec import (
     write_crash_bundle)
 from howtotrainyourmamlpytorch_tpu.telemetry import (
@@ -98,6 +98,16 @@ class ExperimentBuilder:
                 f"mesh size == global device count")
         if n_mesh <= len(devices):
             devices = devices[:n_mesh]
+        elif cfg.require_mesh:
+            # Fail-loud pod geometry: a pod profile that silently fell
+            # back to one device would burn a whole reservation
+            # measuring nothing (VERDICT weakness #6). Laptop configs
+            # keep the fallback below.
+            raise ValueError(
+                f"mesh_shape {cfg.mesh_shape} needs {n_mesh} devices but "
+                f"only {len(devices)} are visible and require_mesh=1; "
+                f"fix the mesh/pod geometry or unset require_mesh to "
+                f"accept the single-device fallback")
         else:
             warnings.warn(
                 f"mesh_shape {cfg.mesh_shape} needs {n_mesh} devices "
@@ -177,6 +187,11 @@ class ExperimentBuilder:
         self._watchdog: Optional[watchdog.Watchdog] = None
         self._beacon: Optional[watchdog.ProgressBeacon] = None
         self._flightrec = None
+        # Pod fault domain (resilience/cluster.py): installed for the
+        # run's duration iff cluster_collective_timeout_s > 0 — peer
+        # heartbeat leases + attributed peer-lost abort (exit 73). None
+        # (the default) keeps every hook site a single None check.
+        self._cluster: Optional[cluster.ClusterFaultDomain] = None
         # Phase keys whose first REAL step call this process has made:
         # that call pays (or waits out) the XLA compile, so it runs
         # under the separate, much larger compile deadline.
@@ -216,8 +231,7 @@ class ExperimentBuilder:
         # count, so a rewound-then-preempted run resumes the SAME stream
         # an uninterrupted post-rewind run would see.
         self.data.set_train_salt(int(self.ckpt.meta.get("rewinds", 0)))
-        self.state = jax.device_put(self.state,
-                                    replicated_sharding(self.mesh))
+        self.state = replicate_state(self.state, self.mesh)
 
     # ------------------------------------------------------------------
     def _resume(self, tag) -> None:
@@ -245,6 +259,33 @@ class ExperimentBuilder:
                 or self.ckpt.meta_from_disk):
             return  # fresh run with continue_from_epoch='latest'
                     # (reference default for restartable jobs)
+        if (from_latest and self._multihost
+                and cluster.cluster_enabled(self.cfg)):
+            # Consensus resume (resilience/cluster.py): after a
+            # peer-loss restart every host gathers its local view of
+            # the newest committed checkpoint epoch; when any view
+            # disagrees (a stale NFS cache or damaged MANIFEST.json on
+            # SOME host), ALL hosts adopt the agreed epoch — the
+            # minimum committed view, the one every host can provably
+            # load — instead of racing 'latest' resolutions that
+            # deadlock in the first mismatched collective. Unanimous
+            # views keep the ordinary 'latest' path bit-for-bit.
+            local_view = cluster.latest_committed_epoch(
+                self.ckpt.manifest)
+            agreed = cluster.consensus_epoch(
+                gather_host_ints(local_view))
+            if agreed >= 0 and any_process_true(agreed != local_view):
+                from_latest = False
+                tag = agreed
+                self.registry.gauge(
+                    cluster.CONSENSUS_EPOCH_GAUGE).set(agreed)
+                self.jsonl.log(cluster.CONSENSUS_EVENT,
+                               consensus_epoch=agreed,
+                               local_view=local_view)
+                print(f"cluster consensus: hosts disagree on the newest "
+                      f"committed checkpoint (local view {local_view}); "
+                      f"resuming every host from epoch {agreed}",
+                      flush=True)
         err: Optional[BaseException] = None
         meta: Dict[str, Any] = {}
         # Fresh-init leaf shapes, captured before load overwrites them —
@@ -294,18 +335,11 @@ class ExperimentBuilder:
                 "hosts instead of deadlocking in the first mismatched "
                 "collective. " + detail)
         self.current_iter = local_iter
-        if self._multihost:
-            # Same tag AND iteration can still mean different weight BYTES
-            # (a stale cache serving an old ckpt file under a fresh
-            # state.json): agree on a cheap content fingerprint of the
-            # loaded file too.
-            local_fp = self.ckpt.fingerprint(tag)
-            if any_process_true(
-                    agree_int_from_main(local_fp) != local_fp):
-                raise RuntimeError(
-                    "hosts disagree on the resume checkpoint's content "
-                    "fingerprint (same tag, different bytes — stale "
-                    "filesystem cache?); aborting all hosts")
+        # Same tag AND iteration can still mean different weight BYTES
+        # (a stale cache serving an old ckpt file under a fresh
+        # state.json): agree on a cheap content fingerprint of the
+        # loaded file too.
+        self._agree_checkpoint_fingerprint(tag, "resume")
         if tag != LATEST:
             # Rewind: epochs after the resume point are abandoned; their
             # checkpoints must not feed the top-k ensemble.
@@ -318,6 +352,23 @@ class ExperimentBuilder:
                                              template_shapes)
         print(f"resumed from checkpoint {tag!r} at iter "
               f"{self.current_iter}")
+
+    def _agree_checkpoint_fingerprint(self, tag, context: str) -> None:
+        """Cross-host agreement that checkpoint ``tag``'s BYTES match
+        process 0's (no-op single-process). Every multihost load that
+        feeds live weights — resume, rewind, each test-protocol
+        ensemble member — runs this: ``replicate_state`` places each
+        host's local copy WITHOUT jax's per-leaf equality broadcast, so
+        this cheap fingerprint (128 bytes + one collective) is what
+        catches a stale filesystem cache serving one host old bytes."""
+        if not self._multihost:
+            return
+        local_fp = self.ckpt.fingerprint(tag)
+        if any_process_true(agree_int_from_main(local_fp) != local_fp):
+            raise RuntimeError(
+                f"hosts disagree on the {context} checkpoint {tag!r}'s "
+                f"content fingerprint (same tag, different bytes — "
+                f"stale filesystem cache?); aborting all hosts")
 
     # ------------------------------------------------------------------
     @property
@@ -478,6 +529,19 @@ class ExperimentBuilder:
                         # Simulated wedged step (phase 'step' is the
                         # current beacon): the watchdog must kill us.
                         faults.hang()
+                    if self._cluster is not None:
+                        # Heartbeat lease (pod fault domain): rate-
+                        # limited touch on a fetch that already synced;
+                        # one None check when the subsystem is off.
+                        self._cluster.heartbeat(detail=self.current_iter)
+                    if faults.maybe_fire("kill_peer",
+                                         step=self.current_iter):
+                        # Peer death as the SURVIVORS see it: this host
+                        # vanishes with no handler, no save-on-signal,
+                        # no cleanup — BEFORE the stop-decision
+                        # collective below, so the peers block in it
+                        # and must attribute the loss + exit 73.
+                        os.kill(os.getpid(), signal.SIGKILL)
                     # Health fetch on its cadence: one extra transfer on
                     # a fetch that already synced. The grad-norm warning
                     # is observed BEFORE the loss (below), so a
@@ -680,13 +744,26 @@ class ExperimentBuilder:
         progress_age = beacon.age() if beacon is not None else None
         if progress_age is not None:
             reg.gauge(watchdog.PROGRESS_AGE_GAUGE).set(progress_age)
+        # Pod fault domain: refresh this host's lease on the heartbeat
+        # cadence and surface every host's lease age on the row (read
+        # straight from the shared lease files, fail-soft) — a stalling
+        # peer is visible in events.jsonl BEFORE any deadline trips.
+        lease_ages = None
+        if self._cluster is not None:
+            self._cluster.heartbeat(detail=f"epoch_{epoch}", force=True)
+            ages = self._cluster.peer_lease_ages()
+            lease_ages = {str(h): (round(a, 3) if np.isfinite(a)
+                                   else None)
+                          for h, a in sorted(ages.items())}
         emit_heartbeat(self.jsonl, epoch=epoch,
                        iteration=self.current_iter,
                        local_mean_step_seconds=tsum.get(
                            "mean_step_seconds", 0.0),
                        progress_age_seconds=progress_age,
                        progress_phase=(beacon.current()[0]
-                                       if beacon is not None else None))
+                                       if beacon is not None else None),
+                       **({"peer_lease_age_seconds": lease_ages}
+                          if lease_ages is not None else {}))
 
     def _eval_batches(self, split: str) -> Iterable:
         """The split's fixed evaluation batches, device-cached after the
@@ -753,8 +830,32 @@ class ExperimentBuilder:
         # only while the run is, process-wide installs restored on exit.
         cfg = self.cfg
         deadlines = watchdog.deadlines_from_config(cfg)
+        # Pod fault domain: arming the per-collective cluster budget
+        # tightens the watchdog's collective deadline (and turns the
+        # watchdog on if it was otherwise all-zero — the cluster
+        # deadline is enforced BY the watchdog thread).
+        deadlines = cluster.arm_deadlines(cfg, deadlines)
         wd_enabled = any(v > 0 for v in deadlines.values())
         prev_recorder = prev_beacon = None
+        prev_cluster = None
+        if cluster.cluster_enabled(cfg):
+            self._cluster = cluster.ClusterFaultDomain(
+                lease_dir=os.path.join(self.paths["base"],
+                                       cluster.LEASE_DIR),
+                process_index=jax.process_index(),
+                num_processes=jax.process_count(),
+                collective_timeout_s=cfg.cluster_collective_timeout_s,
+                stalled_after_s=cluster.stalled_after(cfg),
+                dead_after_s=cluster.dead_after(cfg),
+                lease_interval_s=cfg.cluster_lease_interval_s,
+                registry=self.registry, jsonl=self.jsonl,
+                bundle_dir=self._bundle_dir(),
+                prom_path=f"{self.paths['logs']}/metrics.prom")
+            prev_cluster = cluster.install(self._cluster)
+            self._cluster.heartbeat(force=True)  # lease exists from t0
+            # Eager registration: a cluster-armed run must report
+            # "0 peer losses", not omit the counter.
+            self.registry.counter(cluster.PEER_LOSSES_COUNTER)
         if wd_enabled:
             self._flightrec = flightrec.FlightRecorder(
                 cfg.flight_recorder_events)
@@ -768,7 +869,8 @@ class ExperimentBuilder:
                 registry=self.registry, jsonl=self.jsonl,
                 prom_path=f"{self.paths['logs']}/metrics.prom",
                 poll_interval_s=cfg.watchdog_poll_interval_s,
-                process_index=jax.process_index()).start()
+                process_index=jax.process_index(),
+                cluster=self._cluster).start()
             # Eager registration: every per-epoch metrics row (and the
             # report's watchdog section) must show "0 trips", not omit
             # the counter.
@@ -816,6 +918,10 @@ class ExperimentBuilder:
             if self._watchdog is not None:
                 self._watchdog.stop()
                 self._watchdog = None
+            if self._cluster is not None:
+                self._cluster.close()
+                cluster.install(prev_cluster)
+                self._cluster = None
             if wd_enabled:
                 watchdog.install_beacon(prev_beacon)
                 flightrec.install(prev_recorder)
@@ -998,12 +1104,15 @@ class ExperimentBuilder:
             err = e
         abort_all_if_any(err, f"a peer process could not load the rewind "
                               f"checkpoint {tag}")
+        # Agreed tag, but the BYTES must agree too (replicate_state
+        # places local copies without a cross-host equality broadcast).
+        self._agree_checkpoint_fingerprint(tag, "rewind")
         self.ckpt.meta["rewinds"] = rewinds
         # Drop the abandoned window's epochs from the ensemble
         # bookkeeping and persist (rewind_to writes the whole meta dict,
         # rewind count included).
         self.ckpt.rewind_to(tag, write=self.is_main_process)
-        self.state = jax.device_put(state, replicated_sharding(self.mesh))
+        self.state = replicate_state(state, self.mesh)
         self.current_iter = int(meta["current_iter"])
         # Rewrite 'latest' to the rewound state NOW: the on-disk latest
         # still holds the abandoned window's weights, and a hard kill
@@ -1143,9 +1252,12 @@ class ExperimentBuilder:
         template_shapes = state_leaf_shapes(self.state)
         for epoch in top:
             state, _ = self.ckpt.load(self.state, epoch)
+            # Each ensemble member's bytes must agree across hosts
+            # before its collective-free replication below.
+            self._agree_checkpoint_fingerprint(epoch, "ensemble")
             state = migrate_lslr_rows(cfg, state)
             state = reconcile_loaded_shapes(cfg, state, template_shapes)
-            state = jax.device_put(state, replicated_sharding(self.mesh))
+            state = replicate_state(state, self.mesh)
             res = self._evaluate(self._eval_batches("test"), state,
                                  collect_logits=True)
             per_model_logits.append(res["logits"])

@@ -11,6 +11,7 @@ from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
     any_process_true,
     assemble_global_batch,
     barrier,
+    gather_host_ints,
     initialize_distributed,
     local_batch_positions,
 )
@@ -19,5 +20,5 @@ __all__ = [
     "MeshPlan", "batch_sharding", "make_mesh", "make_sharded_steps",
     "replicated_sharding", "shard_batch",
     "agree_int_from_main", "any_process_true", "assemble_global_batch", "barrier",
-    "initialize_distributed", "local_batch_positions",
+    "gather_host_ints", "initialize_distributed", "local_batch_positions",
 ]

@@ -88,6 +88,38 @@ def shard_batch(batch: Episode, mesh: Mesh) -> Episode:
     return jax.device_put(batch, batch_sharding(mesh))
 
 
+def replicate_state(state, mesh: Mesh):
+    """Replicate a host-identical pytree onto every device of ``mesh``.
+
+    Single-process meshes delegate to ``jax.device_put`` verbatim. On a
+    multi-process mesh, ``jax.device_put`` with a non-addressable
+    sharding first runs ``multihost_utils.assert_equal`` — a broadcast
+    of EVERY leaf across hosts. That is (a) a full extra copy of the
+    state over DCN on each resume/rewind, and (b) unstable on the gloo
+    CPU transport, where the per-leaf collectives of one program race
+    each other (observed live: ``gloo … op.preamble.length <=
+    op.nbytes`` aborts in scripts/chaos_pod.py). Every caller here
+    replicates values that are identical across hosts *by
+    construction* — same-seed init, or a checkpoint load whose
+    iteration AND content fingerprint the resume path already agrees
+    on cross-host (``experiment.py § _resume``) — so each process just
+    places its local copy and declares the global array: zero
+    collectives, bitwise the same result.
+    """
+    sharding = replicated_sharding(mesh)
+    if sharding.is_fully_addressable:
+        return jax.device_put(state, sharding)
+    devices = list(sharding.addressable_devices)
+
+    def leaf(x):
+        host = jax.device_get(x)
+        shards = [jax.device_put(host, d) for d in devices]
+        return jax.make_array_from_single_device_arrays(
+            shards[0].shape, sharding, shards)
+
+    return jax.tree.map(leaf, state)
+
+
 class MeshPlan(NamedTuple):
     """Compiled, sharded step functions for one (cfg, mesh) pair.
 

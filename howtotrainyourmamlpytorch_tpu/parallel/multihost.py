@@ -27,6 +27,7 @@ watchdog's ``watchdog_collective_timeout_s`` deadline exists to kill
 
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Callable, Dict, List, Sequence, Tuple
 
@@ -35,7 +36,8 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
-from howtotrainyourmamlpytorch_tpu.resilience import faults, watchdog
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    cluster, faults, watchdog)
 
 _ENV_COORD = "JAX_COORDINATOR_ADDRESS"
 _ENV_NPROC = "JAX_NUM_PROCESSES"
@@ -43,23 +45,39 @@ _ENV_PID = "JAX_PROCESS_ID"
 _ENV_AUTO = "JAX_AUTO_DISTRIBUTED"
 
 
+@contextlib.contextmanager
 def _collective(name: str):
-    """Watchdog + chaos scope every host-level collective enters.
+    """Watchdog + chaos + cluster scope every host-level collective
+    enters.
 
     Stamps the ``collective`` phase (restoring the caller's phase with a
     fresh timestamp on exit) so a collective stranded by a dead peer
-    trips ``watchdog_collective_timeout_s`` instead of whatever phase
-    the caller happened to be in — and gives the flight recorder the
-    collective's name. The ``hang_collective`` chaos hook (call-counted:
+    trips ``watchdog_collective_timeout_s`` — or the tighter
+    ``cluster_collective_timeout_s`` when the pod fault domain is armed
+    (resilience/cluster.py) — instead of whatever phase the caller
+    happened to be in, and gives the flight recorder the collective's
+    name. The ``hang_collective`` chaos hook (call-counted:
     ``hang_collective@N`` sleeps the Nth collective) fires INSIDE the
     scope and before the single-process early-returns, so a stuck
-    collective is simulable without a pod. One None check each when no
-    beacon/plan is installed.
+    collective is simulable without a pod. An exception escaping the
+    collective body (a transport error — on transports that detect a
+    closed connection, a dead peer raises here instead of hanging) is
+    routed through the cluster fault domain's attributed peer-lost
+    abort before re-raising. One None check each when no
+    beacon/plan/domain is installed.
     """
     if faults.maybe_fire("hang_collective"):
         with watchdog.phase("collective", detail=name):
             faults.hang()
-    return watchdog.phase("collective", detail=name)
+    with watchdog.phase("collective", detail=name):
+        try:
+            yield
+        except Exception as e:
+            # Exits EXIT_PEER_LOST (73) when a multi-process fault
+            # domain is installed; otherwise (or with an injected trip
+            # action) the original error propagates unchanged.
+            cluster.maybe_trip_on_collective_error(name, e)
+            raise
 
 
 def _already_initialized() -> bool:
@@ -71,6 +89,23 @@ def _already_initialized() -> bool:
         return distributed.global_state.client is not None
     except Exception:
         return False
+
+
+def _maybe_enable_cpu_collectives() -> None:
+    """Multi-process runs pinned to the CPU backend need a real
+    cross-process collectives implementation (XLA's default CPU client
+    refuses: "Multiprocess computations aren't implemented on the CPU
+    backend"). Gloo ships with this jaxlib; enabling it is only legal
+    BEFORE backends exist, which is exactly when this runs. Platforms
+    other than CPU (a real pod) are untouched."""
+    platforms = str(getattr(jax.config, "jax_platforms", None)
+                    or os.environ.get("JAX_PLATFORMS", "")).lower()
+    if "cpu" not in platforms:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older/newer jax without the knob: keep the default
 
 
 def initialize_distributed() -> bool:
@@ -92,6 +127,9 @@ def initialize_distributed() -> bool:
     if _already_initialized():
         return jax.process_count() > 1
     coord = os.environ.get(_ENV_COORD)
+    if coord or os.environ.get(_ENV_AUTO, "").lower() in ("1", "true",
+                                                          "yes"):
+        _maybe_enable_cpu_collectives()
     if coord:
         missing = [v for v in (_ENV_NPROC, _ENV_PID)
                    if v not in os.environ]
@@ -146,6 +184,22 @@ def any_process_true_each(flags: Sequence[bool]) -> List[bool]:
             np.asarray(gathered).reshape(-1, len(flags)), axis=0)]
 
 
+def _encode_i64(values: Sequence[int]) -> np.ndarray:
+    """Host-level ints as TWO int32 lanes each. Without x64 (the
+    installed jax), an int64 array fed to the multihost utilities is
+    canonicalized to int32 — a value past 2^31 (half of all checkpoint
+    fingerprints) silently wraps and every host then "disagrees" with
+    its own broadcast. The int32 view is exact for the full int64
+    range."""
+    return np.asarray(list(values), dtype=np.int64).view(np.int32)
+
+
+def _decode_i64(arr: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(
+        np.asarray(arr, dtype=np.int32).reshape(-1, 2)).view(
+            np.int64).reshape(-1)
+
+
 def abort_all_if_any(err, peer_msg: str) -> None:
     """Raise on EVERY host when any host captured an error — the failing
     host re-raises its own exception; peers raise ``peer_msg`` — so no
@@ -172,8 +226,8 @@ def agree_int_from_main(value: int) -> int:
         if jax.process_count() <= 1:
             return int(value)
         from jax.experimental import multihost_utils
-        return int(multihost_utils.broadcast_one_to_all(
-            np.asarray([int(value)]))[0])
+        return int(_decode_i64(multihost_utils.broadcast_one_to_all(
+            _encode_i64([int(value)])))[0])
 
 
 def gather_host_floats(value: float) -> List[float]:
@@ -192,6 +246,23 @@ def gather_host_floats(value: float) -> List[float]:
         gathered = multihost_utils.process_allgather(
             np.asarray([float(value)], dtype=np.float64))
         return [float(v) for v in np.asarray(gathered).reshape(-1)]
+
+
+def gather_host_ints(value: int) -> List[int]:
+    """All-gather one host-level int per process, ordered by process
+    index (single-process: ``[value]``). The consensus-resume transport
+    (resilience/cluster.py): every host contributes its local view of
+    the newest committed checkpoint epoch and every host sees the full
+    vector, so all adopt the same :func:`~..resilience.cluster.
+    consensus_epoch` without a second round. A collective — every
+    process must call it at the same program point."""
+    with _collective("gather_host_ints"):
+        if jax.process_count() <= 1:
+            return [int(value)]
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            _encode_i64([int(value)]))
+        return [int(v) for v in _decode_i64(np.asarray(gathered))]
 
 
 def barrier(tag: str) -> None:

@@ -22,6 +22,11 @@ holds the pieces the rest of the codebase composes:
 * :mod:`~.flightrec` — the lock-protected in-memory event ring dumped as
   ``flight.jsonl`` into every crash bundle (watchdog trip, preemption,
   unhandled exception).
+* :mod:`~.cluster` — the pod fault domain: shared-storage heartbeat
+  leases per host, a pure live/stalled/dead peer monitor, per-collective
+  deadlines with an attributed ``peer_lost`` abort (``EXIT_PEER_LOST``,
+  73) and the consensus-resume helpers that agree every host onto one
+  committed checkpoint epoch after a peer-loss restart.
 
 Metrics: everything here counts into ONE process-wide registry reference
 (`set_registry`), installed by the component that owns telemetry for the
@@ -44,6 +49,12 @@ EXIT_PREEMPTED = 75
 # scheduler/dashboard can tell a clean preemption from a hang kill
 # (docs/RESILIENCE.md § Hangs & forensics).
 EXIT_HUNG = 74
+# Exit code for "a pod peer died/stalled and stranded our collectives;
+# peer_lost forensics written, restart the WHOLE job" — distinct from
+# EXIT_HUNG so a scheduler restarts every task from the consensus
+# checkpoint instead of resubmitting one task into a pod that no longer
+# exists (docs/RESILIENCE.md § Pod fault domain).
+EXIT_PEER_LOST = 73
 
 _registry: Optional[Any] = None  # duck-typed telemetry.MetricsRegistry
 
@@ -72,6 +83,11 @@ from howtotrainyourmamlpytorch_tpu.resilience.faults import (  # noqa: E402
     FaultPlan,
     FaultSpec,
 )
+from howtotrainyourmamlpytorch_tpu.resilience.cluster import (  # noqa: E402
+    ClusterFaultDomain,
+    ClusterMonitor,
+    HeartbeatLease,
+)
 from howtotrainyourmamlpytorch_tpu.resilience.guard import (  # noqa: E402
     DivergenceGuard,
 )
@@ -89,8 +105,9 @@ from howtotrainyourmamlpytorch_tpu.resilience.watchdog import (  # noqa: E402
 )
 
 __all__ = [
-    "EXIT_HUNG", "EXIT_PREEMPTED", "DivergenceGuard", "FaultPlan",
-    "FaultSpec", "FlightRecorder", "ProgressBeacon", "Watchdog",
+    "EXIT_HUNG", "EXIT_PEER_LOST", "EXIT_PREEMPTED", "ClusterFaultDomain",
+    "ClusterMonitor", "DivergenceGuard", "FaultPlan", "FaultSpec",
+    "FlightRecorder", "HeartbeatLease", "ProgressBeacon", "Watchdog",
     "backoff_delay", "counter_inc", "get_registry", "retry_io",
     "set_registry", "write_crash_bundle",
 ]

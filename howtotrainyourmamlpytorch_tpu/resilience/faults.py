@@ -53,6 +53,13 @@ KINDS = (
                         # file writes; utils/checkpoint.py §
                         # _write_bytes_atomic). Recovery must resume
                         # from the last COMMITTED manifest entry.
+    "kill_peer",        # SIGKILL of THIS host at a train iteration —
+                        # no handler, no cleanup, no save-on-signal:
+                        # peer-death as the SURVIVORS experience it.
+                        # Set on exactly one host of a multi-process
+                        # run (scripts/chaos_pod.py); the others must
+                        # detect the loss via the cluster fault domain
+                        # and exit EXIT_PEER_LOST (73).
 )
 
 # How long a hang_* fault sleeps (seconds). Long enough to overrun any

@@ -171,7 +171,8 @@ class Watchdog:
                  prom_path: Optional[str] = None,
                  poll_interval_s: float = 0.0,
                  on_trip: Optional[Callable[[Dict[str, Any]], None]] = None,
-                 process_index: int = 0):
+                 process_index: int = 0,
+                 cluster: Optional[Any] = None):
         self.beacon = beacon
         self.deadlines = {k: float(v) for k, v in deadlines.items()}
         self.bundle_dir = bundle_dir
@@ -180,6 +181,13 @@ class Watchdog:
         self.prom_path = prom_path
         self.on_trip = on_trip
         self.process_index = int(process_index)
+        # Pod fault domain (resilience/cluster.py): the poll loop keeps
+        # this host's heartbeat lease fresh (the monitor thread proves
+        # the PROCESS is alive even while the main thread is
+        # legitimately blocked inside a collective), and a tripped
+        # collective deadline that overran the CLUSTER budget is
+        # delegated to its attributed peer-lost path (exit 73).
+        self.cluster = cluster
         enabled = [v for v in self.deadlines.values() if v > 0]
         self.enabled = bool(enabled)
         # Auto poll: fast enough to detect the tightest deadline with
@@ -235,6 +243,13 @@ class Watchdog:
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_interval_s):
+            if self.cluster is not None:
+                # Liveness, not progress: the lease must stay fresh
+                # while this host waits inside a collective, so a dead
+                # peer's aging lease stands out against the (equally
+                # blocked) survivors'. Rate-limited by the lease's own
+                # interval; fail-soft.
+                self.cluster.heartbeat()
             info = self.check()
             if info is not None:
                 self.trip(info)
@@ -248,6 +263,13 @@ class Watchdog:
         step is best-effort — a failure mid-dump must not prevent the
         exit that frees the pod."""
         from howtotrainyourmamlpytorch_tpu import resilience
+        if self.cluster is not None and self.cluster.owns_trip(info):
+            # A collective that overran the CLUSTER deadline: the pod
+            # fault domain attributes the loss (suspect hosts from the
+            # lease ages) and exits EXIT_PEER_LOST instead of EXIT_HUNG.
+            self.tripped = info
+            self.cluster.trip_peer_lost(info)
+            return
         self.tripped = info
         flightrec.record("watchdog_trip", **info)
         if self.registry is not None:

@@ -62,7 +62,7 @@ from howtotrainyourmamlpytorch_tpu.meta.outer import (
     reconcile_loaded_shapes, state_leaf_shapes)
 from howtotrainyourmamlpytorch_tpu.models import make_model
 from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
-    make_mesh, replicated_sharding)
+    make_mesh, replicate_state)
 from howtotrainyourmamlpytorch_tpu.serve.adapt import (
     AdaptedTask, make_serve_steps)
 from howtotrainyourmamlpytorch_tpu.serve.batcher import (
@@ -112,7 +112,7 @@ class ServingEngine:
         self.mesh = make_mesh(cfg, devices[:n_mesh])
         self.steps = make_serve_steps(cfg, self.model_apply, self.mesh)
         self.num_adapt_steps = cfg.effective_serve_adapt_steps
-        self.state = jax.device_put(state, replicated_sharding(self.mesh))
+        self.state = replicate_state(state, self.mesh)
         # Cache entries must die with the weights that produced them:
         # the fingerprint folds in this context (checkpoint fingerprint
         # when loaded via from_checkpoint).
@@ -558,7 +558,7 @@ class ServingEngine:
         state, _meta = ckpt.load(template, tag)
         state = migrate_lslr_rows(self.cfg, state)
         state = reconcile_loaded_shapes(self.cfg, state, template_shapes)
-        return jax.device_put(state, replicated_sharding(self.mesh))
+        return replicate_state(state, self.mesh)
 
     def _probe_episodes(self) -> List[FewShotRequest]:
         """Pinned canary probes: deterministic synthetic episodes at the

@@ -19,8 +19,10 @@ from typing import Any, Dict, List, Optional, Union
 # subsystem); v5: + "watchdog" (hang detection / flight recorder);
 # v6: + "health" (optimization-health introspection, telemetry/health.py);
 # v7: + "checkpoint" (ckpt/ lifecycle subsystem: async saves, GC,
-# serving hot-swap)
-SCHEMA = "maml_tpu_telemetry_report_v7"
+# serving hot-swap); v8: + "cluster" (pod fault domain,
+# resilience/cluster.py: peer losses, suspect attribution, consensus
+# resume, lease ages)
+SCHEMA = "maml_tpu_telemetry_report_v8"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -368,6 +370,63 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                     else int(c_totals.get(key, 0)))
             for label, key in _CKPT_KEYS.items()}
 
+    # Cluster section (resilience/cluster.py, schema v8): peer losses
+    # from the cluster/peer_losses counter on registry "metrics" rows
+    # (reset-aware — a tripped survivor EXITS 73, so its final counters
+    # live in a killed segment) cross-checked against explicit
+    # "peer_lost" event rows; the last suspect and the consensus epoch
+    # track the most recent signal in log order; lease ages come from
+    # the heartbeat rows' per-host peer_lease_age_seconds (last row
+    # wins — the liveness picture at the end of the log, like the
+    # watchdog's progress age). Runs without the pod fault domain
+    # summarize to "unavailable".
+    cl_totals: Dict[str, float] = {}
+    cl_prev: Dict[str, float] = {}
+    cl_rows = 0
+    cl_seen = False
+    cl_suspect: Metric = UNAVAILABLE
+    cl_consensus: Metric = UNAVAILABLE
+    cl_ages: Union[Dict[str, Any], str] = UNAVAILABLE
+    for e in events:
+        if e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if m.get("cluster/peer_losses") is not None:
+                cl_seen = True
+                _accumulate_counter(cl_totals, cl_prev, "peer_losses",
+                                    float(m["cluster/peer_losses"]))
+            if m.get("cluster/consensus_epoch") is not None:
+                cl_seen = True
+                cl_consensus = int(m["cluster/consensus_epoch"])
+        elif e.get("event") == "peer_lost":
+            cl_seen = True
+            cl_rows += 1
+            suspects = e.get("suspect_hosts")
+            if isinstance(suspects, list) and suspects:
+                cl_suspect = int(suspects[0])
+            if isinstance(e.get("peer_lease_age_seconds"), dict):
+                cl_ages = e["peer_lease_age_seconds"]
+        elif e.get("event") == "consensus_resume":
+            cl_seen = True
+            if e.get("consensus_epoch") is not None:
+                cl_consensus = int(e["consensus_epoch"])
+        elif e.get("event") == "heartbeat":
+            if isinstance(e.get("peer_lease_age_seconds"), dict):
+                cl_seen = True
+                cl_ages = e["peer_lease_age_seconds"]
+    cluster_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if cl_seen:
+        finite_ages = (_finite(list(cl_ages.values()))
+                       if isinstance(cl_ages, dict) else [])
+        cluster_sec = {
+            "peer_losses": max(int(cl_totals.get("peer_losses", 0)),
+                               cl_rows),
+            "last_suspect_host": cl_suspect,
+            "consensus_epoch": cl_consensus,
+            "max_peer_lease_age_seconds": (round(max(finite_ages), 3)
+                                           if finite_ages
+                                           else UNAVAILABLE),
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -402,6 +461,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "watchdog": watchdog_sec,
         "health": health_sec,
         "checkpoint": ckpt_sec,
+        "cluster": cluster_sec,
     }
 
 
@@ -434,6 +494,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("watchdog", summary["watchdog"]),
         ("health", summary["health"]),
         ("checkpoint", summary["checkpoint"]),
+        ("cluster", summary["cluster"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

@@ -1,0 +1,399 @@
+"""Pod chaos harness: SIGKILL a peer, prove the attributed cluster abort
+and the consensus resume — end to end, with real OS processes.
+
+The ISSUE 9 acceptance scenario (docs/RESILIENCE.md § Pod fault domain):
+
+1. **peer_kill** — boot an N-process ``jax.distributed`` training run on
+   CPU (each process 4 virtual devices, the
+   tests/test_multiprocess_distributed.py topology) with the pod fault
+   domain armed (``cluster_collective_timeout_s``). One host carries
+   ``kill_peer@I`` (resilience/faults.py): at train iteration I it
+   SIGKILLs itself — no handler, no cleanup, exactly what a yanked pod
+   node looks like to the survivors. Every survivor must block in its
+   next collective, trip the cluster deadline within
+   ``cluster_collective_timeout_s`` + slack, write a crash bundle and a
+   ``peer_lost`` row *naming the dead host*, and exit
+   ``EXIT_PEER_LOST`` (73) so a scheduler restarts the whole job.
+2. **restart** — relaunch all N processes with no faults. The cluster
+   consensus-resume barrier agrees every host onto the committed
+   checkpoint epoch; the run must resume from exactly those bytes (the
+   committed epoch file's CRC is pinned before and after) and complete
+   through the ensemble test protocol.
+3. **parity** — zero-cost-when-disabled, the watchdog standard: three
+   single-process runs (cluster off / on / off) must produce
+   bitwise-identical final weights, and the two cache-warm runs must
+   compile the same number of executables.
+
+Artifact contract (bench.py discipline): the LAST stdout JSON line is
+authoritative — ``{"metric": "pod_chaos", "status":
+"recovered"|"failed"|"skipped", ...}``. Exit 0 iff recovered (or
+skipped: a sandbox that cannot bind localhost sockets cannot run the
+multi-process phases, and says so rather than failing).
+
+Usage:
+    python scripts/chaos_pod.py --quick
+    python scripts/chaos_pod.py --out /tmp/pod --phases peer_kill,restart
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import zlib
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+NUM_PROCESSES = 2
+KILL_ITER = 6  # mid-epoch-1 (epoch 0 = iters 1..4, checkpointed at 4)
+COLLECTIVE_TIMEOUT_S = 12.0
+# Trip-latency slack on top of the collective budget: watchdog poll
+# overshoot (<= ~25% of the deadline), the bundle/flush drain, and this
+# 1-core box's scheduling jitter.
+TRIP_SLACK_S = 60.0
+
+
+def pod_cfg_dict(out_dir: str, **kw):
+    """The tiny-but-real 2-host workload: 3-way 1-shot over a (2, 4)
+    mesh, every sync point one iteration apart so the kill lands
+    deterministically, cluster deadline tight enough to prove latency."""
+    base = dict(
+        experiment_name="pod_chaos", experiment_root=out_dir,
+        dataset_name="synthetic_pod",
+        image_height=10, image_width=10, image_channels=1,
+        num_classes_per_set=3, num_samples_per_class=1,
+        num_target_samples=2, batch_size=8,
+        cnn_num_filters=4, num_stages=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        second_order=False, use_multi_step_loss_optimization=False,
+        total_epochs=2, total_iter_per_epoch=4,
+        num_evaluation_tasks=4, max_models_to_save=2,
+        compute_dtype="float32", meta_learning_rate=0.005,
+        dispatch_sync_every=1, live_progress=False,
+        mesh_shape=[2, 4],
+        continue_from_epoch="latest",
+        # Pod fault domain armed; generous generic deadlines so ONLY
+        # the cluster budget can trip (the attribution under test).
+        cluster_collective_timeout_s=COLLECTIVE_TIMEOUT_S,
+        cluster_lease_interval_s=0.5,
+        watchdog_step_timeout_s=600.0, watchdog_feed_timeout_s=600.0,
+        watchdog_collective_timeout_s=600.0,
+        watchdog_compile_timeout_s=1200.0,
+        watchdog_poll_interval_s=0.25,
+        # Fail-loud geometry: this IS a pod profile (satellite pin).
+        require_mesh=1)
+    base.update(kw)
+    return base
+
+
+def launch_pod(out: str, cfg: dict, fault_host=None, fault_spec=""):
+    """Start NUM_PROCESSES train_maml_system.py workers joined through
+    jax.distributed; returns (procs, log files). Workers write straight
+    to files — SPMD lockstep means an undrained PIPE on one would
+    deadlock all."""
+    os.makedirs(out, exist_ok=True)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    cfg_path = os.path.join(out, "pod_cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+    procs, logs = [], []
+    for pid in range(NUM_PROCESSES):
+        env = dict(os.environ)
+        env.pop("MAML_FAULTS", None)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": str(NUM_PROCESSES),
+            "JAX_PROCESS_ID": str(pid),
+            "MAML_JAX_PLATFORM": "cpu",
+            "XLA_FLAGS": (env.get("XLA_FLAGS", "") +
+                          " --xla_force_host_platform_device_count=4"
+                          ).strip(),
+        })
+        if fault_host is not None and pid == fault_host:
+            env["MAML_FAULTS"] = fault_spec
+        out_f = open(os.path.join(out, f"worker{pid}.log"), "w+")
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(_REPO, "train_maml_system.py"),
+             "--name_of_args_json_file", cfg_path],
+            env=env, stdout=out_f, stderr=subprocess.STDOUT, text=True))
+        logs.append(out_f)
+    return procs, logs
+
+
+def read_events(out: str):
+    path = os.path.join(out, "pod_chaos", "logs", "events.jsonl")
+    rows = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    rows.append(json.loads(line))
+    return rows
+
+
+def committed_view(out: str):
+    """(newest committed epoch, its iteration, its file CRC32) from the
+    shared manifest + checkpoint file — the consensus resume target."""
+    saved = os.path.join(out, "pod_chaos", "saved_models")
+    manifest_path = os.path.join(saved, "MANIFEST.json")
+    epoch = it = crc = None
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            records = json.load(f).get("records", {})
+        epochs = [(int(t), r) for t, r in records.items()
+                  if t.isdigit() and r.get("status") == "committed"]
+        if epochs:
+            epoch, rec = max(epochs)
+            it = rec.get("iter")
+    if epoch is not None:
+        ckpt = os.path.join(saved, f"train_model_{epoch}.ckpt")
+        if os.path.exists(ckpt):
+            with open(ckpt, "rb") as f:
+                crc = zlib.crc32(f.read())
+    return epoch, it, crc
+
+
+def wait_all(procs, logs, timeout_s: float):
+    """Wait for every worker; returns return codes (None = timed out,
+    then killed)."""
+    codes = []
+    deadline = time.monotonic() + timeout_s
+    for p in procs:
+        left = max(deadline - time.monotonic(), 1.0)
+        try:
+            p.wait(timeout=left)
+            codes.append(p.returncode)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+            codes.append(None)
+    for f in logs:
+        f.close()
+    return codes
+
+
+def run_peer_kill(out: str) -> dict:
+    """Phase 1: the attributed abort. Returns the phase's facts."""
+    from howtotrainyourmamlpytorch_tpu.resilience import EXIT_PEER_LOST
+    procs, logs = launch_pod(out, pod_cfg_dict(out), fault_host=1,
+                             fault_spec=f"kill_peer@{KILL_ITER}")
+    victim, survivor = procs[1], procs[0]
+    # The victim SIGKILLs itself mid-epoch-1 (after compiles + epoch 0,
+    # which can take minutes on a 1-core box) — generous ceiling.
+    try:
+        victim.wait(timeout=1200)
+    except subprocess.TimeoutExpired:
+        pass
+    victim_dead_at = time.monotonic()
+    # The survivor must exit within the cluster budget + slack FROM THE
+    # PEER'S DEATH — the latency claim the exit code makes.
+    try:
+        survivor.wait(timeout=COLLECTIVE_TIMEOUT_S + TRIP_SLACK_S)
+        survivor_latency = time.monotonic() - victim_dead_at
+    except subprocess.TimeoutExpired:
+        survivor_latency = None
+    wait_all(procs, logs, timeout_s=5.0)
+
+    events = read_events(out)
+    lost = [e for e in events if e.get("event") == "peer_lost"]
+    bundle = os.path.join(out, "pod_chaos", "logs", "crash_bundle_p0")
+    crash = {}
+    crash_path = os.path.join(bundle, "crash.json")
+    if os.path.exists(crash_path):
+        with open(crash_path) as f:
+            crash = json.load(f)
+    epoch, it, crc = committed_view(out)
+    tail = ""
+    log_path = os.path.join(out, "worker0.log")
+    if os.path.exists(log_path):
+        with open(log_path) as f:
+            tail = f.read()[-1200:]
+    facts = {
+        "victim_exit_code": victim.returncode,
+        "survivor_exit_code": survivor.returncode,
+        "survivor_latency_s": (round(survivor_latency, 3)
+                               if survivor_latency is not None else None),
+        "peer_lost_rows": len(lost),
+        "suspect_hosts": (lost[-1].get("suspect_hosts") if lost else None),
+        "bundle_reason": crash.get("reason"),
+        "bundle_suspects": crash.get("suspect_hosts"),
+        "committed_epoch": epoch,
+        "committed_iter": it,
+        "committed_crc": crc,
+    }
+    facts["ok"] = bool(
+        victim.returncode == -9  # SIGKILL took it, nothing graceful
+        and survivor.returncode == EXIT_PEER_LOST
+        and survivor_latency is not None
+        and facts["peer_lost_rows"] >= 1
+        and facts["suspect_hosts"] == [1]
+        and facts["bundle_reason"] == "peer_lost"
+        and epoch == 0 and it == 4)  # epoch 0's boundary survived
+    if not facts["ok"]:
+        facts["survivor_log_tail"] = tail
+    return facts
+
+
+def run_restart(out: str, committed_epoch, committed_crc) -> dict:
+    """Phase 2: consensus resume. All N relaunch, agree on the committed
+    epoch, resume bitwise from its bytes, finish the run."""
+    procs, logs = launch_pod(out, pod_cfg_dict(out))
+    codes = wait_all(procs, logs, timeout_s=1500)
+    with open(os.path.join(out, "worker0.log")) as f:
+        w0 = f.read()
+    resumed = None
+    for line in w0.splitlines():
+        if line.startswith("resumed from checkpoint"):
+            resumed = line.strip()
+    # The committed snapshot's bytes were the resume source and survive
+    # the restart untouched — bitwise, not "same epoch number". (The
+    # restart retrains LATER epochs; this epoch's file must not move.)
+    crc_after = None
+    if committed_epoch is not None:
+        ckpt = os.path.join(out, "pod_chaos", "saved_models",
+                            f"train_model_{committed_epoch}.ckpt")
+        if os.path.exists(ckpt):
+            with open(ckpt, "rb") as f:
+                crc_after = zlib.crc32(f.read())
+    facts = {
+        "exit_codes": codes,
+        "resumed_line": resumed,
+        "committed_crc_unchanged": bool(committed_crc is not None
+                                        and crc_after == committed_crc),
+        "test_protocol_ran": "test:" in w0,
+    }
+    facts["ok"] = bool(
+        all(c == 0 for c in codes)
+        and resumed is not None and "at iter 4" in resumed
+        and facts["committed_crc_unchanged"]
+        and facts["test_protocol_ran"])
+    if not facts["ok"]:
+        facts["worker0_log_tail"] = w0[-1200:]
+    return facts
+
+
+def run_parity(out: str) -> dict:
+    """Phase 3: all cluster knobs at 0/off vs armed — bitwise-identical
+    weights and cache-warm compile counts (the watchdog standard)."""
+    import jax
+    import numpy as np
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    def single(name, **kw):
+        cfg = pod_cfg_dict(out, experiment_name=name, mesh_shape=[1, 1],
+                           batch_size=2, require_mesh=0,
+                           continue_from_epoch="from_scratch", **kw)
+        builder = ExperimentBuilder(MAMLConfig.from_dict(cfg))
+        builder.run_experiment()
+        return builder
+
+    on_kw = dict(cluster_collective_timeout_s=300.0,
+                 cluster_lease_interval_s=0.1)
+    off_kw = dict(cluster_collective_timeout_s=0.0)
+    # Run 1 (off) pays the process's cold compiles; the on/off pair is
+    # equally cache-warm, so their compile counts isolate the domain.
+    single("parity_cold", **off_kw)
+    b_on = single("parity_on", **on_kw)
+    compiles_on = b_on.registry.counter("compile/count").value
+    b_off = single("parity_off", **off_kw)
+    compiles_off = b_off.registry.counter("compile/count").value
+    weights_equal = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(b_on.state.params),
+                        jax.tree.leaves(b_off.state.params)))
+    facts = {
+        "weights_equal": weights_equal,
+        "compiles_on": int(compiles_on),
+        "compiles_off": int(compiles_off),
+    }
+    facts["ok"] = bool(weights_equal and compiles_on == compiles_off)
+    return facts
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Pod fault-domain chaos: SIGKILL a jax.distributed "
+                    "peer, prove attributed exit 73 + consensus resume.")
+    ap.add_argument("--out", default=None, metavar="DIR",
+                    help="experiment root (default: fresh temp dir, "
+                         "removed on success)")
+    ap.add_argument("--phases", default="peer_kill,restart,parity",
+                    help="comma list of peer_kill,restart,parity")
+    ap.add_argument("--quick", action="store_true",
+                    help="accepted for CLI symmetry; the config is "
+                         "already CI-sized")
+    args = ap.parse_args(argv)
+
+    platform = os.environ.get("MAML_JAX_PLATFORM")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+    phases = [p.strip() for p in args.phases.split(",") if p.strip()]
+    out = args.out or tempfile.mkdtemp(prefix="chaos_pod_")
+    cleanup = args.out is None
+    artifact = {"metric": "pod_chaos", "phases": phases}
+
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+    except OSError:
+        # No localhost sockets, no jax.distributed: record the skip
+        # loudly instead of failing a box that cannot run the scenario.
+        artifact.update({"value": None, "status": "skipped",
+                         "skip_reason": "cannot bind localhost sockets"})
+        print(json.dumps(artifact), flush=True)
+        return 0
+
+    results = {}
+    ok = True
+    committed_epoch = committed_crc = None
+    for phase in phases:
+        print(json.dumps({"phase": phase, "status": "running"}),
+              flush=True)
+        if phase == "peer_kill":
+            results.update(
+                {f"peer_kill_{k}": v
+                 for k, v in run_peer_kill(out).items()})
+            committed_epoch = results.get("peer_kill_committed_epoch")
+            committed_crc = results.get("peer_kill_committed_crc")
+            ok = ok and results["peer_kill_ok"]
+        elif phase == "restart":
+            results.update(
+                {f"restart_{k}": v
+                 for k, v in run_restart(out, committed_epoch,
+                                         committed_crc).items()})
+            ok = ok and results["restart_ok"]
+        elif phase == "parity":
+            results.update(
+                {f"parity_{k}": v for k, v in run_parity(out).items()})
+            ok = ok and results["parity_ok"]
+        else:
+            raise SystemExit(f"unknown phase {phase!r}")
+
+    artifact.update(results)
+    artifact.update({
+        "value": 1.0 if ok else 0.0,
+        "unit": "recovered",
+        "status": "recovered" if ok else "failed",
+        "out_dir": None if cleanup else out,
+    })
+    if cleanup and ok:
+        shutil.rmtree(out, ignore_errors=True)
+    print(json.dumps(artifact), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

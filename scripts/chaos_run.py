@@ -28,6 +28,14 @@ Runs the ISSUE 3 acceptance scenario on a tiny synthetic config:
    (all-thread ``stacks.txt`` + ``flight.jsonl``) and exit
    ``resilience.EXIT_HUNG`` (74) — then a clean in-process restart from
    'latest' resumes past the hang and finishes.
+6. **peer_kill** (ISSUE 9) — shells out to ``scripts/chaos_pod.py``
+   where a multi-process ``jax.distributed`` pair can run: one host
+   SIGKILLs itself mid-epoch, every survivor must exit
+   ``EXIT_PEER_LOST`` (73) with a ``peer_lost`` row naming the dead
+   host, and a full restart must consensus-resume from the committed
+   epoch. On a box that cannot run the pair (1 core, no localhost
+   sockets) the phase is SKIPPED with the reason recorded in the
+   artifact — never silently.
 
 The verdict requires `resilience/rewinds >= 1`, `resilience/io_retries
 >= 1`, exactly one preemption, the health subsystem's grad-norm early
@@ -235,6 +243,55 @@ def ckpt_dir_state(out: str):
     }
 
 
+def run_peer_kill_phase(out: str):
+    """The ISSUE 9 pod fault-domain scenario, by shelling out to
+    scripts/chaos_pod.py (SIGKILL one of N ``jax.distributed`` hosts →
+    every survivor exits 73 with a ``peer_lost`` row naming it →
+    consensus restart) when this box can run a multi-process pair.
+    A box that can't (1-core, or no localhost sockets) SKIPS with the
+    reason recorded in the artifact — never silently.
+    """
+    import socket
+    reason = None
+    if (os.cpu_count() or 1) < 2:
+        reason = ("single-core box: a 2-process jax.distributed "
+                  "training pair would serialize past the harness "
+                  "timeouts")
+    else:
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+        except OSError:
+            reason = "cannot bind localhost sockets in this sandbox"
+    if reason:
+        return {"skipped": reason, "recovered": None}
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "chaos_pod.py"),
+         "--out", os.path.join(out, "pod"),
+         "--phases", "peer_kill,restart"],  # parity: chaos_pod's own
+        #   acceptance; this harness already proves its own phases
+        capture_output=True, text=True, timeout=3000)
+    artifact = {}
+    for line in proc.stdout.strip().splitlines()[::-1]:
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if row.get("metric") == "pod_chaos":
+            artifact = row
+            break
+    return {
+        "skipped": None,
+        "recovered": artifact.get("status") == "recovered",
+        "exit_code": proc.returncode,
+        "survivor_exit_code": artifact.get("peer_kill_survivor_exit_code"),
+        "suspect_hosts": artifact.get("peer_kill_suspect_hosts"),
+        "resumed_line": artifact.get("restart_resumed_line"),
+        "stderr_tail": (proc.stderr[-800:]
+                        if proc.returncode != 0 else None),
+    }
+
+
 def counter_sum(snapshots, key) -> int:
     return int(sum(float(s.get(key) or 0) for s in snapshots))
 
@@ -339,6 +396,16 @@ def main(argv=None) -> int:
     hang_restart_result, _ = run_phase(
         tiny_cfg(out, "chaos_hang", continue_from_epoch="latest"))
 
+    # Pod fault domain (ISSUE 9): peer SIGKILL -> attributed exit 73 ->
+    # consensus restart, via scripts/chaos_pod.py where multi-process
+    # is available; a clean, RECORDED skip where it is not.
+    print(json.dumps({"phase": "peer_kill", "status": "running"}),
+          flush=True)
+    peer_kill = run_peer_kill_phase(out)
+    if peer_kill["skipped"]:
+        print(json.dumps({"phase": "peer_kill", "status": "skipped",
+                          "reason": peer_kill["skipped"]}), flush=True)
+
     # Health early warning (ISSUE 7): the injected NaN poisons the
     # observed grad norm too, so the faulted phase's log must read
     # warn -> rewind in that order.
@@ -390,17 +457,24 @@ def main(argv=None) -> int:
         and counter_sum([ckpt_restart_counters],
                         "resilience/quarantined") == 0)
 
+    # The peer-kill phase gates recovery when it RAN; a recorded skip
+    # (1-core box, no sockets) is not a failure — but it is never
+    # silent, the artifact says exactly why it didn't run.
+    peer_kill_ok = (peer_kill["skipped"] is not None
+                    or bool(peer_kill["recovered"]))
     recovered = bool(
         preempted and rewinds >= 1 and io_retries >= 1
         and warn_before_rewind
         and chaos_acc is not None
         and delta is not None and delta <= args.tolerance
         and ckpt_kill_recovered
-        and hang_recovered)
+        and hang_recovered
+        and peer_kill_ok)
     # Recoveries: one per distinct fault class the run survived.
     recoveries = (int(preempted) + int(rewinds >= 1)
                   + int(io_retries >= 1) + int(ckpt_kill_recovered)
-                  + int(hang_recovered))
+                  + int(hang_recovered)
+                  + int(bool(peer_kill["recovered"])))
 
     artifact = {
         "metric": "chaos_recovery",
@@ -446,6 +520,12 @@ def main(argv=None) -> int:
         "hang_test_accuracy_delta": (round(hang_delta, 6)
                                      if hang_delta is not None else None),
         "hang_recovered": hang_recovered,
+        "peer_kill_skipped": peer_kill["skipped"],
+        "peer_kill_recovered": peer_kill["recovered"],
+        "peer_kill_survivor_exit_code": peer_kill.get(
+            "survivor_exit_code"),
+        "peer_kill_suspect_hosts": peer_kill.get("suspect_hosts"),
+        "peer_kill_stderr_tail": peer_kill.get("stderr_tail"),
         "tolerance": args.tolerance,
         "out_dir": None if cleanup else out,
     }

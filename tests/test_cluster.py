@@ -1,0 +1,568 @@
+"""Pod fault domain units (ISSUE 9).
+
+Tier-1 keeps the cheap layers — the pure ClusterMonitor deadline math
+(live/stalled/dead boundaries, clock-skew tolerance, missing leases),
+lease write/read round-trip, consensus-epoch agreement with a
+deliberately stale local manifest, peer_lost-row + exit-73 plumbing via
+an injectable trip action, the double-trip escalation, and the
+structural zero-config-installs-nothing pin (the watchdog pattern). The
+N-process SIGKILL → exit-73 → consensus-resume proof lives in
+tests/test_pod_cluster.py's slow profile and scripts/chaos_pod.py.
+"""
+
+import json
+import math
+import os
+import time
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu import resilience
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    cluster, faults, flightrec, watchdog)
+from howtotrainyourmamlpytorch_tpu.resilience.cluster import (
+    ClusterFaultDomain, ClusterMonitor, HeartbeatLease)
+from howtotrainyourmamlpytorch_tpu.resilience.faults import FaultPlan
+from howtotrainyourmamlpytorch_tpu.resilience.watchdog import (
+    ProgressBeacon, Watchdog)
+from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+from howtotrainyourmamlpytorch_tpu.utils.tracing import (
+    JsonlLogger, read_jsonl)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    """Every test starts/ends with no domain, beacon, recorder, fault
+    plan or resilience registry installed (runs install their own)."""
+    faults.configure("")
+    prev_reg = resilience.set_registry(None)
+    prev_beacon = watchdog.install_beacon(None)
+    prev_rec = flightrec.install(None)
+    prev_dom = cluster.install(None)
+    yield
+    faults.configure("")
+    resilience.set_registry(prev_reg)
+    watchdog.install_beacon(prev_beacon)
+    flightrec.install(prev_rec)
+    cluster.install(prev_dom)
+
+
+# ---------------------------------------------------------------------------
+# exit code + config surface
+# ---------------------------------------------------------------------------
+
+def test_exit_code_distinct():
+    assert resilience.EXIT_PEER_LOST == 73
+    assert len({resilience.EXIT_PEER_LOST, resilience.EXIT_HUNG,
+                resilience.EXIT_PREEMPTED}) == 3
+
+
+def test_config_cluster_validation():
+    for field in ("cluster_collective_timeout_s",
+                  "cluster_peer_stalled_s", "cluster_peer_dead_s"):
+        with pytest.raises(ValueError, match=field):
+            MAMLConfig(**{field: -1.0})
+    with pytest.raises(ValueError, match="cluster_lease_interval_s"):
+        MAMLConfig(cluster_lease_interval_s=0.0)
+    with pytest.raises(ValueError, match="cluster_peer_dead_s"):
+        MAMLConfig(cluster_peer_stalled_s=10.0, cluster_peer_dead_s=5.0)
+    with pytest.raises(ValueError, match="require_mesh"):
+        MAMLConfig(require_mesh=2)
+    # Defaults: the subsystem is OFF.
+    cfg = MAMLConfig()
+    assert not cluster.cluster_enabled(cfg)
+    on = cfg.replace(cluster_collective_timeout_s=30.0)
+    assert cluster.cluster_enabled(on)
+    # Auto thresholds: stalled = 3 lease intervals; dead = the
+    # collective budget, never below stalled.
+    assert cluster.stalled_after(on) == pytest.approx(15.0)
+    assert cluster.dead_after(on) == pytest.approx(30.0)
+    tight = on.replace(cluster_collective_timeout_s=2.0)
+    assert cluster.dead_after(tight) >= cluster.stalled_after(tight)
+
+
+def test_arm_deadlines_merge():
+    base = {"collective": 1800.0, "step": 300.0}
+    off = MAMLConfig()
+    assert cluster.arm_deadlines(off, base) == base
+    on = off.replace(cluster_collective_timeout_s=10.0)
+    armed = cluster.arm_deadlines(on, base)
+    assert armed["collective"] == pytest.approx(10.0)
+    assert armed["step"] == pytest.approx(300.0)  # untouched
+    # A watchdog collective deadline of 0 (disabled) still gets armed —
+    # the cluster budget is what turns the phase on.
+    assert cluster.arm_deadlines(on, {"collective": 0.0})["collective"] \
+        == pytest.approx(10.0)
+    # A TIGHTER generic deadline is kept (the cluster path then never
+    # claims the earlier generic trip — owns_trip below).
+    assert cluster.arm_deadlines(on, {"collective": 5.0})["collective"] \
+        == pytest.approx(5.0)
+
+
+def test_kill_peer_fault_kind_parses():
+    plan = FaultPlan.parse("kill_peer@6")
+    assert "kill_peer" in faults.KINDS
+    assert plan.maybe_fire("kill_peer", step=6)
+    assert not plan.maybe_fire("kill_peer", step=6)  # at most once
+
+
+# ---------------------------------------------------------------------------
+# monitor (pure deadline math)
+# ---------------------------------------------------------------------------
+
+def test_monitor_classification_boundaries():
+    mon = ClusterMonitor(stalled_after_s=2.0, dead_after_s=10.0)
+    assert mon.classify(0.0) == cluster.LIVE
+    assert mon.classify(2.0) == cluster.LIVE       # inclusive boundary
+    assert mon.classify(2.01) == cluster.STALLED
+    assert mon.classify(10.0) == cluster.STALLED   # inclusive boundary
+    assert mon.classify(10.01) == cluster.DEAD
+    assert mon.classify(math.inf) == cluster.DEAD  # missing lease
+    # Clock skew: a lease "from the future" reads as fresh, never dead.
+    assert mon.classify(-5.0) == cluster.LIVE
+    with pytest.raises(ValueError):
+        ClusterMonitor(stalled_after_s=0.0, dead_after_s=10.0)
+    with pytest.raises(ValueError):
+        ClusterMonitor(stalled_after_s=10.0, dead_after_s=2.0)
+
+
+def test_monitor_suspects_exclude_self_and_prefer_dead():
+    mon = ClusterMonitor(stalled_after_s=2.0, dead_after_s=10.0,
+                         self_index=0)
+    # Self is stalled too (it is blocked in the stranded collective) —
+    # it must never blame itself.
+    ages = {0: 5.0, 1: 12.0, 2: 4.0, 3: 30.0}
+    assert mon.check(ages)[0] == cluster.STALLED
+    assert mon.suspects(ages) == [3, 1]  # dead peers only, oldest first
+    # No dead peers: the stalled ones are the suspects.
+    assert mon.suspects({0: 5.0, 1: 4.0, 2: 0.1}) == [1]
+    # Every peer live: the leases exonerate them (a genuine hang).
+    assert mon.suspects({0: 50.0, 1: 0.1, 2: 0.2}) == []
+
+
+# ---------------------------------------------------------------------------
+# heartbeat leases
+# ---------------------------------------------------------------------------
+
+def test_lease_write_read_roundtrip(tmp_path):
+    lease_dir = str(tmp_path / "cluster")
+    lease = HeartbeatLease(lease_dir, process_index=0, interval_s=60.0)
+    assert lease.touch(detail="epoch_0") is True
+    assert os.path.isfile(lease.path)
+    # Advisory payload is readable JSON naming the host.
+    assert json.load(open(lease.path))["host"] == 0
+    # Rate-limited: an immediate second touch is a no-op...
+    assert lease.touch() is False
+    # ...unless forced (the per-epoch heartbeat path).
+    assert lease.touch(force=True) is True
+    assert lease.touches == 2
+
+    ages = cluster.read_lease_ages(lease_dir)
+    assert set(ages) == {0} and ages[0] < 30.0
+    # A stale peer lease reads as old; an expected-but-absent host
+    # reads as inf (dead) — absence on shared storage IS the signal.
+    peer = cluster.lease_path(lease_dir, 1)
+    with open(peer, "w") as f:
+        f.write("{}")
+    past = time.time() - 120.0
+    os.utime(peer, (past, past))
+    # A FAILED write must not consume the rate-limit window: with the
+    # lease "dir" shadowed by a file, touch fails — and the very next
+    # call (not one interval later) retries.
+    broken = HeartbeatLease(str(tmp_path / "shadow"), 0, interval_s=60.0)
+    with open(str(tmp_path / "shadow"), "w") as f:
+        f.write("not a directory")
+    assert broken.touch() is False and broken.errors == 1
+    assert broken.touch() is False and broken.errors == 2  # retried NOW
+
+    ages = cluster.read_lease_ages(lease_dir, expected_hosts=3)
+    assert 100.0 < ages[1] < 200.0
+    assert ages[2] == math.inf
+    # An orphan lease from a previous LARGER pod geometry is dropped
+    # when the pod size is known — it must not top every suspect list
+    # as a permanently-dead host.
+    orphan = cluster.lease_path(lease_dir, 7)
+    with open(orphan, "w") as f:
+        f.write("{}")
+    os.utime(orphan, (past, past))
+    assert 7 not in cluster.read_lease_ages(lease_dir, expected_hosts=2)
+    assert 7 in cluster.read_lease_ages(lease_dir)  # size unknown: kept
+    # Fail-soft: a missing directory degrades to expected-hosts-only.
+    assert cluster.read_lease_ages(str(tmp_path / "nope")) == {}
+
+
+# ---------------------------------------------------------------------------
+# consensus resume
+# ---------------------------------------------------------------------------
+
+def test_host_int_lanes_roundtrip_exactly():
+    """The agreement collectives ship ints as two int32 lanes: without
+    x64, an int64 array is canonicalized to int32 and any value past
+    2^31 — half of all checkpoint fingerprints — silently wraps, making
+    every host 'disagree' with its own broadcast (found live by
+    chaos_pod's restart phase)."""
+    from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
+        _decode_i64, _encode_i64)
+    values = [0, -1, 1, 2**31 - 1, 2**31, 3562112061, 2**63 - 1,
+              -(2**63)]
+    encoded = _encode_i64(values)
+    assert encoded.dtype.name == "int32"  # survives canonicalization
+    assert list(_decode_i64(encoded)) == values
+    # The gathered form (one row per host) decodes the same way.
+    import numpy as np
+    stacked = np.stack([_encode_i64([v]) for v in values])
+    assert list(_decode_i64(stacked)) == values
+
+
+def test_consensus_epoch_math():
+    assert cluster.consensus_epoch([5, 3, 4]) == 3
+    # A stale/damaged view (-1) adopts the peers' verdict instead of
+    # dragging the cluster to a fresh start.
+    assert cluster.consensus_epoch([5, -1, 3]) == 3
+    assert cluster.consensus_epoch([-1, -1]) == -1
+    assert cluster.consensus_epoch([]) == -1
+    assert cluster.consensus_epoch([0]) == 0
+
+
+def test_latest_committed_epoch_with_stale_manifest(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.ckpt import manifest as manifest_mod
+    # "Fresh" host: epochs 0 and 1 committed, epoch 2 stranded pending,
+    # plus the 'latest' link record (which must NOT count — consensus
+    # is over epoch snapshots every host can load by tag).
+    fresh_dir = str(tmp_path / "fresh")
+    os.makedirs(fresh_dir)
+    fresh = manifest_mod.Manifest(fresh_dir)
+    for epoch in (0, 1):
+        fresh.begin(str(epoch), epoch=epoch, iteration=4 * (epoch + 1))
+        fresh.commit(str(epoch), nbytes=10, crc=1)
+    fresh.begin("latest", iteration=8)
+    fresh.commit("latest", nbytes=10, crc=1)
+    fresh.begin("2", epoch=2, iteration=12)  # torn write: never commits
+    assert cluster.latest_committed_epoch(fresh) == 1
+
+    # Stale host: its MANIFEST.json view predates epoch 1's commit.
+    stale_dir = str(tmp_path / "stale")
+    os.makedirs(stale_dir)
+    stale = manifest_mod.Manifest(stale_dir)
+    stale.begin("0", epoch=0, iteration=4)
+    stale.commit("0", nbytes=10, crc=1)
+    assert cluster.latest_committed_epoch(stale) == 0
+
+    # Damaged host: no readable manifest at all.
+    empty = manifest_mod.Manifest(str(tmp_path / "empty"))
+    assert cluster.latest_committed_epoch(empty) == -1
+
+    # The cluster agrees on the minimum committed view — the one every
+    # host can provably load; the damaged host adopts it.
+    views = [cluster.latest_committed_epoch(m)
+             for m in (fresh, stale, empty)]
+    assert cluster.consensus_epoch(views) == 0
+
+
+# ---------------------------------------------------------------------------
+# trip path (peer_lost row + exit-73 plumbing, injectable on_trip)
+# ---------------------------------------------------------------------------
+
+def _domain(tmp_path, **kw):
+    reg = MetricsRegistry()
+    jsonl = JsonlLogger(str(tmp_path / "events.jsonl"))
+    base = dict(
+        lease_dir=str(tmp_path / "cluster"), process_index=0,
+        num_processes=2, collective_timeout_s=10.0,
+        stalled_after_s=2.0, dead_after_s=10.0, lease_interval_s=0.1,
+        registry=reg, jsonl=jsonl,
+        bundle_dir=str(tmp_path / "crash_bundle"),
+        prom_path=str(tmp_path / "metrics.prom"))
+    base.update(kw)
+    return ClusterFaultDomain(**base), reg, jsonl
+
+
+def test_watchdog_trip_delegates_to_peer_lost(tmp_path):
+    trips = []
+    domain, reg, jsonl = _domain(tmp_path, on_trip=trips.append)
+    rec = flightrec.FlightRecorder(32)
+    flightrec.install(rec)
+    # Fresh own lease; peer 1's lease is 2 minutes stale — dead.
+    domain.heartbeat(force=True)
+    peer = cluster.lease_path(domain.lease.lease_dir, 1)
+    with open(peer, "w") as f:
+        f.write("{}")
+    past = time.time() - 120.0
+    os.utime(peer, (past, past))
+
+    b = ProgressBeacon()
+    b.stamp("collective", detail="any_process_true_each")
+    wd = Watchdog(b, {"collective": 10.0},
+                  bundle_dir=str(tmp_path / "wd_bundle"),
+                  registry=reg, jsonl=jsonl, cluster=domain)
+    info = wd.check(now=b.current()[1] + 12.0)
+    assert info is not None and info["phase"] == "collective"
+    assert domain.owns_trip(info)
+    wd.trip(info)
+
+    # The injected action ran INSTEAD of os._exit, with attribution.
+    assert len(trips) == 1
+    row = trips[0]
+    assert row["suspect_hosts"] == [1]
+    assert row["peer_verdicts"]["1"] == cluster.DEAD
+    # peer_lost row in events.jsonl + counter + registry flush.
+    events = read_jsonl(str(tmp_path / "events.jsonl"))
+    lost = [e for e in events if e["event"] == "peer_lost"]
+    assert len(lost) == 1 and lost[0]["suspect_hosts"] == [1]
+    assert lost[0]["peer_lease_age_seconds"]["1"] > 100.0
+    assert reg.counter(cluster.PEER_LOSSES_COUNTER).value == 1
+    metric_rows = [e for e in events if e["event"] == "metrics"]
+    assert metric_rows[-1]["metrics"]["cluster/peer_losses"] == 1
+    # No generic watchdog_trip row: the cluster path OWNED the trip.
+    assert not [e for e in events if e["event"] == "watchdog_trip"]
+    # Crash bundle written with the peer_lost reason + the flight ring
+    # carrying the peer_lost record.
+    crash = json.load(open(os.path.join(str(tmp_path / "crash_bundle"),
+                                        "crash.json")))
+    assert crash["reason"] == "peer_lost"
+    assert crash["suspect_hosts"] == [1]
+    assert any(e["kind"] == "peer_lost" for e in rec.events())
+    assert "peer_losses 1" in open(str(tmp_path / "metrics.prom")).read()
+
+
+def test_generic_collective_trip_below_cluster_budget_stays_hung(tmp_path):
+    """A tighter generic collective deadline tripping EARLIER than the
+    cluster budget is a plain hang (74-path forensics): no peer gets
+    blamed below the cluster's bar."""
+    domain, reg, jsonl = _domain(tmp_path, collective_timeout_s=100.0)
+    b = ProgressBeacon()
+    b.stamp("collective", detail="barrier:x")
+    wd_trips = []
+    wd = Watchdog(b, {"collective": 5.0},
+                  bundle_dir=str(tmp_path / "wd_bundle"),
+                  registry=reg, jsonl=jsonl, cluster=domain,
+                  on_trip=wd_trips.append)
+    info = wd.check(now=b.current()[1] + 6.0)
+    assert not domain.owns_trip(info)
+    # Ownership is decided by the BINDING deadline, not the observed
+    # age: poll overshoot can first observe a generic-deadline trip at
+    # an age past the cluster budget, and that must stay a hang.
+    late = dict(info, age_seconds=domain.collective_timeout_s + 5.0)
+    assert not domain.owns_trip(late)
+    assert domain.owns_trip(dict(late,
+                                 deadline_seconds=domain
+                                 .collective_timeout_s))
+    wd.trip(info)
+    assert wd_trips == [info]  # the ORDINARY watchdog action ran
+    events = read_jsonl(str(tmp_path / "events.jsonl"))
+    assert [e["event"] for e in events if e["event"] in
+            ("watchdog_trip", "peer_lost")] == ["watchdog_trip"]
+
+
+def test_second_trip_escalates_straight_to_exit(tmp_path):
+    """The ISSUE 9 bugfix pin: a second trip of the collective deadline
+    while the first is still draining (or the armed backstop firing)
+    must escalate straight to os._exit(EXIT_PEER_LOST) — no second
+    bundle, no second row, nothing that can wedge."""
+    exits = []
+    domain, reg, jsonl = _domain(tmp_path)
+    domain._exit = exits.append  # record instead of dying
+    info = {"phase": "collective", "detail": "gather_host_floats",
+            "age_seconds": 12.0, "deadline_seconds": 10.0,
+            "process_index": 0}
+    domain.trip_peer_lost(info)
+    # First trip: full drain, then the (injected) exit with 73.
+    assert exits == [resilience.EXIT_PEER_LOST]
+    events = read_jsonl(str(tmp_path / "events.jsonl"))
+    assert sum(e["event"] == "peer_lost" for e in events) == 1
+
+    domain.trip_peer_lost(info)  # the drain-window re-entry
+    assert exits == [resilience.EXIT_PEER_LOST] * 2
+    # Straight to exit: no second row, no second flush, counted.
+    events = read_jsonl(str(tmp_path / "events.jsonl"))
+    assert sum(e["event"] == "peer_lost" for e in events) == 1
+    assert reg.counter(cluster.ESCALATIONS_COUNTER).value == 1
+    domain.close()
+
+
+def test_backstop_timer_escalates_a_wedged_drain(tmp_path):
+    """The first trip arms a backstop timer sized to the collective
+    budget; if the drain wedges, the timer re-enters and takes the
+    escalation branch — the survivor can never hang forever."""
+    exits = []
+    domain, _, _ = _domain(tmp_path, collective_timeout_s=0.2,
+                           jsonl=None, bundle_dir=None, prom_path=None)
+    domain._exit = exits.append
+    domain.trip_peer_lost({"phase": "collective", "age_seconds": 1.0})
+    assert domain._backstop is not None
+    deadline = time.monotonic() + 5.0
+    while len(exits) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    # First exit: the trip's own (injected, returned); second: the
+    # backstop's escalation.
+    assert len(exits) >= 2
+    domain.close()
+
+
+def test_collective_error_converts_to_peer_lost(tmp_path):
+    """A transport error escaping a collective scope (a dead peer on a
+    transport that detects the closed connection) routes through the
+    SAME attributed abort, then re-raises for the injected-action
+    case."""
+    from howtotrainyourmamlpytorch_tpu.parallel import multihost
+    trips = []
+    domain, _, jsonl = _domain(tmp_path, on_trip=trips.append)
+    cluster.install(domain)
+    with pytest.raises(RuntimeError, match="connection reset"):
+        with multihost._collective("gather_host_floats"):
+            raise RuntimeError("connection reset by peer")
+    assert len(trips) == 1
+    assert trips[0]["detail"] == "gather_host_floats"
+    assert "connection reset" in trips[0]["error"]
+    events = read_jsonl(str(tmp_path / "events.jsonl"))
+    assert sum(e["event"] == "peer_lost" for e in events) == 1
+
+    # Single-process domains never claim an error (no peer to lose).
+    solo, _, _ = _domain(tmp_path / "solo", num_processes=1,
+                         on_trip=trips.append)
+    cluster.install(solo)
+    with pytest.raises(ValueError):
+        with multihost._collective("x"):
+            raise ValueError("not a transport error")
+    assert len(trips) == 1  # unchanged
+
+    # No domain installed: plain raise, no side effects (one None check).
+    cluster.install(None)
+    with pytest.raises(ValueError):
+        with multihost._collective("x"):
+            raise ValueError("boom")
+
+
+def test_unattributed_collective_error_propagates(tmp_path):
+    """When the (grace-re-read) leases exonerate every peer, an error
+    inside a collective is an APPLICATION failure: it must propagate as
+    itself — converting it to exit 73 would loop a deterministic bug
+    through infinite whole-job restarts. Counted, never silent."""
+    from howtotrainyourmamlpytorch_tpu.parallel import multihost
+    trips = []
+    # Tight collective budget keeps the grace re-read sub-second.
+    domain, reg, jsonl = _domain(tmp_path, on_trip=trips.append,
+                                 collective_timeout_s=1.0)
+    # BOTH hosts' leases fresh: nobody is dead or stalled.
+    domain.heartbeat(force=True)
+    with open(cluster.lease_path(domain.lease.lease_dir, 1), "w") as f:
+        f.write("{}")
+    cluster.install(domain)
+    with pytest.raises(RuntimeError, match="app bug"):
+        with multihost._collective("agree_int_from_main"):
+            raise RuntimeError("app bug, not a dead peer")
+    assert trips == []  # no peer-lost conversion
+    assert domain.tripped is None
+    # Nothing was logged at all: the lazily-created events.jsonl never
+    # came into existence because no peer_lost row was written.
+    assert not os.path.exists(tmp_path / "events.jsonl")
+    assert reg.counter(
+        "cluster/unattributed_collective_errors").value == 1
+
+
+# ---------------------------------------------------------------------------
+# wiring structure (the watchdog install-iff-enabled pattern)
+# ---------------------------------------------------------------------------
+
+def test_run_installs_cluster_iff_enabled(tmp_path, monkeypatch):
+    """Structural half of the acceptance pin: with every cluster knob at
+    its 0/off default a run installs NO fault domain (each hook site
+    stays a single None check); with the deadline set it installs the
+    domain + lease for the run's duration, arms the watchdog's
+    collective budget, and restores process state after. The training-
+    parity half is the slow bitwise test in test_pod_cluster.py."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    seen = {}
+
+    def probe(builder):
+        def stub():
+            seen["domain"] = cluster.get()
+            seen["builder_domain"] = builder._cluster
+            seen["watchdog"] = builder._watchdog
+            return {"paused_at_iter": builder.current_iter}
+        return stub
+
+    builder = ExperimentBuilder(_cfg(tmp_path / "off"))
+    monkeypatch.setattr(builder, "_run_experiment", probe(builder))
+    builder.run_experiment()
+    assert seen["domain"] is None and seen["builder_domain"] is None
+
+    builder = ExperimentBuilder(_cfg(tmp_path / "on",
+                                     cluster_collective_timeout_s=30.0))
+    monkeypatch.setattr(builder, "_run_experiment", probe(builder))
+    builder.run_experiment()
+    assert isinstance(seen["builder_domain"], ClusterFaultDomain)
+    assert seen["domain"] is seen["builder_domain"]
+    assert seen["watchdog"].cluster is seen["builder_domain"]
+    # The watchdog's collective budget was tightened to the cluster's.
+    assert seen["watchdog"].deadlines["collective"] == pytest.approx(30.0)
+    # The lease exists from t0 under <experiment>/cluster/.
+    lease = os.path.join(str(tmp_path / "on"), "smoke", "cluster",
+                         "host_0.lease")
+    assert os.path.isfile(lease)
+    # Scoped lifetime: restored after the run.
+    assert cluster.get() is None and builder._cluster is None
+
+    # Cluster deadline alone (all watchdog knobs 0) still arms the
+    # watchdog thread — it is what enforces the collective budget.
+    off = {f: 0.0 for f in (
+        "watchdog_step_timeout_s", "watchdog_feed_timeout_s",
+        "watchdog_collective_timeout_s", "watchdog_compile_timeout_s",
+        "watchdog_serve_timeout_s", "watchdog_ckpt_timeout_s")}
+    builder = ExperimentBuilder(_cfg(tmp_path / "armed",
+                                     cluster_collective_timeout_s=30.0,
+                                     **off))
+    monkeypatch.setattr(builder, "_run_experiment", probe(builder))
+    builder.run_experiment()
+    assert seen["watchdog"] is not None and seen["watchdog"].enabled
+    assert seen["watchdog"].deadlines["collective"] == pytest.approx(30.0)
+
+
+def test_require_mesh_makes_geometry_fallback_fatal(tmp_path):
+    """VERDICT weakness #6 pin: a pod profile must fail loudly when its
+    mesh cannot be realized, not silently train on one device."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    # 16 devices do not exist on this 8-device test mesh.
+    with pytest.raises(ValueError, match="require_mesh"):
+        ExperimentBuilder(_cfg(tmp_path / "strict", mesh_shape=(1, 16),
+                               require_mesh=1))
+    # Default keeps the documented warn-and-fallback behavior.
+    with pytest.warns(UserWarning, match="falling back"):
+        builder = ExperimentBuilder(_cfg(tmp_path / "lax",
+                                         mesh_shape=(1, 16)))
+    assert builder.cfg.mesh_shape == (1, 1)
+
+
+def test_cluster_run_end_to_end_heartbeats_and_report(tmp_path):
+    """One tiny real run with the fault domain armed (nothing trips):
+    heartbeat rows carry the per-host lease ages, the lease file is
+    maintained, and the telemetry report renders the v8 cluster section
+    with measured zeros."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    from howtotrainyourmamlpytorch_tpu.telemetry import summarize_events
+
+    builder = ExperimentBuilder(_cfg(
+        tmp_path, cluster_collective_timeout_s=300.0,
+        cluster_lease_interval_s=0.05, dispatch_sync_every=1))
+    result = builder.run_experiment()
+    assert "test_accuracy_mean" in result  # ran to completion
+    lease = os.path.join(str(tmp_path), "smoke", "cluster",
+                         "host_0.lease")
+    assert os.path.isfile(lease)
+    events = read_jsonl(os.path.join(builder.paths["logs"],
+                                     "events.jsonl"))
+    beats = [e for e in events if e.get("event") == "heartbeat"]
+    assert beats
+    for beat in beats:
+        ages = beat["peer_lease_age_seconds"]
+        assert set(ages) == {"0"} and ages["0"] < 60.0
+    cl = summarize_events(events)["cluster"]
+    assert cl["peer_losses"] == 0  # measured zero, not omitted
+    assert cl["max_peer_lease_age_seconds"] < 60.0
+    assert not [e for e in events if e.get("event") == "peer_lost"]

@@ -22,20 +22,21 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # fraction, peak memory, per-host skew; v2 adds the serving section,
 # v3 the resilience section, v4 the data-plane section, v5 the
 # watchdog section, v6 the optimization-health section, v7 the
-# checkpoint-lifecycle section).
+# checkpoint-lifecycle section, v8 the pod-fault-domain cluster
+# section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
-    "watchdog", "health", "checkpoint",
+    "watchdog", "health", "checkpoint", "cluster",
 }
 
 
 def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
                          with_resilience=False, with_data=False,
                          with_watchdog=False, with_health=False,
-                         with_checkpoint=False):
+                         with_checkpoint=False, with_cluster=False):
     """A synthetic 2-epoch run's event stream, as the experiment loop
     writes it (train_epoch + telemetry + heartbeat per epoch); with
     ``with_serving``, a trailing serve/ registry-flush row as
@@ -157,6 +158,27 @@ def write_fixture_events(path, *, with_failsoft=True, with_serving=False,
                                     "ckpt/gc_deletes": 2.0})
         log.log("metrics", metrics={"serve/hot_swaps": 2.0,
                                     "serve/hot_swap_rollbacks": 1.0})
+    if with_cluster:
+        # A pod fault domain run: heartbeats carry the per-host lease
+        # ages, the survivor's peer_lost row names the suspect, its
+        # registry flush carries the counter, and the restarted
+        # segment's consensus_resume row + reset-to-zero counter row
+        # must be absorbed reset-aware.
+        log.log("heartbeat", epoch=2, iter=30, process_index=0,
+                hosts=2, host_mean_step_seconds=[0.1, 0.1],
+                skew_frac=0.0, slowest_host=0,
+                peer_lease_age_seconds={"0": 0.4, "1": 7.5})
+        log.log("peer_lost", phase="collective",
+                detail="any_process_true_each", age_seconds=12.0,
+                deadline_seconds=10.0, process_index=0,
+                suspect_hosts=[1],
+                peer_verdicts={"0": "live", "1": "dead"},
+                peer_lease_age_seconds={"0": 0.6, "1": 13.0})
+        log.log("metrics", metrics={"cluster/peer_losses": 1.0})
+        # Restarted segment: fresh registry + consensus adoption.
+        log.log("consensus_resume", consensus_epoch=3, local_view=-1)
+        log.log("metrics", metrics={"cluster/peer_losses": 0.0,
+                                    "cluster/consensus_epoch": 3.0})
     return log.path
 
 
@@ -185,6 +207,7 @@ def test_summarize_events_fixture(tmp_path):
     assert s["watchdog"] == UNAVAILABLE
     assert s["health"] == UNAVAILABLE
     assert s["checkpoint"] == UNAVAILABLE
+    assert s["cluster"] == UNAVAILABLE
     # The table renders every row without raising.
     table = format_table(s)
     assert "feed stall fraction" in table and "0.1" in table
@@ -357,6 +380,44 @@ def test_summarize_events_checkpoint_section(tmp_path):
     # flush is a serve/* row, so the serving section renders too — a
     # hot-swapping process IS a serving process.)
     assert s["epochs"] == 2 and s["serving"] != UNAVAILABLE
+
+
+def test_summarize_events_cluster_section(tmp_path):
+    """peer_lost / consensus_resume rows + cluster/* metric rows (the
+    pod fault domain, resilience/cluster.py) render the v8 cluster
+    section: losses accumulate reset-aware across the killed survivor's
+    segment and the restart (cross-checked against explicit peer_lost
+    rows), the last suspect and the consensus epoch follow log order,
+    and the lease-age picture comes from the newest row carrying one."""
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    path = write_fixture_events(tmp_path / "events.jsonl",
+                                with_cluster=True)
+    s = summarize_events(read_jsonl(path))
+    assert set(s) == SCHEMA_KEYS
+    cl = s["cluster"]
+    assert cl["peer_losses"] == 1        # counter and row agree
+    assert cl["last_suspect_host"] == 1  # the peer_lost row named it
+    assert cl["consensus_epoch"] == 3    # the restart's adoption wins
+    # The peer_lost row's lease picture is newer than the heartbeat's.
+    assert cl["max_peer_lease_age_seconds"] == pytest.approx(13.0)
+    assert "cluster" in format_table(s)
+    # Training metrics untouched by the cluster rows.
+    assert s["epochs"] == 2 and s["watchdog"] == UNAVAILABLE
+
+
+def test_cluster_section_from_heartbeats_alone():
+    """Lease ages on ordinary heartbeat rows alone (a healthy armed run
+    that never tripped) render the section with zero losses — a
+    measured zero, not an omission."""
+    events = [{"event": "heartbeat", "epoch": 0, "iter": 5,
+               "peer_lease_age_seconds": {"0": 0.2, "1": 0.9}},
+              {"event": "metrics",
+               "metrics": {"cluster/peer_losses": 0.0}}]
+    cl = summarize_events(events)["cluster"]
+    assert cl["peer_losses"] == 0
+    assert cl["last_suspect_host"] == UNAVAILABLE
+    assert cl["consensus_epoch"] == UNAVAILABLE
+    assert cl["max_peer_lease_age_seconds"] == pytest.approx(0.9)
 
 
 def test_health_section_nonfinite_grad_norm_visible():
