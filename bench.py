@@ -39,11 +39,13 @@ Backend init is retried with bounded backoff (default up to 10 min,
 subprocess probes so a wedged/hung tunnel can be escaped) before
 failing — one transient tunnel outage must not zero a capture.
 Prints the headline JSON line {"metric", "value", "unit",
-"vs_baseline"} as soon as it is measured; for the flagship workload
-enriched lines follow (each a strict superset): the run-weighted
-whole-schedule throughput measured across every executable the config's
-epoch schedule visits, then the strict paper batch-8 operating point
-(`strict_b8_*` keys). The LAST JSON line is authoritative. With
+"vs_baseline"} as soon as it is measured; enriched lines follow (each a
+strict superset): the warm-start leg (`time_to_first_step_cold_s` /
+`_warm_s` — null on the headline line, measured right after it), then
+for the flagship workload the run-weighted whole-schedule throughput
+measured across every executable the config's epoch schedule visits,
+then the strict paper batch-8 operating point (`strict_b8_*` keys). The
+LAST JSON line is authoritative. With
 --config, any shipped workload is benched instead of the flagship (batch
 and mesh re-shaped to the local device count, everything else as
 shipped); "vs_baseline" is then null — the baseline estimate is for the
@@ -249,6 +251,30 @@ class Workload(NamedTuple):
 COMPILER_OPTIONS: dict = {}
 
 
+def parse_compiler_options(pairs) -> dict:
+    """Validate ``--compiler-option KEY=VAL`` pairs into a dict; raises
+    ValueError on malformed or repeated keys. Parses into a LOCAL dict
+    (ADVICE r5): the duplicate check must test THIS invocation's
+    options only — checking against the module-global COMPILER_OPTIONS
+    (which main() populates and never clears) falsely rejected options
+    on a second main() call in the same process."""
+    opts: dict = {}
+    for kv in pairs:
+        key, sep, val = kv.partition("=")
+        if not sep or not key or not val:
+            # Empty VAL rejected too (ADVICE r4): an empty string
+            # forwarded through PJRT compiler_options surfaces as a
+            # confusing server-side compile error far from the CLI.
+            raise ValueError(
+                f"--compiler-option needs KEY=VAL, got {kv!r}")
+        if key in opts:
+            raise ValueError(
+                f"--compiler-option {key!r} given twice; repeated keys "
+                f"would silently overwrite")
+        opts[key] = val
+    return opts
+
+
 def build_steady_state(cfg: MAMLConfig, devices,
                        registry: MetricsRegistry = None) -> Workload:
     """Build cfg's steady-state (last-epoch) train step: by definition an
@@ -305,26 +331,23 @@ def main() -> int:
                          "e.g. xla_tpu_scoped_vmem_limit_kib=65536). "
                          "Client-side XLA_FLAGS do NOT reach the "
                          "tunneled server compiler — this does.")
+    ap.add_argument("--no-warm-start", action="store_true",
+                    help="skip the AOT warm-start leg (the "
+                         "time_to_first_step_cold_s/_warm_s keys); it "
+                         "compiles the train step's undonated twin — "
+                         "one extra full compile per capture")
     ap.add_argument("--backend-timeout", type=float, default=600.0,
                     help="seconds to poll for JAX backend availability "
                          "before failing (tunnel outages are transient; "
                          "0 = no retry, fail on first init error)")
     args = ap.parse_args()
-    for kv in args.compiler_option:
-        key, sep, val = kv.partition("=")
-        if not sep or not key or not val:
-            # Empty VAL rejected too (ADVICE r4): an empty string
-            # forwarded through PJRT compiler_options surfaces as a
-            # confusing server-side compile error far from the CLI.
-            print(json.dumps({"error": f"--compiler-option needs "
-                              f"KEY=VAL, got {kv!r}"}))
-            return 1
-        if key in COMPILER_OPTIONS:
-            print(json.dumps({"error": f"--compiler-option {key!r} "
-                              f"given twice; repeated keys would "
-                              f"silently overwrite"}))
-            return 1
-        COMPILER_OPTIONS[key] = val
+    try:
+        parsed_options = parse_compiler_options(args.compiler_option)
+    except ValueError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+    COMPILER_OPTIONS.clear()
+    COMPILER_OPTIONS.update(parsed_options)
 
     devices = init_backend(args.backend_timeout)
     # Compile telemetry (docs/PERF.md § Observability): every AOT
@@ -421,6 +444,14 @@ def main() -> int:
             shutil.rmtree(ckpt_dir, ignore_errors=True)
     except Exception:  # noqa: BLE001 — observability key, never fatal
         pass
+    # Warm-start keys (parallel/aot.py): measured AFTER the headline
+    # print below — the leg costs a full extra compile of the headline
+    # program, and the headline must already be on stdout if a kill
+    # lands mid-compile (the same discipline as the run-weighted legs).
+    # Null at first print; the enriched lines that follow carry the
+    # measured values, and the authoritative LAST line is a strict
+    # superset of everything measured before any hiccup.
+    time_to_first_step_cold_s = time_to_first_step_warm_s = None
     # The baseline estimate is for the FLAGSHIP workload (either batch
     # variant); a ratio against it means nothing for other configs.
     is_flagship = cfg.experiment_name.startswith(
@@ -468,6 +499,16 @@ def main() -> int:
         # stall (fail-soft null on error, measured above).
         "ckpt_save_seconds": ckpt_save_seconds,
         "ckpt_blocking_frac": ckpt_blocking_frac,
+        # Warm-start keys (parallel/aot.py): first-step latency paying
+        # the full trace+lower+compile (cold) vs an AOT-store
+        # deserialize (warm) of the SAME headline executable — the
+        # restart cost the prewarm pipeline erases. Null HERE by design:
+        # the leg costs an extra compile and runs after the headline
+        # print (kill-resilience); the later enriched lines — and the
+        # authoritative LAST line — carry the measured values. Fail-soft
+        # null where executable serialization is unavailable.
+        "time_to_first_step_cold_s": time_to_first_step_cold_s,
+        "time_to_first_step_warm_s": time_to_first_step_warm_s,
     }
     if cfg.health_metrics_every_n_steps > 0:
         # The headline executable ALREADY computes the diagnostics
@@ -513,12 +554,100 @@ def main() -> int:
         # A failed HLO walk degrades to the loop-flat XLA count — the
         # very under-count r5 fixed — so it must be visible, not silent.
         out["flops_parse_error"] = fl["parse_error"]
+    # Trip-count tripwire (ADVICE r5 / VERDICT Next #6): every detected
+    # loop bound must be one of the config's known scan extents — the K
+    # inner steps (train/eval, and the unroll quotient), the microbatch
+    # accumulation count — or the heuristic misread a constant and the
+    # flops/mfu keys above are silently wrong. Warnings ride the
+    # artifact; they never zero a capture.
+    from howtotrainyourmamlpytorch_tpu.utils.hlo_flops import (
+        parse_trip_overrides, verify_trip_counts)
+    k_train = cfg.number_of_training_steps_per_iter
+    expected_trips = {k_train,
+                      cfg.number_of_evaluation_steps_per_iter,
+                      cfg.effective_task_microbatches(n_dev)}
+    if cfg.inner_unroll > 1 and k_train % cfg.inner_unroll == 0:
+        expected_trips.add(k_train // cfg.inner_unroll)
+    try:
+        overridden = parse_trip_overrides(
+            os.environ.get("PERF_CEILING_TRIPS", ""))
+    except ValueError:
+        overridden = {}  # counter init already surfaced the parse error
+    trip_warnings = verify_trip_counts(fl.get("trip_counts") or {},
+                                       expected_trips,
+                                       overridden=overridden)
+    if trip_warnings:
+        out["flops_trip_warnings"] = trip_warnings
     # Print the headline IMMEDIATELY: the run-weighted legs below cost
     # up to two more executable compiles, and if anything (or anyone)
     # kills the process mid-compile the artifact must already hold the
     # headline. The enriched line printed afterwards is a strict
     # superset; the LAST JSON line on stdout is authoritative.
     print(json.dumps({**out, "workload": cfg.experiment_name}), flush=True)
+    # Warm-start leg (parallel/aot.py, docs/PERF.md § Cold start & warm
+    # restarts): time-to-first-step cold vs warm through a REAL AOT
+    # store round trip. The store holds the UNDONATED twin of the train
+    # step (parallel/mesh.py § MeshPlan — deserialized donating
+    # executables are unsafe on this jaxlib), so the cold leg pays the
+    # twin's own trace+lower+compile — exactly what a cold run with the
+    # store enabled pays — and the warm leg deserializes it back. One
+    # extra compile per capture; --no-warm-start skips it. Fail-soft
+    # null: a backend without executable serialization must not zero
+    # the capture.
+    if not args.no_warm_start:
+        try:
+            import shutil
+            import tempfile
+            from howtotrainyourmamlpytorch_tpu.parallel import (
+                aot as aot_mod)
+            aot_dir = tempfile.mkdtemp(prefix="bench_aot_")
+            try:
+                store = aot_mod.AOTStore(
+                    aot_dir, aot_mod.store_fingerprint(cfg, mesh),
+                    doc=aot_mod.fingerprint_doc(cfg, mesh))
+                bench_key = (cfg.use_second_order(wl.bench_epoch),
+                             cfg.use_msl(wl.bench_epoch))
+                twin = plan.aot_train_steps[bench_key]
+
+                def one_step_seconds(step_fn) -> float:
+                    st = jax.device_put(
+                        init_train_state(cfg, init,
+                                         jax.random.PRNGKey(0)),
+                        replicated_sharding(mesh))
+                    t0 = time.perf_counter()
+                    _, m = step_fn(st, batch_ep, epoch)
+                    float(jax.device_get(m.loss))
+                    return time.perf_counter() - t0
+
+                # Avals, not the live state: the timed loop above
+                # DONATED wl.state's buffers.
+                savals = aot_mod.state_avals(wl.state, mesh)
+                bavals = aot_mod.episode_aval(cfg, mesh, cfg.batch_size)
+                t0 = time.perf_counter()
+                twin_compiled = timed_compile(
+                    twin.lower(savals, bavals, aot_mod.epoch_aval()),
+                    registry=registry,
+                    compiler_options=COMPILER_OPTIONS or None)
+                build_s = time.perf_counter() - t0
+                time_to_first_step_cold_s = round(
+                    build_s + one_step_seconds(twin_compiled), 6)
+                if not store.save("bench_train", twin_compiled):
+                    raise RuntimeError(
+                        "executable serialization unavailable")
+                t0 = time.perf_counter()
+                loaded = store.load("bench_train")
+                load_seconds = time.perf_counter() - t0
+                if loaded is not None:
+                    time_to_first_step_warm_s = round(
+                        load_seconds + one_step_seconds(loaded), 6)
+            finally:
+                shutil.rmtree(aot_dir, ignore_errors=True)
+        except Exception:  # noqa: BLE001 — observability keys, never
+            pass           # fatal
+        out["time_to_first_step_cold_s"] = time_to_first_step_cold_s
+        out["time_to_first_step_warm_s"] = time_to_first_step_warm_s
+        out["workload"] = cfg.experiment_name
+        print(json.dumps(out), flush=True)
     # Run-weighted throughput over the config's REAL schedule (VERDICT
     # r2 weak #5: pin the whole-run number in the BENCH artifact, not
     # just PERF.md prose). Epochs group into distinct executables by

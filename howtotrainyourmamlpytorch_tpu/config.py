@@ -222,6 +222,18 @@ class MAMLConfig:
     # TPU compiles cost tens of seconds; with a cache dir, restarts and
     # preemption-resumes reload compiled executables instead. None = off.
     compilation_cache_dir: Optional[str] = None
+    # Warm-start AOT executable store (parallel/aot.py, docs/PERF.md §
+    # Cold start & warm restarts): directory holding serialized compiled
+    # executables keyed by a fingerprint of (config resolution, jax/XLA
+    # versions, device kind, mesh topology, sharding/donation layout).
+    # With it set, run_experiment (and ServingEngine.warmup) load every
+    # phase/eval/serve executable from the store — a cache-warm restart
+    # reaches its first train dispatch with ZERO XLA compiles — and
+    # misses compile-then-populate it. scripts/aot_prewarm.py fills the
+    # store before job launch. Unlike compilation_cache_dir this skips
+    # Python tracing/lowering too, and loads are integrity-checked with
+    # counted fail-soft JIT fallback. None = off.
+    aot_store_dir: Optional[str] = None
     # TensorBoard scalar logging (beyond-reference observability; the
     # reference logs CSVs only, which we also keep). Events are written
     # under <experiment>/logs/tensorboard/ when enabled.

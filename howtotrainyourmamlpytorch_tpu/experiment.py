@@ -39,6 +39,7 @@ from howtotrainyourmamlpytorch_tpu.meta.outer import (
     MetaTrainState, init_train_state, migrate_lslr_rows,
     reconcile_loaded_shapes, state_leaf_shapes)
 from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel import aot
 from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
     make_mesh, make_sharded_steps, replicate_state)
 from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
@@ -197,6 +198,18 @@ class ExperimentBuilder:
         # under the separate, much larger compile deadline.
         self._stamped_compiles: set = set()
         self._eval_compile_stamped = False
+        # Warm-start subsystem (parallel/aot.py, docs/PERF.md § Cold
+        # start & warm restarts): when cfg.aot_store_dir is set,
+        # run_experiment swaps the plan's lazily-jitted executables for
+        # store-backed ones (_adopt_aot_plan) — a warm restart then
+        # reaches its first train dispatch with ZERO XLA compiles. The
+        # first dispatch of every session stamps time_to_first_step and
+        # the compile count into one "warm_start" row either way.
+        self._aot_store = None
+        self._aot_stats: Optional[Dict[str, Any]] = None
+        self._warmup_thread: Optional[threading.Thread] = None
+        self._run_started_at: Optional[float] = None
+        self._first_dispatch_done = False
         # Divergence guard (resilience/guard.py): observes the outer-loss
         # scalar at dispatch-sync points; a trigger rewinds to the
         # last-good epoch checkpoint (_perform_rewind). The grad-norm
@@ -399,7 +412,51 @@ class ExperimentBuilder:
         harmlessly on the device. Multi-host: synchronous, because a
         warmup step racing the training steps would dispatch collectives
         in different orders on different processes.
+
+        Armed-AOT branch: the store's deferred phase keys
+        (_adopt_aot_plan) are compiled via ``aot.load_or_compile`` on
+        the thread — AOT-compile + store-populate + in-place swap of
+        ``plan.train_steps`` (dict mutation, atomic under the GIL; no
+        throwaway step or state copy needed, the compiled executable's
+        first real call is not a trace). ``_join_phase_warmup`` waits
+        for it before a normal exit so a cold run still populates the
+        whole store (the prewarm-for-every-restart contract).
         """
+        if self._aot_store is not None:
+            deferred = (self._aot_stats or {}).get("deferred") or []
+            if not deferred:
+                return
+            store, plan = self._aot_store, self.plan
+            registry = self.registry
+
+            def warm_aot() -> None:
+                for key, name, avals in deferred:
+                    t0 = time.time()
+                    # count_load=False: this key's miss was already
+                    # counted at adoption time — re-probing here (a
+                    # co-writer may have populated it meanwhile) must
+                    # not inflate aot/hits|misses a second time.
+                    fn, _ = aot.load_or_compile(
+                        store, name, plan.aot_train_steps[key], avals,
+                        registry=registry,
+                        fallback=plan.train_steps[key],
+                        count_load=False)
+                    # Swap the live dict in place: the boundary dispatch
+                    # reads self.plan.train_steps[key] — either the lazy
+                    # undonated jit fn (thread not done yet: identical
+                    # program, lazily compiled) or this executable.
+                    self.plan.train_steps[key] = fn
+                    if self.is_main_process:
+                        print(f"[warmup] AOT phase (second_order="
+                              f"{key[0]}, msl={key[1]}) ready in "
+                              f"{time.time() - t0:.1f}s", flush=True)
+
+            # Deferral is only chosen single-process (_adopt_aot_plan):
+            # no multihost branch here.
+            self._warmup_thread = threading.Thread(
+                target=warm_aot, daemon=True, name="phase-warmup")
+            self._warmup_thread.start()
+            return
         later = self._phase_order()[1:]
         if not later:
             return
@@ -439,8 +496,30 @@ class ExperimentBuilder:
         if self._multihost:
             warm()
         else:
-            threading.Thread(target=warm, daemon=True,
-                             name="phase-warmup").start()
+            self._warmup_thread = threading.Thread(
+                target=warm, daemon=True, name="phase-warmup")
+            self._warmup_thread.start()
+
+    def _join_phase_warmup(self) -> None:
+        """Wait for the phase-warmup thread before a NORMAL run exit:
+        with an armed AOT store the thread is still populating the
+        store with the deferred phase executables, and 'a cold run is
+        the next restart's prewarm' only holds if they land. Preempt
+        paths never call this — a drain must not wait on a compile."""
+        t = self._warmup_thread
+        if t is None:
+            return
+        if t.is_alive():
+            with watchdog.phase("compile", detail="warmup_join"):
+                # Poll, don't block: a SIGTERM landing DURING this join
+                # only sets _preempted — a bare join() would pin the
+                # drain behind a possibly-minutes-long deferred compile
+                # until the scheduler's grace window SIGKILLs us. On
+                # preempt the daemon thread is abandoned (dies with the
+                # process; the store's startup sweep clears its tmp).
+                while t.is_alive() and not self._preempted:
+                    t.join(timeout=1.0)
+        self._warmup_thread = None
 
     def _train_epoch(self):
         """Train to the next epoch boundary (a resumed run mid-epoch does
@@ -494,6 +573,12 @@ class ExperimentBuilder:
                 else:
                     self.state, metrics = step_fn(self.state, batch,
                                                   jnp.float32(epoch))
+                if not self._first_dispatch_done:
+                    # Session's first train dispatch is now in flight
+                    # (the JIT path's first call blocked on its compile
+                    # above, so the compile count here includes it).
+                    self._first_dispatch_done = True
+                    self._note_first_dispatch()
                 # The per-epoch accumulator feeds only the scalar stats;
                 # the health dict is consumed at the sync points below —
                 # retaining every iteration's copy would pin its device
@@ -876,6 +961,8 @@ class ExperimentBuilder:
             # the counter.
             self.registry.counter(watchdog.TRIPS_COUNTER)
         try:
+            self._run_started_at = time.time()
+            self._adopt_aot_plan()
             result = self._run_experiment()
             if (self._flightrec is not None and isinstance(result, dict)
                     and "preempted_at_iter" in result):
@@ -936,6 +1023,87 @@ class ExperimentBuilder:
                 # sweep driver may build many ExperimentBuilders).
                 self._tb.close()
 
+    def _adopt_aot_plan(self) -> None:
+        """Warm-start adoption (parallel/aot.py): replace the plan's
+        lazily-jitted executables — every train phase key the remaining
+        schedule visits, plus the eval step — with store-backed ones.
+        Hits deserialize in milliseconds with zero XLA compiles; misses
+        compile HERE, under the compile watchdog deadline and the
+        installed CompileWatcher, and populate the store so every
+        restart after this run is warm. Fail-soft throughout: any store
+        problem leaves the ordinary JIT path in place, counted."""
+        if not aot.enabled(self.cfg):
+            return
+        # Eager registration (the resilience-counter rule): an armed
+        # warm-start run must report "0 misses" — and "0 quarantined",
+        # "0 demotions" — not omit the counters.
+        for name in (aot.HITS, aot.MISSES, aot.LOAD_SECONDS,
+                     aot.SAVE_SECONDS, aot.COMPILE_SECONDS, aot.ERRORS,
+                     aot.QUARANTINED, aot.GC_DELETES,
+                     aot.EXEC_FALLBACKS):
+            self.registry.counter(name)
+        # Eval-only runs (the test protocol) never train: adopting the
+        # eval executable alone avoids compiling train steps for a run
+        # that will not dispatch them.
+        phase_keys = ([] if self.cfg.evaluate_on_test_set_only
+                      else self._phase_order())
+        # Later phase keys defer their cold-miss compiles to the phase
+        # warmup thread (_start_phase_warmup's AOT branch): a cold
+        # start's time-to-first-step pays ONE train compile + eval, not
+        # the whole schedule's, and the thread still populates the
+        # store before the run ends (_join_phase_warmup). Deferral does
+        # NOT depend on precompile_phases: that knob opts out of the
+        # legacy throwaway-step warmup, while the AOT branch is pure
+        # background compilation (no extra step, no state copy) and an
+        # armed store's cold-run-is-the-prewarm contract needs it.
+        # Multihost stays fully synchronous — same rationale as the
+        # step-warmup thread: uniform dispatch across processes.
+        defer = phase_keys[1:] if not self._multihost else ()
+        with watchdog.phase("compile", detail="aot_adopt"):
+            self._aot_store = aot.AOTStore.from_config(
+                self.cfg, self.mesh, registry=self.registry,
+                writer=self.is_main_process)
+            self.plan, self._aot_stats = aot.adopt_train_plan(
+                self.cfg, self.plan, self.mesh, self._aot_store,
+                self.state, phase_keys, registry=self.registry,
+                defer=defer)
+        n_def = len(self._aot_stats["deferred"])
+        if self.is_main_process:
+            print(f"warm start: {self._aot_stats['hits']} executable(s) "
+                  f"loaded from the AOT store, "
+                  f"{self._aot_stats['misses'] - n_def} compiled"
+                  + (f", {n_def} deferred to the warmup thread" if n_def
+                     else "")
+                  + f" (store {self._aot_stats['store_dir']})",
+                  flush=True)
+
+    def _note_first_dispatch(self) -> None:
+        """One row per session, right after the first train step call
+        returns: how long from run start to the first dispatched step,
+        and how many XLA compiles it took to get there — the warm-start
+        acceptance numbers (0 compiles on a cache-warm restart)."""
+        watch = self._compile_watch
+        compiles = (watch.count if watch is not None and watch.installed
+                    else None)
+        ttfs = (round(time.time() - self._run_started_at, 3)
+                if self._run_started_at is not None else None)
+        if ttfs is not None:
+            self.registry.gauge(
+                "warm_start/time_to_first_step_seconds").set(ttfs)
+        if compiles is not None:
+            self.registry.gauge(
+                "warm_start/compiles_before_first_step").set(compiles)
+        row: Dict[str, Any] = {
+            "iter": self.current_iter,
+            "time_to_first_step_seconds": ttfs,
+            "compiles_before_first_step": compiles,
+        }
+        if self._aot_stats is not None:
+            row.update(aot_hits=self._aot_stats["hits"],
+                       aot_misses=self._aot_stats["misses"],
+                       aot_fingerprint=self._aot_stats["fingerprint"][:16])
+        self.jsonl.log("warm_start", **row)
+
     def _run_experiment(self) -> Dict[str, Any]:
         cfg = self.cfg
         if cfg.evaluate_on_test_set_only:
@@ -943,7 +1111,16 @@ class ExperimentBuilder:
 
         total_iters = cfg.total_epochs * cfg.total_iter_per_epoch
         epochs_this_session = 0
-        if cfg.precompile_phases and self.current_iter < total_iters:
+        # With an adopted AOT plan every NON-deferred phase executable
+        # is already compiled (or loaded) — the warmup thread then only
+        # runs in its AOT branch, compiling the deferred keys into the
+        # store off the critical path (none deferred: no thread at
+        # all). The AOT branch runs regardless of precompile_phases:
+        # that knob gates only the legacy throwaway-step warmup.
+        start_warmup = (bool(self._aot_stats.get("deferred"))
+                        if self._aot_stats is not None
+                        else cfg.precompile_phases)
+        if start_warmup and self.current_iter < total_iters:
             self._start_phase_warmup()
         # Eagerly register the resilience counters so every per-epoch
         # metrics row (and the final Prometheus snapshot) carries them —
@@ -998,6 +1175,18 @@ class ExperimentBuilder:
                     # host exiting while others start the next epoch would
                     # hang their first psum.
                     self._preempted = any_process_true(self._preempted)
+            # Normal (non-preempt) exits wait for the deferred AOT
+            # phase compiles to land in the store — the
+            # cold-run-is-the-prewarm contract. Preempt returns above
+            # skip this: a drain must not block on a compile (the
+            # daemon thread just dies). Store off: the legacy warmup
+            # thread's compiles persist nothing — nothing to wait for.
+            # Still INSIDE the try: the join's preempt escape (its
+            # _preempted poll) only works while our signal handler is
+            # installed, i.e. before the finally below restores the
+            # previous handlers.
+            if not self._preempted and self._aot_store is not None:
+                self._join_phase_warmup()
         finally:
             for sig, prev in prev_handlers:
                 signal.signal(sig, prev)

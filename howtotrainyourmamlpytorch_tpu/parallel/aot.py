@@ -1,0 +1,760 @@
+"""Ahead-of-time executable store: serialize compiled XLA programs so a
+restart never recompiles them.
+
+Why this subsystem exists (docs/PERF.md § Cold start & warm restarts):
+the MAML++ second-order K-step inner loop lowers to some of the largest
+XLA programs per parameter around — cold pod compiles are documented at
+~30 minutes — and the pod fault domain (resilience/cluster.py)
+deliberately restarts the WHOLE job on exits 73/74/75. Every peer loss,
+hang or preemption therefore re-pays trace+lower+compile before the
+first recovered step. The persistent ``jax_compilation_cache_dir`` only
+caches the backend-compile half (full Python tracing/lowering is still
+paid, and the cache is not even written on some backends —
+``test_compilation_cache_dir_populated`` xfail); this store caches the
+finished executable: ``jax.experimental.serialize_executable`` bytes on
+disk, keyed by a fingerprint of everything that determines the program,
+loaded back with ZERO tracing and ZERO compilation.
+
+Layout (one directory per fingerprint, manifest idioms from
+ckpt/manifest.py — atomic commit, pending→committed, GC of wreckage):
+
+    <aot_store_dir>/<fingerprint[:16]>/
+        STORE.json          # full fingerprint + the doc it hashes
+        MANIFEST.json       # per-executable {file, bytes, crc, status}
+        train_so1_msl0.aotx # pickle((serialized, in_tree, out_tree))
+        eval.aotx
+        serve_adapt_s25q15.aotx ...
+
+Failure discipline: loads validate the store fingerprint, the manifest
+record and a whole-file CRC32, then deserialize — ANY failure (foreign
+fingerprint, truncated file, bit flip, unpicklable payload, unwritable
+directory) is a counted miss that falls back to the ordinary JIT path;
+nothing in this module is ever fatal to training or serving. Corrupt
+payloads are quarantined (``*.corrupt``) so the next run recompiles
+instead of re-tripping. Saves commit through the manifest (begin →
+tmp+fsync+rename → commit), so a kill mid-save leaves a pending record
+GC sweeps, never a half-file a load could trust.
+
+Telemetry: ``aot/hits``, ``aot/misses``, ``aot/load_seconds``,
+``aot/save_seconds``, ``aot/errors``, ``aot/quarantined``,
+``aot/gc_deletes`` — flushed with the run's registry like every other
+subsystem; scripts/telemetry_report.py renders them as the "warm_start"
+section (schema v9) together with the experiment loop's
+``time_to_first_step_seconds`` gauge.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import pickle
+import shutil
+import time
+import warnings
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from howtotrainyourmamlpytorch_tpu.ckpt.manifest import (
+    Manifest, atomic_write_json, file_crc32)
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
+from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+    MeshPlan, batch_sharding, replicated_sharding)
+
+log = logging.getLogger(__name__)
+
+STORE_FILE = "STORE.json"
+STORE_SCHEMA = "maml_aot_store_v1"
+# Bumped whenever the sharding layout of the compiled steps changes
+# (parallel/mesh.py in_shardings, serve/adapt.py ditto): the
+# fingerprint must not hit an executable whose calling contract the
+# caller no longer honors. Stored executables are the UNDONATED twins
+# (MeshPlan.aot_train_steps / ServeSteps.aot_*): executing a
+# DESERIALIZED donating executable corrupts the heap on jaxlib
+# 0.4.37's CPU runtime (donation aliasing does not survive
+# serialize_executable round trips safely — layout-dependent
+# `corrupted double-linked list` aborts, isolated live in ISSUE 10),
+# so nothing in this store ever aliases its inputs.
+LAYOUT_TAG = ("nodonate;train:repl,batch,scalar->repl,repl;"
+              "eval:repl,batch->repl;"
+              "serve:repl*3,batch*3->repl|repl,batch*3->repl")
+# Fingerprint directories kept by the writer's GC (newest by mtime): one
+# live + a few predecessors so an in-flight rollback to the previous
+# jax/config still warm-starts. Every AOTStore construction touches its
+# own dir's mtime, so on a SHARED root (several configs prewarmed into
+# one store) "newest" means "most recently opened" and an active
+# config's store is never the eviction victim; the age floor below
+# additionally protects recently-touched dirs outright.
+GC_KEEP_FINGERPRINTS = 4
+# Never GC a fingerprint dir younger than this, regardless of count: a
+# fleet of distinct configs sharing one root must not evict each
+# other's freshly-prewarmed stores.
+GC_MIN_AGE_S = 14 * 24 * 3600.0
+# A *.tmp.<pid> younger than this survives the startup sweep even when
+# the pid probe is inconclusive (another HOST's writer on shared
+# storage): generous against multi-second big-executable writes, tiny
+# against the wreckage the sweep exists to clear.
+SWEEP_TMP_GRACE_S = 30 * 60.0
+
+HITS = "aot/hits"
+MISSES = "aot/misses"
+LOAD_SECONDS = "aot/load_seconds"
+SAVE_SECONDS = "aot/save_seconds"
+COMPILE_SECONDS = "aot/compile_seconds"
+ERRORS = "aot/errors"
+QUARANTINED = "aot/quarantined"
+GC_DELETES = "aot/gc_deletes"
+EXEC_FALLBACKS = "aot/exec_fallbacks"
+
+# Config fields that change NO compiled program: paths/identity, resume
+# policy, host-side cadences, resilience/watchdog/cluster deadlines,
+# checkpoint-lifecycle policy, serve queue/cache policy. The asymmetry
+# is deliberate: wrongly INCLUDING a runtime knob only costs a spurious
+# recompile on the next tweak; wrongly EXCLUDING a structural one (a
+# learning rate is baked into the program as constants) would silently
+# run the WRONG executable — so when in doubt a field stays in the hash.
+_RUNTIME_ONLY_KEYS = frozenset({
+    "experiment_name", "experiment_root", "dataset_path",
+    "dataset_pack_path", "dataset_name", "download_datasets",
+    "load_into_memory", "labels_as_int", "sets_are_pre_split",
+    "train_val_test_split", "indexes_of_folders_indicating_class",
+    "continue_from_epoch", "total_epochs_before_pause",
+    "evaluate_on_test_set_only", "max_models_to_save", "fault_spec",
+    "divergence_patience", "divergence_spike_factor",
+    "divergence_max_rewinds", "watchdog_step_timeout_s",
+    "watchdog_feed_timeout_s", "watchdog_collective_timeout_s",
+    "watchdog_compile_timeout_s", "watchdog_serve_timeout_s",
+    "watchdog_ckpt_timeout_s", "watchdog_poll_interval_s",
+    "flight_recorder_events", "require_mesh",
+    "cluster_collective_timeout_s", "cluster_lease_interval_s",
+    "cluster_peer_stalled_s", "cluster_peer_dead_s",
+    "ckpt_async", "ckpt_queue_policy", "ckpt_publish",
+    "serve_registry_poll_s", "serve_canary_episodes",
+    "serve_canary_acc_drop", "serve_canary_latency_factor",
+    "serve_max_queue_depth", "serve_default_deadline_ms",
+    "serve_cache_capacity", "health_grad_norm_warn_factor",
+    "dispatch_sync_every", "live_progress", "use_tensorboard",
+    "profile_dir", "profile_epoch", "profile_num_steps",
+    "compilation_cache_dir", "aot_store_dir", "prefetch_batches",
+    "cache_eval_episodes", "precompile_phases", "ignored_keys",
+})
+
+
+def enabled(cfg: MAMLConfig) -> bool:
+    return bool(cfg.aot_store_dir)
+
+
+def fingerprint_doc(cfg: MAMLConfig, mesh) -> Dict[str, Any]:
+    """Everything that determines the compiled programs, as one JSON
+    doc: the structural config resolution, jax/jaxlib + XLA backend
+    versions, device kind, pod/mesh topology and the donation/sharding
+    layout tag. Hashed by :func:`store_fingerprint`; recorded verbatim
+    in STORE.json so a mismatch is diagnosable, not just detected."""
+    import jaxlib
+
+    devices = list(mesh.devices.flat)
+    try:
+        backend = jax.devices()[0].client
+        backend_version = str(getattr(backend, "platform_version", ""))
+    except Exception:  # noqa: BLE001 — fingerprinting must not raise
+        backend_version = ""
+    return {
+        "schema": STORE_SCHEMA,
+        "config": {k: v for k, v in sorted(cfg.to_dict().items())
+                   if k not in _RUNTIME_ONLY_KEYS},
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": devices[0].platform,
+        "backend_version": backend_version,
+        "device_kind": devices[0].device_kind,
+        "num_devices": len(devices),
+        "process_count": jax.process_count(),
+        "mesh_shape": list(mesh.devices.shape),
+        "mesh_axes": list(mesh.axis_names),
+        "layout": LAYOUT_TAG,
+    }
+
+
+def store_fingerprint(cfg: MAMLConfig, mesh) -> str:
+    doc = fingerprint_doc(cfg, mesh)
+    blob = json.dumps(doc, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# aval construction — ONE place builds the abstract signatures every
+# consumer (experiment adoption, prewarm CLI, serve engine) lowers with,
+# so an aval drift between the prewarmer and the trainer is impossible.
+
+def state_avals(state, mesh):
+    """Replicated ShapeDtypeStruct tree mirroring a (host or device)
+    train-state pytree."""
+    repl = replicated_sharding(mesh)
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            np.shape(x), getattr(x, "dtype", None) or np.asarray(x).dtype,
+            sharding=repl),
+        state)
+
+
+def episode_aval(cfg: MAMLConfig, mesh, batch_size: int) -> Episode:
+    """The task-sharded Episode signature the loader ships (wire dtype
+    from ``transfer_images_uint8``, labels int32)."""
+    bsh = batch_sharding(mesh)
+    h, w, c = cfg.image_shape
+    img = np.uint8 if cfg.transfer_images_uint8 else np.float32
+
+    def a(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=bsh)
+
+    return Episode(
+        support_x=a((batch_size, cfg.num_support_per_task, h, w, c), img),
+        support_y=a((batch_size, cfg.num_support_per_task), np.int32),
+        target_x=a((batch_size, cfg.num_target_per_task, h, w, c), img),
+        target_y=a((batch_size, cfg.num_target_per_task), np.int32))
+
+
+def epoch_aval() -> jax.ShapeDtypeStruct:
+    # The loop passes jnp.float32(epoch) — a weak_type=False f32 scalar.
+    return jax.ShapeDtypeStruct((), np.float32)
+
+
+def serve_adapt_avals(cfg: MAMLConfig, mesh, params, lslr, bn_state,
+                      support_rows: int) -> Tuple:
+    """The serve adapt executable's signature for one support extent —
+    the SAME aval-construction discipline as above: the prewarmer
+    (scripts/aot_prewarm.py) and the engine (serve/engine.py) both
+    call THIS, so the store can never hold a same-named executable
+    with a signature the engine no longer dispatches (which would
+    demote every 'hit' via GuardedExec and silently lose the warm
+    start). ``params``/``lslr``/``bn_state`` are the caller's state
+    aval trees (state_avals output or its components)."""
+    bsh = batch_sharding(mesh)
+    b = cfg.serve_batch_tasks
+    h, w, c = cfg.image_shape
+    wire = np.uint8 if cfg.transfer_images_uint8 else np.float32
+
+    def a(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=bsh)
+
+    return (params, lslr, bn_state,
+            a((b, support_rows, h, w, c), wire),
+            a((b, support_rows), np.int32),
+            a((b, support_rows), np.float32))
+
+
+def serve_predict_avals(cfg: MAMLConfig, mesh, adapt_fn, adapt_avals,
+                        params, query_rows: int) -> Tuple:
+    """The predict executable's signature for one query extent. The
+    adapted-state avals come from ``eval_shape`` of the adapt signature
+    itself, so the two executables cannot drift apart."""
+    bsh = batch_sharding(mesh)
+    b = cfg.serve_batch_tasks
+    h, w, c = cfg.image_shape
+    wire = np.uint8 if cfg.transfer_images_uint8 else np.float32
+
+    def a(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=bsh)
+
+    adapted = jax.eval_shape(adapt_fn, *adapt_avals)
+    stack = jax.tree.map(lambda s: a(s.shape, s.dtype), adapted)
+    return (params, stack.fast, stack.bn_state,
+            a((b, query_rows, h, w, c), wire))
+
+
+def train_exec_name(phase_key: Tuple[bool, bool]) -> str:
+    so, msl = phase_key
+    return f"train_so{int(so)}_msl{int(msl)}"
+
+
+def serve_adapt_name(support_rows: int) -> str:
+    # The adapt executable's signature depends only on the bucket's
+    # support extent; two buckets sharing it share the executable.
+    return f"serve_adapt_s{support_rows}"
+
+
+def serve_predict_name(query_rows: int) -> str:
+    return f"serve_predict_q{query_rows}"
+
+
+# ---------------------------------------------------------------------------
+
+
+class AOTStore:
+    """One fingerprint's executable directory. Never raises from
+    ``load``/``save``: every failure is counted and degrades to the JIT
+    path (docstring discipline above)."""
+
+    def __init__(self, root: str, fingerprint: str,
+                 doc: Optional[Dict[str, Any]] = None,
+                 registry=None, writer: bool = True):
+        self.root = root
+        self.fingerprint = fingerprint
+        self.registry = registry
+        self.dir = os.path.join(root, fingerprint[:16])
+        # writer=False is the multi-host non-main (and read-only
+        # consumer) mode: loads only, saves are silent no-ops — only a
+        # REQUESTED writer that cannot write counts errors.
+        self._writer_requested = writer
+        self.writable = False
+        self.readable = False
+        self.hits = 0
+        self.misses = 0
+        try:
+            if writer:
+                os.makedirs(self.dir, exist_ok=True)
+                self.writable = os.access(self.dir, os.W_OK)
+            self.manifest = Manifest(self.dir)
+            store_doc = self._read_store_file()
+            if store_doc is None:
+                if self.writable:
+                    atomic_write_json(
+                        os.path.join(self.dir, STORE_FILE),
+                        {"schema": STORE_SCHEMA,
+                         "fingerprint": fingerprint,
+                         "doc": doc or {}})
+                    self.readable = True
+                # No STORE.json and not writable: an empty unreadable
+                # dir — every load is a miss, every save an error.
+            elif store_doc.get("fingerprint") == fingerprint:
+                self.readable = True
+            else:
+                # Foreign bytes under our key (hand-copied dir, hash
+                # collision): never load from it, never write into it.
+                self._count(ERRORS)
+                warnings.warn(
+                    f"AOT store dir {self.dir} records fingerprint "
+                    f"{str(store_doc.get('fingerprint'))[:16]}… but this "
+                    f"run computes {fingerprint[:16]}…; ignoring the "
+                    f"store (JIT fallback)")
+                self.writable = False
+            if writer and self.writable:
+                # Freshness stamp for the shared-root GC: "newest by
+                # mtime" must mean most recently OPENED.
+                try:
+                    os.utime(self.dir)
+                except OSError:
+                    pass
+                self._sweep()
+                self._gc_fingerprints()
+        except Exception as e:  # noqa: BLE001 — a broken store mount
+            # must cost misses, never the run.
+            self._count(ERRORS)
+            log.warning("AOT store unavailable at %s (%s: %s)",
+                        self.dir, type(e).__name__, e)
+            # Manifest.__init__ is itself fail-soft (an unreadable
+            # file leaves records={} / loaded=False), so a real empty
+            # instance serves as the inert placeholder.
+            self.manifest = Manifest(self.dir)
+            self.writable = False
+            self.readable = False
+
+    @classmethod
+    def from_config(cls, cfg: MAMLConfig, mesh, registry=None,
+                    writer: bool = True) -> Optional["AOTStore"]:
+        """The wiring entry point: None when the subsystem is off."""
+        if not enabled(cfg):
+            return None
+        return cls(cfg.aot_store_dir, store_fingerprint(cfg, mesh),
+                   doc=fingerprint_doc(cfg, mesh), registry=registry,
+                   writer=writer)
+
+    # -- internals -------------------------------------------------------
+    def _count(self, name: str, value: float = 1) -> None:
+        if self.registry is not None:
+            try:
+                self.registry.counter(name).inc(value)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _read_store_file(self) -> Optional[Dict[str, Any]]:
+        try:
+            with open(os.path.join(self.dir, STORE_FILE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _sweep(self) -> None:
+        """Startup GC (the ckpt/manifest sweep rules): tmp leftovers and
+        pending records from a killed save are wreckage, not data.
+        EXCEPT a live co-writer's in-flight tmp: several processes
+        legally write one store (trainer, serving engine, prewarmer —
+        the module docstring's multi-writer contract), and a big
+        executable's tmp write takes seconds — unlinking it here would
+        make the other writer's os.replace fail and lose the save. A
+        tmp survives the sweep while the pid embedded in its name is
+        alive on this host, or while it is younger than the grace
+        window (the cross-host shared-storage case, where a local pid
+        probe means nothing)."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        now = time.time()
+        removed = 0
+        for name in names:
+            if name.endswith(".tmp") or ".tmp." in name:
+                path = os.path.join(self.dir, name)
+                if self._tmp_in_flight(name, path, now):
+                    continue
+                try:
+                    os.remove(path)
+                    removed += 1
+                except OSError:
+                    pass
+            elif name.endswith(".corrupt"):
+                # Quarantined payloads are full serialized executables
+                # (potentially hundreds of MB) that nothing else ever
+                # reclaims — age them out once their forensic window
+                # passes (recent ones stay for diagnosis; the
+                # quarantine event itself was already counted+logged).
+                path = os.path.join(self.dir, name)
+                try:
+                    if now - os.path.getmtime(path) > GC_MIN_AGE_S:
+                        os.remove(path)
+                        removed += 1
+                except OSError:
+                    pass
+        stale = [r["tag"] for r in self.manifest.pending()]
+        if stale:
+            # A live co-writer's pending record may be among these —
+            # tolerated: its commit synthesizes a fresh record (save()),
+            # so the cost is bookkeeping churn, never a lost file.
+            self.manifest.remove_many(stale)
+            removed += len(stale)
+        if removed:
+            self._count(GC_DELETES, removed)
+
+    @staticmethod
+    def _tmp_in_flight(name: str, path: str, now: float) -> bool:
+        """True when a *.tmp.<pid> belongs to a save that may still be
+        running: the embedded pid is alive on this host, or the file is
+        too young to condemn from here (another host's writer)."""
+        pid_part = name.rsplit(".", 1)[-1]
+        if pid_part.isdigit():
+            try:
+                os.kill(int(pid_part), 0)
+                return True
+            except ProcessLookupError:
+                pass
+            except (OSError, OverflowError):
+                # EPERM: the pid exists but is not ours — alive.
+                return True
+        try:
+            return now - os.path.getmtime(path) < SWEEP_TMP_GRACE_S
+        except OSError:
+            return False
+
+    def _gc_fingerprints(self) -> None:
+        """Drop the oldest fingerprint directories beyond the retention
+        budget — a store outlives jax upgrades and config tunings; the
+        stale programs are pure disk waste. Guarded two ways for shared
+        roots: opening a store touches its dir mtime (so "oldest" means
+        least-recently-OPENED, not least-recently-written), and nothing
+        younger than GC_MIN_AGE_S is ever deleted — another config's
+        just-prewarmed store can't be this run's eviction victim."""
+        try:
+            entries = []
+            for name in os.listdir(self.root):
+                path = os.path.join(self.root, name)
+                if (os.path.isdir(path)
+                        and os.path.isfile(os.path.join(path, STORE_FILE))):
+                    entries.append((os.path.getmtime(path), path))
+        except OSError:
+            return
+        entries.sort(reverse=True)
+        me = os.path.abspath(self.dir)
+        now = time.time()
+        keep, dropped = 0, 0
+        for mtime, path in entries:
+            if os.path.abspath(path) == me:
+                continue
+            if now - mtime <= GC_MIN_AGE_S:
+                # Age floor: never a victim, and it doesn't consume a
+                # retention slot either — a shared-root neighbor must
+                # not shrink this config's predecessor budget.
+                continue
+            keep += 1
+            if keep >= GC_KEEP_FINGERPRINTS:
+                shutil.rmtree(path, ignore_errors=True)
+                dropped += 1
+        if dropped:
+            self._count(GC_DELETES, dropped)
+
+    def _refresh_manifest(self) -> None:
+        """Re-read MANIFEST.json from disk. Several processes may
+        legally write one store (a training run, a serving engine, a
+        prewarmer — each owns different executable names), and each
+        manifest rewrite is a whole-file snapshot: starting a
+        transition (or retrying a lookup) from a stale in-memory view
+        would drop the other writer's committed records from the next
+        rewrite. A residual simultaneous-rewrite race remains; its cost
+        is one lost record = one counted recompile later, never a bad
+        load (every load re-validates bytes+CRC)."""
+        try:
+            fresh = Manifest(self.dir)
+        except Exception:  # noqa: BLE001 — keep the current view
+            return
+        if fresh.loaded:
+            self.manifest = fresh
+
+    def _quarantine(self, name: str, path: str) -> None:
+        self._count(QUARANTINED)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass
+        try:
+            self.manifest.remove(name)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- the store contract ----------------------------------------------
+    def load(self, name: str, count: bool = True) -> Optional[Callable]:
+        """Deserialize executable ``name``, or None (counted miss).
+
+        Validation ladder before any deserialize: store fingerprint
+        (constructor), committed manifest record, byte count, whole-file
+        CRC32 — a truncated or bit-flipped payload is quarantined and
+        recompiled, never half-loaded. ``count=False`` keeps hit/miss
+        counters untouched (a RE-probe of a name whose outcome was
+        already counted — the deferred-adoption warmup thread; error
+        and quarantine events still count, they are new information)."""
+        t0 = time.perf_counter()
+
+        def _miss() -> None:
+            if count:
+                self.misses += 1
+                self._count(MISSES)
+
+        try:
+            if not self.readable:
+                _miss()
+                return None
+            rec = self.manifest.get(name)
+            if rec is None or rec.get("status") != "committed":
+                # Another writer (the trainer, a prewarmer) may have
+                # committed this name since our snapshot: re-read once.
+                self._refresh_manifest()
+                rec = self.manifest.get(name)
+            if rec is None or rec.get("status") != "committed":
+                _miss()
+                return None
+            path = os.path.join(self.dir, rec["file"])
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                _miss()
+                return None
+            if size != int(rec.get("bytes") or 0) \
+                    or file_crc32(path) != int(rec.get("crc") or 0):
+                self._quarantine(name, path)
+                _miss()
+                return None
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load)
+            with open(path, "rb") as f:
+                serialized, in_tree, out_tree = pickle.load(f)
+            loaded = deserialize_and_load(serialized, in_tree, out_tree)
+            if count:
+                self.hits += 1
+                self._count(HITS)
+            return loaded
+        except Exception as e:  # noqa: BLE001 — unpicklable payload,
+            # PJRT refusing the binary (different runtime build): a
+            # counted miss, with the file quarantined so the next run
+            # recompiles instead of re-tripping.
+            log.warning("AOT load of %r failed (%s: %s); JIT fallback",
+                        name, type(e).__name__, e)
+            try:
+                rec = self.manifest.get(name)
+                if rec is not None:
+                    self._quarantine(
+                        name, os.path.join(self.dir, rec["file"]))
+            except Exception:  # noqa: BLE001
+                pass
+            _miss()
+            self._count(ERRORS)
+            return None
+        finally:
+            self._count(LOAD_SECONDS, time.perf_counter() - t0)
+
+    def save(self, name: str, compiled) -> bool:
+        """Serialize ``compiled`` under ``name`` with manifest-framed
+        atomic commit. Returns False (counted) on any failure —
+        backends without executable serialization, unwritable mounts."""
+        if not self.writable:
+            if self._writer_requested:
+                self._count(ERRORS)
+            return False
+        t0 = time.perf_counter()
+        try:
+            from jax.experimental.serialize_executable import serialize
+            payload = pickle.dumps(serialize(compiled))
+            filename = f"{name}.aotx"
+            path = os.path.join(self.dir, filename)
+            # Start the transition from the freshest on-disk view so
+            # this rewrite carries every other writer's records.
+            self._refresh_manifest()
+            self.manifest.begin(name, filename=filename)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            # The payload write above can take seconds on a big
+            # executable — refresh again so the commit rewrite carries
+            # anything committed meanwhile. If a co-writer's startup
+            # sweep dropped our pending record during the write, reopen
+            # it WITH our filename before committing: commit's
+            # synthesized default record would point at a path we never
+            # wrote, stranding the saved file as a permanent miss.
+            self._refresh_manifest()
+            if self.manifest.get(name) is None:
+                self.manifest.begin(name, filename=filename, flush=False)
+            self.manifest.commit(name, nbytes=len(payload),
+                                 crc=zlib.crc32(payload))
+            self._count(SAVE_SECONDS, time.perf_counter() - t0)
+            return True
+        except Exception as e:  # noqa: BLE001
+            log.warning("AOT save of %r failed (%s: %s); the next run "
+                        "will recompile", name, type(e).__name__, e)
+            self._count(ERRORS)
+            return False
+
+
+class GuardedExec:
+    """A deserialized executable with a one-way JIT escape hatch.
+
+    A stored executable's input avals were fixed at prewarm time; if a
+    drifted caller feeds it something it cannot accept (TypeError /
+    ValueError raised BEFORE execution — donation untouched), the first
+    failure permanently demotes this slot to the ordinary jit function
+    (counted + warned once). Steady state after demotion is one
+    attribute check per call."""
+
+    def __init__(self, compiled, jit_fn, name: str, registry=None):
+        self._compiled = compiled
+        self._jit = jit_fn
+        self._name = name
+        self._registry = registry
+
+    def __call__(self, *args):
+        if self._compiled is None:
+            return self._jit(*args)
+        try:
+            return self._compiled(*args)
+        except (TypeError, ValueError) as e:
+            self._compiled = None
+            if self._registry is not None:
+                try:
+                    self._registry.counter(EXEC_FALLBACKS).inc()
+                except Exception:  # noqa: BLE001
+                    pass
+            warnings.warn(
+                f"AOT executable {self._name!r} rejected its arguments "
+                f"({type(e).__name__}: {e}); demoted to the JIT path "
+                f"for the rest of this run")
+            return self._jit(*args)
+
+
+def load_or_compile(store: Optional[AOTStore], name: str, jit_fn,
+                    avals: Tuple, registry=None, save: bool = True,
+                    fallback: Optional[Callable] = None,
+                    compile_on_miss: bool = True,
+                    count_load: bool = True
+                    ) -> Tuple[Callable, bool]:
+    """THE adoption primitive: a store hit returns the deserialized
+    executable; a miss lowers+compiles ``jit_fn`` at ``avals`` (the one
+    compile a cold run pays anyway, just moved ahead of the loop) and
+    populates the store for the next process. Returns ``(callable,
+    hit)`` — the callable is guarded (GuardedExec), the flag feeds the
+    warm_start telemetry. ``jit_fn`` must be an UNDONATED wrapper
+    (LAYOUT_TAG rationale); ``fallback`` (default ``jit_fn``) is what a
+    demoted GuardedExec calls — it must run the SAME undonated program
+    (with the store armed, make_sharded_steps already swaps the whole
+    plan to the undonated twins; a donating fallback would break the
+    store-cannot-change-numerics invariant on the demotion path).
+    ``store=None`` (subsystem off) returns ``fallback`` untouched. ``count_load=False`` makes the store probe silent for
+    hit/miss telemetry — the warmup thread re-resolving a deferred key
+    whose miss adopt_train_plan already counted."""
+    fallback = fallback if fallback is not None else jit_fn
+    if store is None:
+        return fallback, False
+    loaded = store.load(name, count=count_load)
+    if loaded is not None:
+        return GuardedExec(loaded, fallback, name, registry), True
+    if not compile_on_miss:
+        # Deferred-adoption mode (experiment.py's phase-warmup thread):
+        # the caller compiles this one off the critical path later.
+        return fallback, False
+    t0 = time.perf_counter()
+    try:
+        compiled = jit_fn.lower(*avals).compile()
+    except Exception as e:  # noqa: BLE001 — an aval-construction bug
+        # must degrade to the lazy jit path, not kill the run.
+        store._count(ERRORS)
+        log.warning("AOT compile of %r failed (%s: %s); lazy JIT path",
+                    name, type(e).__name__, e)
+        return fallback, False
+    store._count(COMPILE_SECONDS, time.perf_counter() - t0)
+    if save:
+        store.save(name, compiled)
+    return GuardedExec(compiled, fallback, name, registry), False
+
+
+def adopt_train_plan(cfg: MAMLConfig, plan: MeshPlan, mesh, store: AOTStore,
+                     state, phase_keys: List[Tuple[bool, bool]],
+                     registry=None, defer=()) -> Tuple[MeshPlan,
+                                                       Dict[str, Any]]:
+    """Swap the MeshPlan's lazily-jitted executables for store-backed
+    ones: every train phase key the remaining schedule visits, plus the
+    eval step. Returns the new plan and a stats dict for the warm_start
+    row. Misses compile HERE (under the caller's compile watchdog
+    phase) and populate the store — a cold run is the prewarm for every
+    restart after it — EXCEPT keys in ``defer``: those are adopted on a
+    hit but on a miss stay on the lazy jit path and are listed in
+    ``stats["deferred"]`` as (key, name, avals) for the caller to
+    compile-and-populate off the critical path (experiment.py's phase
+    warmup thread), so a cold start's time-to-first-step pays only the
+    FIRST phase executable, not the whole schedule's."""
+    savals = state_avals(state, mesh)
+    train_batch = episode_aval(cfg, mesh, cfg.batch_size)
+    eval_batch = episode_aval(cfg, mesh, cfg.effective_eval_batch_size)
+    hits = misses = 0
+    deferred: List[Tuple[Tuple[bool, bool], str, Tuple]] = []
+    train_steps = dict(plan.train_steps)
+    for key in phase_keys:
+        # Lower the UNDONATED twin (LAYOUT_TAG rationale); the demotion
+        # fallback is plan.train_steps[key], which the armed store has
+        # already swapped to the same undonated program — every path
+        # (hit, demotion, lazy jit) computes identical numerics.
+        avals = (savals, train_batch, epoch_aval())
+        lazy = key in defer
+        fn, hit = load_or_compile(
+            store, train_exec_name(key), plan.aot_train_steps[key],
+            avals, registry=registry, fallback=plan.train_steps[key],
+            compile_on_miss=not lazy)
+        hits, misses = hits + hit, misses + (not hit)
+        if lazy and not hit:
+            # Numerics-safe on every path: an armed store already runs
+            # the UNDONATED programs everywhere (make_sharded_steps),
+            # so whether the boundary dispatch finds the lazy jit fn or
+            # the thread's compiled twin, it runs the same program.
+            deferred.append((key, train_exec_name(key), avals))
+        else:
+            train_steps[key] = fn
+    eval_fn, hit = load_or_compile(
+        store, "eval", plan.eval_step, (savals, eval_batch),
+        registry=registry)
+    hits, misses = hits + hit, misses + (not hit)
+    stats = {"hits": hits, "misses": misses, "deferred": deferred,
+             "fingerprint": store.fingerprint,
+             "store_dir": store.dir}
+    return plan._replace(train_steps=train_steps, eval_step=eval_fn), stats

@@ -132,6 +132,16 @@ class MeshPlan(NamedTuple):
     mesh: Mesh
     train_steps: Dict[Tuple[bool, bool], Callable]
     eval_step: Callable
+    # UNDONATED twins of train_steps, for the AOT executable store
+    # (parallel/aot.py) ONLY. Identical programs minus the input-output
+    # aliasing: executing a DESERIALIZED donating executable corrupts
+    # the heap on jaxlib 0.4.37's CPU runtime (the donation metadata
+    # does not survive serialize_executable round trips safely —
+    # layout-dependent `corrupted double-linked list` aborts, isolated
+    # in ISSUE 10), so serialized executables must not alias. Cost: the
+    # AOT path holds one extra transient state copy per step. These are
+    # lazy jit wrappers — zero cost unless the store lowers them.
+    aot_train_steps: Dict[Tuple[bool, bool], Callable]
 
 
 def make_sharded_steps(cfg: MAMLConfig, apply_fn,
@@ -206,6 +216,7 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
 
     train_step = make_train_step(cfg, apply_fn, reduce_axes=axes)
     train_steps = {}
+    aot_train_steps = {}
     for so in (False, True):
         for msl in (False, True):
             smapped = _shard_map(
@@ -223,6 +234,28 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
                 out_shardings=(repl, repl),
                 donate_argnums=(0,),
             )
+            # Undonated twin for the AOT store (MeshPlan docstring):
+            # same computation, no aliasing — safe to
+            # serialize/deserialize.
+            aot_train_steps[(so, msl)] = jax.jit(
+                smapped,
+                in_shardings=(repl, bsh, None),
+                out_shardings=(repl, repl),
+            )
+    if cfg.aot_store_dir:
+        # One numerics world when the store is armed: donation changes
+        # the code XLA emits (measured: last-ulp gradient differences
+        # on the second-order step, amplified by Adam's near-zero-
+        # variance denominators into real weight divergence — the
+        # telemetry/health.py § parity-constraint failure class), so an
+        # AOT-enabled run executes the UNDONATED programs everywhere —
+        # in-process jit path included. Store hits, misses, corrupt
+        # fallbacks and GuardedExec demotions then all run the
+        # identical program: the store can never change training
+        # results, only where the executable came from. Cost: one
+        # transient state-sized copy per step (small next to episode
+        # activations).
+        train_steps = dict(aot_train_steps)
 
     eval_step = jax.jit(
         _shard_map(
@@ -238,4 +271,5 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
         # result — required for multi-host, harmless single-host.
         out_shardings=repl,
     )
-    return MeshPlan(mesh=mesh, train_steps=train_steps, eval_step=eval_step)
+    return MeshPlan(mesh=mesh, train_steps=train_steps,
+                    eval_step=eval_step, aot_train_steps=aot_train_steps)

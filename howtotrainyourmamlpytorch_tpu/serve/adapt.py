@@ -95,6 +95,14 @@ class ServeSteps(NamedTuple):
     adapt: Callable[..., AdaptedTask]
     predict: Callable[..., jax.Array]
     mesh: Any
+    # Undonated twins for the AOT executable store (parallel/aot.py,
+    # rationale in parallel/mesh.py § MeshPlan): a deserialized
+    # donating executable is unsafe on jaxlib 0.4.37. On the default
+    # uint8 wire nothing donates and the twins are byte-identical
+    # programs; on the f32 wire they trade the donated request buffer
+    # for one transient copy. Lazy jit wrappers — free unless lowered.
+    aot_adapt: Callable[..., AdaptedTask]
+    aot_predict: Callable[..., jax.Array]
 
 
 def make_serve_steps(cfg: MAMLConfig, apply_fn, mesh) -> ServeSteps:
@@ -123,8 +131,11 @@ def make_serve_steps(cfg: MAMLConfig, apply_fn, mesh) -> ServeSteps:
     # realizes donation through input-output aliasing, and the uint8
     # wire's pixel buffers (and int32 labels) can never alias the f32
     # outputs — the donation would be rejected with a per-executable
-    # warning and zero benefit.
-    f32_wire = not cfg.transfer_images_uint8
+    # warning and zero benefit. With the AOT store armed, nothing
+    # donates (the one-numerics-world rule, parallel/mesh.py §
+    # make_sharded_steps — and serialized donating executables are
+    # unsafe on this jaxlib anyway).
+    f32_wire = not cfg.transfer_images_uint8 and not cfg.aot_store_dir
 
     def adapt_shard(params, lslr, bn_state, sx, sy, sw):
         def one(sx1, sy1, sw1):
@@ -134,15 +145,21 @@ def make_serve_steps(cfg: MAMLConfig, apply_fn, mesh) -> ServeSteps:
         out = jax.vmap(one)(sx, sy, sw)
         return jax.lax.all_gather(out, axis_name=axes, axis=0, tiled=True)
 
+    adapt_smapped = _shard_map(
+        adapt_shard, mesh=mesh,
+        in_specs=(P(), P(), P(), batch_spec, batch_spec, batch_spec),
+        out_specs=P(),
+        check_vma=False)
     adapt = jax.jit(
-        _shard_map(adapt_shard, mesh=mesh,
-                   in_specs=(P(), P(), P(), batch_spec, batch_spec,
-                             batch_spec),
-                   out_specs=P(),
-                   check_vma=False),
+        adapt_smapped,
         in_shardings=(repl, repl, repl, bsh, bsh, bsh),
         out_shardings=repl,
         donate_argnums=(3, 5) if f32_wire else (),
+    )
+    aot_adapt = jax.jit(
+        adapt_smapped,
+        in_shardings=(repl, repl, repl, bsh, bsh, bsh),
+        out_shardings=repl,
     )
 
     def predict_shard(params, fast_stack, bn_stack, qx):
@@ -159,13 +176,21 @@ def make_serve_steps(cfg: MAMLConfig, apply_fn, mesh) -> ServeSteps:
         return jax.lax.all_gather(logits, axis_name=axes, axis=0,
                                   tiled=True)
 
+    predict_smapped = _shard_map(
+        predict_shard, mesh=mesh,
+        in_specs=(P(), batch_spec, batch_spec, batch_spec),
+        out_specs=P(),
+        check_vma=False)
     predict = jax.jit(
-        _shard_map(predict_shard, mesh=mesh,
-                   in_specs=(P(), batch_spec, batch_spec, batch_spec),
-                   out_specs=P(),
-                   check_vma=False),
+        predict_smapped,
         in_shardings=(repl, bsh, bsh, bsh),
         out_shardings=repl,
         donate_argnums=(3,) if f32_wire else (),
     )
-    return ServeSteps(adapt=adapt, predict=predict, mesh=mesh)
+    aot_predict = jax.jit(
+        predict_smapped,
+        in_shardings=(repl, bsh, bsh, bsh),
+        out_shardings=repl,
+    )
+    return ServeSteps(adapt=adapt, predict=predict, mesh=mesh,
+                      aot_adapt=aot_adapt, aot_predict=aot_predict)

@@ -61,6 +61,7 @@ from howtotrainyourmamlpytorch_tpu.meta.outer import (
     MetaTrainState, init_train_state, migrate_lslr_rows,
     reconcile_loaded_shapes, state_leaf_shapes)
 from howtotrainyourmamlpytorch_tpu.models import make_model
+from howtotrainyourmamlpytorch_tpu.parallel import aot
 from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
     make_mesh, replicate_state)
 from howtotrainyourmamlpytorch_tpu.serve.adapt import (
@@ -133,6 +134,21 @@ class ServingEngine:
         self.cache = AdaptedParamsLRU(cfg.serve_cache_capacity)
         self.registry = registry if registry is not None else (
             MetricsRegistry())
+        # Warm-start store (parallel/aot.py): per-bucket adapt/predict
+        # executables load from disk instead of compiling — a restarted
+        # serving process (and the hot-swap canary, which shares these
+        # executables) warms up in seconds. None when the subsystem is
+        # off; every lookup below is then one falsy check. The
+        # fingerprint must hash the RESOLVED task_microbatches (the
+        # trainer and aot_prewarm both clamp before fingerprinting) or
+        # a clamped config lands in a different store dir and every
+        # prewarmed serve executable is a silent miss.
+        self._aot_store = aot.AOTStore.from_config(
+            cfg.replace(task_microbatches=cfg.effective_task_microbatches(
+                self.mesh.size)),
+            self.mesh, registry=self.registry)
+        self._aot_adapt: Dict[int, Any] = {}    # support rows -> exec
+        self._aot_predict: Dict[int, Any] = {}  # query rows -> exec
         # Serve-side storage retries / fault counters land in THIS
         # engine's registry while it is the live serving process
         # (restored on close(), mirroring the compile listener below).
@@ -268,10 +284,12 @@ class ServingEngine:
         dtype = (np.uint8 if self.cfg.transfer_images_uint8
                  else np.float32)
         for s_b, q_b in self.batcher.buckets:
-            # Each bucket's warmup pays an XLA compile: it runs under
-            # the separate (much larger) compile deadline, not the
-            # serve-request one.
+            # Each bucket's warmup pays an XLA compile — unless the AOT
+            # store has it, in which case the adoption below makes the
+            # calls pure executions: it runs under the separate (much
+            # larger) compile deadline, not the serve-request one.
             with watchdog.phase("compile", detail=f"serve{(s_b, q_b)}"):
+                self._adopt_serve_bucket((s_b, q_b))
                 req = FewShotRequest(
                     support_x=np.zeros((s_b, h, w, c), dtype),
                     support_y=np.zeros((s_b,), np.int32),
@@ -288,6 +306,47 @@ class ServingEngine:
                 entry = jax.tree.map(lambda x: x[0], adapted)
                 self._run_predict([entry], [req], (s_b, q_b),
                                   record=False)
+
+    def _adopt_serve_bucket(self, bucket: Tuple[int, int]) -> None:
+        """Warm-start one bucket's executables from the AOT store
+        (load-or-compile-and-populate; parallel/aot.py). The adapt
+        signature depends only on the support extent and predict only on
+        the query extent, so shared dims share executables. Fail-soft:
+        any problem leaves the jit functions in place."""
+        store = self._aot_store
+        if store is None:
+            return
+        s_b, q_b = bucket
+        try:
+            params = aot.state_avals(self.state.params, self.mesh)
+            lslr = aot.state_avals(self.state.lslr, self.mesh)
+            bn = aot.state_avals(self.state.bn_state, self.mesh)
+            # Signatures come from aot's shared builders (the prewarmer
+            # uses the SAME ones, so prewarmed names can never carry a
+            # stale signature the engine would demote on first call).
+            adapt_avals = aot.serve_adapt_avals(
+                self.cfg, self.mesh, params, lslr, bn, s_b)
+            if s_b not in self._aot_adapt:
+                self._aot_adapt[s_b], _ = aot.load_or_compile(
+                    store, aot.serve_adapt_name(s_b),
+                    self.steps.aot_adapt, adapt_avals,
+                    registry=self.registry, fallback=self.steps.adapt)
+            if q_b not in self._aot_predict:
+                self._aot_predict[q_b], _ = aot.load_or_compile(
+                    store, aot.serve_predict_name(q_b),
+                    self.steps.aot_predict,
+                    aot.serve_predict_avals(
+                        self.cfg, self.mesh, self.steps.adapt,
+                        adapt_avals, params, q_b),
+                    registry=self.registry,
+                    fallback=self.steps.predict)
+        except Exception as e:  # noqa: BLE001 — warm-start is an
+            # optimization; serving must come up regardless.
+            self.registry.counter(aot.ERRORS).inc()
+            import logging
+            logging.getLogger(__name__).warning(
+                "serve AOT adoption for bucket %s failed (%s: %s); "
+                "JIT fallback", bucket, type(e).__name__, e)
 
     def step(self, now: Optional[float] = None) -> List[FewShotResponse]:
         """Serve ONE batch: dequeue a same-bucket group, answer expired
@@ -403,8 +462,13 @@ class ServingEngine:
         ``state`` overrides the live state (the canary adapts under a
         CANDIDATE version without touching what serving uses)."""
         state = self.state if state is None else state
+        # Warm-start routing: the bucket's store-backed executable when
+        # adopted (same program bitwise — parallel/aot.py), else jit.
+        adapt_fn = (self._aot_adapt.get(batch["support_x"].shape[1],
+                                        self.steps.adapt)
+                    if self._aot_adapt else self.steps.adapt)
         t0 = time.perf_counter()
-        adapted = self.steps.adapt(
+        adapted = adapt_fn(
             state.params, state.lslr, state.bn_state,
             batch["support_x"], batch["support_y"], batch["support_w"])
         jax.block_until_ready(adapted.support_loss)
@@ -436,9 +500,10 @@ class ServingEngine:
             qx[i, :req.num_query] = req.query_x
         for i in range(len(group), b):
             qx[i] = qx[0]
+        predict_fn = (self._aot_predict.get(q_b, self.steps.predict)
+                      if self._aot_predict else self.steps.predict)
         t0 = time.perf_counter()
-        logits = self.steps.predict(state.params, fast_stack,
-                                    bn_stack, qx)
+        logits = predict_fn(state.params, fast_stack, bn_stack, qx)
         logits = np.asarray(jax.device_get(logits))
         if record:
             self.registry.histogram("serve/predict_seconds").observe(

@@ -21,8 +21,10 @@ from typing import Any, Dict, List, Optional, Union
 # v7: + "checkpoint" (ckpt/ lifecycle subsystem: async saves, GC,
 # serving hot-swap); v8: + "cluster" (pod fault domain,
 # resilience/cluster.py: peer losses, suspect attribution, consensus
-# resume, lease ages)
-SCHEMA = "maml_tpu_telemetry_report_v8"
+# resume, lease ages); v9: + "warm_start" (AOT executable store,
+# parallel/aot.py: hits/misses/load seconds + per-session
+# time-to-first-step and the compiles-before-first-dispatch count)
+SCHEMA = "maml_tpu_telemetry_report_v9"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -427,6 +429,50 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                                            else UNAVAILABLE),
         }
 
+    # Warm-start section (parallel/aot.py, schema v9): the AOT store's
+    # hit/miss/load counters ride registry "metrics" rows and accumulate
+    # reset-aware like the resilience section (one log spans several
+    # process lifetimes — exactly the restarts this subsystem exists
+    # for); the per-session "warm_start" event row carries
+    # time-to-first-step and the compile count at first dispatch — the
+    # LAST row wins, i.e. the most recent (re)start, which is the one a
+    # warm-start story is about. ``sessions`` counts the warm_start rows
+    # so a report reader can see how many (re)starts the log spans.
+    ws_totals: Dict[str, float] = {}
+    ws_prev: Dict[str, float] = {}
+    ws_seen = False
+    ws_rows = 0
+    ws_ttfs: Metric = UNAVAILABLE
+    ws_compiles: Metric = UNAVAILABLE
+    for e in events:
+        if e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if not any(k.startswith("aot/") for k in m):
+                continue
+            ws_seen = True
+            for key in ("aot/hits", "aot/misses", "aot/load_seconds"):
+                if m.get(key) is not None:
+                    _accumulate_counter(ws_totals, ws_prev, key,
+                                        float(m[key]))
+        elif e.get("event") == "warm_start":
+            ws_seen = True
+            ws_rows += 1
+            if e.get("time_to_first_step_seconds") is not None:
+                ws_ttfs = round(float(e["time_to_first_step_seconds"]), 3)
+            if e.get("compiles_before_first_step") is not None:
+                ws_compiles = int(e["compiles_before_first_step"])
+    warm_start_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if ws_seen:
+        warm_start_sec = {
+            "aot_hits": int(ws_totals.get("aot/hits", 0)),
+            "aot_misses": int(ws_totals.get("aot/misses", 0)),
+            "aot_load_seconds": round(
+                ws_totals.get("aot/load_seconds", 0.0), 3),
+            "time_to_first_step_seconds": ws_ttfs,
+            "compiles_before_first_step": ws_compiles,
+            "sessions": ws_rows,
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -462,6 +508,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "health": health_sec,
         "checkpoint": ckpt_sec,
         "cluster": cluster_sec,
+        "warm_start": warm_start_sec,
     }
 
 
@@ -495,6 +542,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("health", summary["health"]),
         ("checkpoint", summary["checkpoint"]),
         ("cluster", summary["cluster"]),
+        ("warm start", summary["warm_start"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

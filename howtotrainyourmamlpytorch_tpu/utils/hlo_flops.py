@@ -66,6 +66,48 @@ _FREE_OPS = {
 _NAME_RE = re.compile(r"%([\w.\-]+)")
 
 
+def parse_trip_overrides(env: str) -> dict[str, int]:
+    """``PERF_CEILING_TRIPS=name:count,...`` → {name: count}. Malformed
+    counts fail LOUDLY (bench.py's fail-soft wrapper surfaces the error
+    as a visible ``parse_error`` artifact key, never a silent flat
+    count)."""
+    overrides: dict[str, int] = {}
+    for part in env.split(","):
+        if ":" not in part:
+            continue
+        name, count = part.split(":", 1)
+        try:
+            overrides[name] = int(count)
+        except ValueError:
+            raise ValueError(
+                f"PERF_CEILING_TRIPS entry {part!r}: count {count!r} is "
+                f"not an integer") from None
+    return overrides
+
+
+def verify_trip_counts(trips: dict[str, int], expected: "set[int]",
+                       overridden=()) -> list[str]:
+    """Tripwire the detected loop trip counts against the config's known
+    values (K inner steps, eval steps, ``task_microbatches``, 1): the
+    extractor's largest-integer-constant heuristic can misread an
+    unrelated constant as a scan bound, silently inflating every
+    FLOPs/MFU number downstream. Returns one warning string per loop
+    whose detected count matches nothing known — for the artifact to
+    carry, not an exception (an exotic-but-correct loop must not zero a
+    capture). Loops named in ``overridden`` (PERF_CEILING_TRIPS) are
+    trusted as-is: the override IS this warning's documented remedy, so
+    it must be able to silence it even when the operator's true count
+    is no config extent."""
+    allowed = set(expected) | {1}
+    return [
+        f"loop {name!r}: detected trip count {count} matches no known "
+        f"config value {sorted(allowed)} — largest-constant heuristic "
+        f"may have misread the loop bound (override via "
+        f"PERF_CEILING_TRIPS={name}:<count>)"
+        for name, count in sorted(trips.items())
+        if count not in allowed and name not in overridden]
+
+
 def _shape_bytes(text: str, physical: bool) -> tuple[int, int]:
     """(bytes, flop-elements) summed over every array shape in `text`.
 
@@ -207,6 +249,28 @@ class HloFlopsCounter:
         self.comps = _split_computations(hlo)
         self.entry = self.comps["__entry__"][0]
         self.trip_counts: dict[str, int] = {}
+        # PERF_CEILING_TRIPS is parsed + validated ONCE here (ADVICE r5):
+        # a malformed count raises immediately, and an override naming no
+        # while-condition present in THIS module warns instead of being
+        # silently ignored — the operator typo'd the loop name and the
+        # heuristic count is still what gets reported.
+        self._trip_overrides = parse_trip_overrides(
+            os.environ.get("PERF_CEILING_TRIPS", ""))
+        if self._trip_overrides:
+            conds = set()
+            for lines in self.comps.values():
+                for line in (lines if isinstance(lines, list) else []):
+                    for m in re.finditer(r"condition=%?([\w.\-]+)",
+                                         str(line)):
+                        conds.add(m.group(1))
+            unknown = sorted(set(self._trip_overrides) - conds)
+            if unknown:
+                import warnings
+                warnings.warn(
+                    f"PERF_CEILING_TRIPS entries {unknown} name no loop "
+                    f"condition present in this HLO module (present: "
+                    f"{sorted(conds) or 'none'}); the overrides will "
+                    f"not apply", stacklevel=2)
         # name -> output shape text, per computation: optimized dumps
         # print operands WITHOUT shapes, so reads resolve through the
         # defining instruction (parameters appear as explicit
@@ -239,21 +303,9 @@ class HloFlopsCounter:
         for line in self.comps.get(cond_name, []):
             for m in re.finditer(r"constant\((\d+)\)", line):
                 best = max(best, int(m.group(1)))
-        env = os.environ.get("PERF_CEILING_TRIPS", "")
-        for part in env.split(","):
-            if ":" in part:
-                n, c = part.split(":", 1)
-                if n == cond_name:
-                    try:
-                        best = int(c)
-                    except ValueError:
-                        # Malformed override must fail LOUDLY and
-                        # identically in every consumer (bench.py's
-                        # fail-soft wrapper surfaces it as a visible
-                        # parse_error key, never a silent flat count).
-                        raise ValueError(
-                            f"PERF_CEILING_TRIPS entry {part!r}: count "
-                            f"{c!r} is not an integer") from None
+        # Overrides were parsed + validated at __init__ (malformed counts
+        # already raised there, typo'd names already warned).
+        best = self._trip_overrides.get(cond_name, best)
         self.trip_counts[cond_name] = best
         return best
 

@@ -42,18 +42,27 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # MAML++ paper test-accuracy table (BASELINE.md; arXiv:1810.09502), keyed
-# by (dataset family, way, shot). The gate is >= paper mean.
+# by (dataset family, way, shot) as (mean, published 95% CI half-width).
+# The PASS gate is >= mean - CI (ADVICE r5): the paper numbers carry a
+# ±CI, so an implementation at exact statistical parity lands above and
+# below the point estimate with roughly equal probability — gating on
+# the bare mean fails ~half of at-parity runs. The strict >=mean verdict
+# is still REPORTED (``strict_pass``), just not the exit-code gate.
+# Omniglot rows: BASELINE.md records no CI, so their margin is 0 (the
+# strict gate) rather than an invented one.
 PAPER_GATES = {
-    ("omniglot", 5, 1): 0.9947,
-    ("omniglot", 5, 5): 0.9993,
-    ("omniglot", 20, 1): 0.9765,
-    ("omniglot", 20, 5): 0.9933,
-    ("imagenet", 5, 1): 0.5215,
-    ("imagenet", 5, 5): 0.6832,
+    ("omniglot", 5, 1): (0.9947, 0.0),
+    ("omniglot", 5, 5): (0.9993, 0.0),
+    ("omniglot", 20, 1): (0.9765, 0.0),
+    ("omniglot", 20, 5): (0.9933, 0.0),
+    ("imagenet", 5, 1): (0.5215, 0.0026),
+    ("imagenet", 5, 5): (0.6832, 0.0044),
 }
 
 
-def paper_gate(cfg) -> float | None:
+def paper_gate(cfg) -> "tuple[float, float] | None":
+    """(paper mean, published CI half-width) for the config's row, or
+    None when the paper has no row."""
     # "imagenet" here means MINI-ImageNet only: tiered-ImageNet (the pod
     # config) has no row in the MAML++ paper table and must demand an
     # explicit --min-accuracy instead of borrowing mini's gate.
@@ -109,14 +118,22 @@ def main(argv=None) -> int:
             f"(docs/E2E.md synthetic runs are protocol evidence, not "
             f"paper numbers)", config=args.config)
 
-    threshold = (args.min_accuracy if args.min_accuracy is not None
-                 else paper_gate(cfg))
-    if threshold is None:
-        return fail(
-            f"no BASELINE.md paper row for {cfg.dataset_name!r} "
-            f"{cfg.num_classes_per_set}-way "
-            f"{cfg.num_samples_per_class}-shot; pass --min-accuracy",
-            config=args.config)
+    paper_mean = paper_ci = None
+    if args.min_accuracy is not None:
+        # Explicit override: an absolute threshold, no CI margin.
+        threshold, margin = args.min_accuracy, 0.0
+    else:
+        row = paper_gate(cfg)
+        if row is None:
+            return fail(
+                f"no BASELINE.md paper row for {cfg.dataset_name!r} "
+                f"{cfg.num_classes_per_set}-way "
+                f"{cfg.num_samples_per_class}-shot; pass --min-accuracy",
+                config=args.config)
+        paper_mean, paper_ci = row
+        # Gate at mean - CI (ADVICE r5): deterministic for an at-parity
+        # run; the margin is recorded in the verdict below.
+        threshold, margin = paper_mean - paper_ci, paper_ci
 
     platform = os.environ.get("MAML_JAX_PLATFORM")
     if platform:
@@ -159,10 +176,19 @@ def main(argv=None) -> int:
         "test_accuracy_std": round(result["test_accuracy_std"], 4),
         "num_models": result["num_models"],
         "num_episodes": result["num_episodes"],
-        "threshold": threshold,
+        "threshold": round(threshold, 6),
         "threshold_source": ("--min-accuracy" if args.min_accuracy
                              is not None else
-                             "BASELINE.md MAML++ paper table"),
+                             "BASELINE.md MAML++ paper table, mean - CI"),
+        # The margin the gate granted (the paper's published CI
+        # half-width; 0 for --min-accuracy and CI-less rows), plus the
+        # strict >=mean verdict as a REPORTED field — the exit code
+        # gates on mean - CI, the report still shows both.
+        "paper_mean": paper_mean,
+        "paper_ci": paper_ci,
+        "margin": margin,
+        "strict_pass": (bool(acc >= paper_mean)
+                        if paper_mean is not None else None),
         "pass": bool(acc >= threshold),
     }
     print(json.dumps(verdict), flush=True)

@@ -1,0 +1,197 @@
+"""Pre-launch executable prewarmer: fill the AOT store before the job.
+
+Schedulers run this ONCE per (config, topology, jax version) before
+launching — or relaunching — a job:
+
+    python scripts/aot_prewarm.py \
+        --config experiment_config/mini-imagenet_maml++_5-way_5-shot_DA_b12.json \
+        [--store /shared/aot] [--serve] [--key value ...]
+
+It lowers and compiles every executable the run will need — one train
+step per (derivative-order, MSL) phase boundary the epoch schedule
+visits, the eval step, and (``--serve``) each serve bucket's
+adapt/predict pair — and serializes them into the store
+(``parallel/aot.py``) keyed by the run's fingerprint. A job started
+afterwards with the same config and ``aot_store_dir`` reaches its first
+train dispatch with ZERO XLA compiles; the fault-domain restart path
+(exits 73/74/75 → full job restart) reuses the same store, so every
+restart is warm too. Re-running against a warm store is cheap and
+idempotent (every executable loads, nothing compiles) — safe to put in
+front of every launch unconditionally.
+
+State is never materialized (avals only, ``jax.eval_shape``), so the
+prewarmer runs fine on a machine that could not fit the training run —
+what must match is the config and the device topology the fingerprint
+records.
+
+Artifact contract (bench.py discipline): the LAST stdout JSON line is
+``{"metric": "aot_prewarm", ...}`` with per-executable dispositions;
+exit 0 iff every requested executable is in the store afterwards.
+
+Trailing ``--key value`` pairs are config overrides with the trainer
+CLI's exact coercion rules (train_maml_system.get_args).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compile + serialize every executable a run needs "
+                    "into its AOT store (parallel/aot.py)")
+    ap.add_argument("--config", required=True,
+                    help="experiment_config/*.json to prewarm for")
+    ap.add_argument("--store", default=None, metavar="DIR",
+                    help="AOT store directory (default: the config's "
+                         "aot_store_dir; required via one of the two)")
+    ap.add_argument("--serve", action="store_true",
+                    help="also prewarm the serve buckets' adapt/predict "
+                         "executables (ServingEngine.warmup's set)")
+    ap.add_argument("--backend-timeout", type=float, default=600.0,
+                    help="seconds to poll for JAX backend availability "
+                         "(0 = fail on first init error)")
+    try:
+        args, overrides = ap.parse_known_args(argv)
+    except SystemExit:
+        print(json.dumps({"metric": "aot_prewarm", "ok": False,
+                          "error": "invalid command line"}))
+        return 1
+
+    from train_maml_system import get_args
+    try:
+        cfg = get_args(["--name_of_args_json_file", args.config]
+                       + overrides)
+    except (SystemExit, OSError, ValueError) as e:
+        print(json.dumps({"metric": "aot_prewarm", "ok": False,
+                          "error": f"invalid config/override: {e}"}))
+        return 1
+    if args.store:
+        cfg = cfg.replace(aot_store_dir=args.store)
+    if not cfg.aot_store_dir:
+        print(json.dumps({"metric": "aot_prewarm", "ok": False,
+                          "error": "no store: set --store or the "
+                                   "config's aot_store_dir"}))
+        return 1
+
+    from howtotrainyourmamlpytorch_tpu.utils.backend import init_backend
+    devices = init_backend(args.backend_timeout)
+
+    import jax
+    import numpy as np
+
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.parallel import aot
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+        make_mesh, make_sharded_steps)
+    from howtotrainyourmamlpytorch_tpu.serve.adapt import make_serve_steps
+
+    n_mesh = int(np.prod(cfg.mesh_shape))
+    if n_mesh > len(devices):
+        print(json.dumps({"metric": "aot_prewarm", "ok": False,
+                          "error": f"mesh_shape {cfg.mesh_shape} needs "
+                                   f"{n_mesh} devices, got "
+                                   f"{len(devices)} — prewarm must run "
+                                   f"on the job's topology (the "
+                                   f"fingerprint records it)"}))
+        return 1
+    cfg = cfg.replace(
+        task_microbatches=cfg.effective_task_microbatches(n_mesh))
+    mesh = make_mesh(cfg, devices[:n_mesh])
+    model_init, apply_fn = make_model(cfg)
+    plan = make_sharded_steps(cfg, apply_fn, mesh)
+    store = aot.AOTStore.from_config(cfg, mesh)
+
+    # Avals only — the prewarmer never allocates a training state.
+    template = jax.eval_shape(
+        lambda: init_train_state(cfg, model_init,
+                                 jax.random.PRNGKey(cfg.seed)))
+    savals = aot.state_avals(template, mesh)
+
+    phase_keys, seen = [], set()
+    for e in range(cfg.total_epochs):
+        key = (cfg.use_second_order(e), cfg.use_msl(e))
+        if key not in seen:
+            seen.add(key)
+            phase_keys.append(key)
+
+    executables = []
+    hits = misses = failures = 0
+    t_start = time.perf_counter()
+
+    def warm_one(name, jit_fn, avals):
+        nonlocal hits, misses, failures
+        t0 = time.perf_counter()
+        _, hit = aot.load_or_compile(store, name, jit_fn, avals)
+        ready = store.manifest.get(name) is not None and \
+            store.manifest.get(name).get("status") == "committed"
+        hits, misses = hits + hit, misses + (not hit)
+        if not ready:
+            failures += 1
+        executables.append({
+            "name": name,
+            "disposition": "hit" if hit else
+                           ("compiled" if ready else "failed"),
+            "seconds": round(time.perf_counter() - t0, 3)})
+        print(json.dumps(executables[-1]), flush=True)
+
+    train_batch = aot.episode_aval(cfg, mesh, cfg.batch_size)
+    for key in phase_keys:
+        # The store holds the UNDONATED twins (parallel/mesh.py §
+        # MeshPlan): deserialized donating executables are unsafe.
+        warm_one(aot.train_exec_name(key), plan.aot_train_steps[key],
+                 (savals, train_batch, aot.epoch_aval()))
+    warm_one("eval", plan.eval_step,
+             (savals, aot.episode_aval(cfg, mesh,
+                                       cfg.effective_eval_batch_size)))
+
+    if args.serve:
+        steps = make_serve_steps(cfg, apply_fn, mesh)
+        # Signatures from aot's shared builders — the engine adopts
+        # through the SAME ones (serve/engine.py § _adopt_serve_bucket),
+        # so a prewarmed name can never carry a signature the engine
+        # would demote on first call.
+        done_s, done_q = set(), set()
+        for s_b, q_b in cfg.serve_bucket_shapes:
+            adapt_avals = aot.serve_adapt_avals(
+                cfg, mesh, savals.params, savals.lslr, savals.bn_state,
+                s_b)
+            if s_b not in done_s:
+                done_s.add(s_b)
+                warm_one(aot.serve_adapt_name(s_b), steps.aot_adapt,
+                         adapt_avals)
+            if q_b not in done_q:
+                done_q.add(q_b)
+                warm_one(aot.serve_predict_name(q_b), steps.aot_predict,
+                         aot.serve_predict_avals(
+                             cfg, mesh, steps.adapt, adapt_avals,
+                             savals.params, q_b))
+
+    ok = failures == 0
+    print(json.dumps({
+        "metric": "aot_prewarm",
+        "value": len(executables) - failures,
+        "unit": "executables",
+        "ok": ok,
+        "hits": hits,
+        "misses": misses,
+        "failures": failures,
+        "seconds": round(time.perf_counter() - t_start, 3),
+        "store_dir": store.dir,
+        "fingerprint": store.fingerprint,
+        "workload": cfg.experiment_name,
+        "executables": executables,
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,8 +43,11 @@ warning landing strictly BEFORE the rewind in the faulted phase's log
 (ISSUE 7 — `health_grad_norm_warn` precedes `rewind`), the ckpt_kill
 phase recovering from the last committed manifest entry (ISSUE 8 —
 `ckpt_kill_*` keys), hang exit code 74 + bundle present + hang-restart
-completion, and final test accuracies (restart, ckpt-kill-restart AND
-hang-restart) within ``--tolerance`` of the baseline.
+completion, the faulted run's restart reaching its first train dispatch
+with ZERO XLA compiles — every executable an AOT-store hit from the
+store the faulted phase populated (ISSUE 10 — `warm_restart_*` keys) —
+and final test accuracies (restart, ckpt-kill-restart AND hang-restart)
+within ``--tolerance`` of the baseline.
 
 Artifact contract (bench.py discipline): the LAST stdout JSON line is
 authoritative — ``{"metric": "chaos_recovery", "status":
@@ -296,6 +299,23 @@ def counter_sum(snapshots, key) -> int:
     return int(sum(float(s.get(key) or 0) for s in snapshots))
 
 
+def last_warm_start_row(events_path: str):
+    """The LAST warm_start row of a phase's events.jsonl — the most
+    recent session's time-to-first-step / compiles-at-first-dispatch
+    facts (experiment.py § _note_first_dispatch). The faulted and
+    restart phases share one log; the restart session's row is last."""
+    row = {}
+    if os.path.exists(events_path):
+        with open(events_path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                parsed = json.loads(line)
+                if parsed.get("event") == "warm_start":
+                    row = parsed
+    return row
+
+
 def warn_precedes_rewind(events_path: str):
     """(warn_rows, warn_before_rewind) from a phase's events.jsonl: the
     guard's grad-norm early warning (telemetry/health.py) must land in
@@ -359,17 +379,27 @@ def main(argv=None) -> int:
     baseline_result, baseline_counters = run_phase(
         tiny_cfg(out, "chaos_baseline"))
 
+    # Warm-start store (parallel/aot.py) shared by the faulted run and
+    # its restart: the faulted phase populates it cold; the restart
+    # must then reach its first train dispatch with ZERO XLA compiles,
+    # every executable a counted AOT hit — the fault-domain restart
+    # promise (ISSUE 10), gated in `recovered` below.
+    aot_store = os.path.join(out, "aot_store")
     print(json.dumps({"phase": "faulted", "spec": faulted_spec,
                       "status": "running"}), flush=True)
     faulted_result, faulted_counters = run_phase(
-        tiny_cfg(out, "chaos_faulted", fault_spec=faulted_spec))
+        tiny_cfg(out, "chaos_faulted", fault_spec=faulted_spec,
+                 aot_store_dir=aot_store))
     preempted = (isinstance(faulted_result, dict)
                  and "preempted_at_iter" in faulted_result)
 
     print(json.dumps({"phase": "restart", "status": "running"}),
           flush=True)
     restart_result, restart_counters = run_phase(
-        tiny_cfg(out, "chaos_faulted", continue_from_epoch="latest"))
+        tiny_cfg(out, "chaos_faulted", continue_from_epoch="latest",
+                 aot_store_dir=aot_store))
+    warm_start_row = last_warm_start_row(
+        os.path.join(out, "chaos_faulted", "logs", "events.jsonl"))
 
     # Kill-during-save scenario (ISSUE 8): async writer + SIGKILL mid-
     # write -> restart from the last COMMITTED manifest entry.
@@ -462,6 +492,13 @@ def main(argv=None) -> int:
     # silent, the artifact says exactly why it didn't run.
     peer_kill_ok = (peer_kill["skipped"] is not None
                     or bool(peer_kill["recovered"]))
+    # ISSUE 10 gate: the restart of the faulted run reached its first
+    # train dispatch compile-free, every executable loaded from the AOT
+    # store the faulted phase populated.
+    warm_restart_ok = bool(
+        warm_start_row.get("compiles_before_first_step") == 0
+        and (warm_start_row.get("aot_hits") or 0) >= 1
+        and (warm_start_row.get("aot_misses") or 0) == 0)
     recovered = bool(
         preempted and rewinds >= 1 and io_retries >= 1
         and warn_before_rewind
@@ -469,11 +506,12 @@ def main(argv=None) -> int:
         and delta is not None and delta <= args.tolerance
         and ckpt_kill_recovered
         and hang_recovered
+        and warm_restart_ok
         and peer_kill_ok)
     # Recoveries: one per distinct fault class the run survived.
     recoveries = (int(preempted) + int(rewinds >= 1)
                   + int(io_retries >= 1) + int(ckpt_kill_recovered)
-                  + int(hang_recovered)
+                  + int(hang_recovered) + int(warm_restart_ok)
                   + int(bool(peer_kill["recovered"])))
 
     artifact = {
@@ -511,6 +549,13 @@ def main(argv=None) -> int:
                                           if ckpt_delta is not None
                                           else None),
         "ckpt_kill_recovered": ckpt_kill_recovered,
+        "warm_restart_compiles_before_first_step": warm_start_row.get(
+            "compiles_before_first_step"),
+        "warm_restart_aot_hits": warm_start_row.get("aot_hits"),
+        "warm_restart_aot_misses": warm_start_row.get("aot_misses"),
+        "warm_restart_time_to_first_step_s": warm_start_row.get(
+            "time_to_first_step_seconds"),
+        "warm_restart_ok": warm_restart_ok,
         "hang_exit_code": hang["hang_exit_code"],
         "hang_stacks_dumped": hang["stacks_dumped"],
         "hang_flight_rows": hang["flight_rows"],

@@ -1,0 +1,214 @@
+"""Sharded full-pyramid test runner: the whole suite in one session.
+
+The full pyramid (tier-1 quick profile + the `slow` system tests) is
+~30+ min of wall-clock on a 1-core box — past what a single pytest
+invocation survives inside CI session budgets, and a single process
+also accumulates jit-cache/thread state across 200+ tests. This runner
+splits the suite into per-file shards, runs each as a FRESH pytest
+subprocess (bounded memory, independent timeouts, a hang kills one
+shard not the session), streams everything into one archived log, and
+emits the bench.py-style last-JSON-line artifact:
+
+    {"metric": "pyramid", "passed": N, "failed": N, ...}
+
+Usage:
+
+    python scripts/run_pyramid.py                      # full pyramid
+    python scripts/run_pyramid.py --profile quick      # -m 'not slow'
+    python scripts/run_pyramid.py --shard 2/4          # this shard only
+    python scripts/run_pyramid.py --archive docs/measurements/r6
+
+With ``pytest-xdist`` installed, ``--xdist N`` forwards ``-n N`` to
+each shard instead (process-parallel within the shard); the subprocess
+sharding needs no extra dependency and is the default — this container
+ships no xdist (VERDICT Next #5: the 234-test suite must complete in
+one session, with the round's full-run log archived under
+docs/measurements/).
+
+Exit 0 iff every shard ran and nothing failed or errored.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Counts pytest prints on its summary line, e.g.
+# "== 12 passed, 2 skipped, 1 xfailed in 34.56s ==".
+_SUMMARY_RE = re.compile(
+    r"(\d+) (passed|failed|errors?|skipped|xfailed|xpassed|deselected|"
+    r"warnings?)")
+
+PROFILES = {
+    "full": None,            # the whole pyramid, slow tests included
+    "quick": "not slow",     # the tier-1 profile
+    "core": "core",          # the <5-minute pre-commit gate
+}
+
+
+def collect_shards(n_shards: int) -> list:
+    """Per-file shards, round-robin over the size-sorted file list so
+    the heavy system-test files spread across shards instead of
+    stacking in one."""
+    files = sorted(glob.glob(os.path.join(_REPO, "tests", "test_*.py")))
+    files.sort(key=os.path.getsize, reverse=True)
+    shards = [[] for _ in range(max(n_shards, 1))]
+    for i, f in enumerate(files):
+        shards[i % len(shards)].append(os.path.relpath(f, _REPO))
+    return [sorted(s) for s in shards if s]
+
+
+def run_shard(index: int, files: list, marker, timeout_s: float,
+              xdist: int, log_fh) -> dict:
+    cmd = [sys.executable, "-m", "pytest", "-q",
+           "--continue-on-collection-errors", "-p", "no:cacheprovider",
+           "-p", "no:randomly"] + files
+    if marker:
+        cmd += ["-m", marker]
+    if xdist:
+        cmd += ["-n", str(xdist)]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.monotonic()
+    header = (f"\n===== shard {index}: {len(files)} file(s) =====\n"
+              f"$ {' '.join(cmd)}\n")
+    log_fh.write(header)
+    log_fh.flush()
+    counts = {"passed": 0, "failed": 0, "errors": 0, "skipped": 0,
+              "xfailed": 0, "xpassed": 0, "deselected": 0}
+    try:
+        proc = subprocess.run(cmd, cwd=_REPO, env=env, text=True,
+                              capture_output=True, timeout=timeout_s)
+        out = proc.stdout + proc.stderr
+        rc = proc.returncode
+    except subprocess.TimeoutExpired as e:
+        # TimeoutExpired carries BYTES on Python < 3.12 even under
+        # text=True; concatenating raw would TypeError and kill the
+        # whole runner exactly when a shard hangs.
+        def _txt(s):
+            return s.decode(errors="replace") if isinstance(s, bytes) \
+                else (s or "")
+        out = (_txt(e.stdout) + _txt(e.stderr)
+               + f"\n[pyramid] shard {index} TIMED OUT after "
+                 f"{timeout_s:.0f}s\n")
+        rc = -1
+        counts["errors"] += 1
+    log_fh.write(out)
+    log_fh.flush()
+    for m in _SUMMARY_RE.finditer(out):
+        key = m.group(2).rstrip("s") if m.group(2).startswith("error") \
+            else m.group(2).rstrip()
+        key = "errors" if key == "error" else key
+        if key in counts:
+            counts[key] += int(m.group(1))
+    # pytest exit 5 = "no tests collected" (a fully-deselected shard
+    # under -m) — not a failure.
+    ok = rc in (0, 5) and counts["failed"] == 0 and counts["errors"] == 0
+    return {"shard": index, "files": len(files), "rc": rc, "ok": ok,
+            "seconds": round(time.monotonic() - t0, 1), **counts}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="sharded full-pyramid pytest runner with archived "
+                    "log + JSON artifact")
+    ap.add_argument("--profile", choices=sorted(PROFILES), default="full",
+                    help="marker filter: full (default, everything), "
+                         "quick (-m 'not slow'), core")
+    ap.add_argument("--shards", type=int, default=6,
+                    help="number of per-file shard subprocesses")
+    ap.add_argument("--shard", default=None, metavar="I/N",
+                    help="run only shard I of N (1-based; CI fan-out)")
+    ap.add_argument("--shard-timeout", type=float, default=2400.0,
+                    help="seconds per shard subprocess")
+    ap.add_argument("--xdist", type=int, default=0,
+                    help="forward -n N to pytest (requires pytest-xdist; "
+                         "0 = off, the no-dependency default)")
+    ap.add_argument("--archive", default=None, metavar="DIR",
+                    help="directory to archive the full run log under "
+                         "(e.g. docs/measurements/r6); default: "
+                         "/tmp, not archived")
+    args = ap.parse_args(argv)
+
+    if args.xdist:
+        try:
+            import xdist  # noqa: F401
+        except ImportError:
+            print(json.dumps({"metric": "pyramid", "ok": False,
+                              "error": "--xdist requested but "
+                                       "pytest-xdist is not installed"}))
+            return 1
+
+    n_shards = args.shards
+    only = None
+    if args.shard:
+        try:
+            i_s, n_s = args.shard.split("/")
+            only, n_shards = int(i_s), int(n_s)
+            if not 1 <= only <= n_shards:
+                raise ValueError
+        except ValueError:
+            print(json.dumps({"metric": "pyramid", "ok": False,
+                              "error": f"--shard expects I/N with "
+                                       f"1<=I<=N, got {args.shard!r}"}))
+            return 1
+
+    shards = collect_shards(n_shards)
+    if only is not None and only > len(shards):
+        # Empty shards are dropped, so with more requested shards than
+        # test files a high index enumerates nothing — that must be an
+        # explicit error, not a zero-tests "ok": false with no cause.
+        print(json.dumps({"metric": "pyramid", "ok": False,
+                          "error": f"--shard {args.shard}: only "
+                                   f"{len(shards)} non-empty shard(s) "
+                                   f"exist at this shard count"}))
+        return 1
+    log_dir = args.archive or "/tmp"
+    os.makedirs(log_dir, exist_ok=True)
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    log_path = os.path.join(
+        log_dir, f"pyramid_{args.profile}_{stamp}.log")
+
+    t0 = time.monotonic()
+    results = []
+    with open(log_path, "w") as log_fh:
+        log_fh.write(f"full-pyramid run: profile={args.profile} "
+                     f"shards={len(shards)} "
+                     f"{time.strftime('%Y-%m-%d %H:%M:%S')}\n")
+        for i, files in enumerate(shards, start=1):
+            if only is not None and i != only:
+                continue
+            res = run_shard(i, files, PROFILES[args.profile],
+                            args.shard_timeout, args.xdist, log_fh)
+            results.append(res)
+            print(json.dumps(res), flush=True)
+
+    total = {k: sum(r[k] for r in results)
+             for k in ("passed", "failed", "errors", "skipped",
+                       "xfailed", "xpassed", "deselected")}
+    ok = bool(results) and all(r["ok"] for r in results)
+    print(json.dumps({
+        "metric": "pyramid",
+        "value": total["passed"],
+        "unit": "tests_passed",
+        "ok": ok,
+        "profile": args.profile,
+        "shards_run": len(results),
+        "shards_total": len(shards),
+        **total,
+        "seconds": round(time.monotonic() - t0, 1),
+        "log": log_path,
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

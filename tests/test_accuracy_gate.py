@@ -85,11 +85,30 @@ def test_gate_usage_errors_exit_1_not_2(capsys):
 
 
 def test_gate_paper_table_matches_baseline_md():
-    """The thresholds hardcoded in the gate are BASELINE.md's rows."""
+    """The (mean, CI) rows hardcoded in the gate are BASELINE.md's."""
     md = open(os.path.join(REPO, "BASELINE.md")).read()
-    for (family, way, shot), acc in accuracy_gate.PAPER_GATES.items():
+    for (family, way, shot), (acc, ci) in accuracy_gate.PAPER_GATES.items():
         # Omniglot rows read "99.47%", imagenet rows "68.32 ± 0.44%".
         assert f"{100 * acc:.2f}" in md, (family, way, shot)
+        if ci:
+            # A non-zero margin must be the PUBLISHED CI, not invented.
+            assert f"± {100 * ci:.2f}" in md, (family, way, shot)
+
+
+def test_gate_threshold_is_mean_minus_ci():
+    """ADVICE r5: the pass gate is paper mean minus its published CI —
+    an at-parity run passes deterministically; rows without a published
+    CI keep the strict mean."""
+    class _C:
+        dataset_name = "mini_imagenet_full_size"
+        num_classes_per_set = 5
+        num_samples_per_class = 5
+    mean, ci = accuracy_gate.paper_gate(_C)
+    assert (mean, ci) == (0.6832, 0.0044)
+    _C.num_samples_per_class = 1
+    assert accuracy_gate.paper_gate(_C) == (0.5215, 0.0026)
+    _C.dataset_name = "omniglot_dataset"
+    assert accuracy_gate.paper_gate(_C) == (0.9947, 0.0)
 
 
 @pytest.mark.slow
@@ -146,5 +165,11 @@ def test_gate_end_to_end_on_real_png_tree(tmp_path, capsys):
         capsys)
     assert rc2 == 2
     assert verdict2["pass"] is False
-    assert verdict2["threshold"] == pytest.approx(0.6832)
-    assert verdict2["threshold_source"] == "BASELINE.md MAML++ paper table"
+    # Gate = paper mean minus its published CI (ADVICE r5); the strict
+    # mean and the granted margin are reported fields.
+    assert verdict2["threshold"] == pytest.approx(0.6832 - 0.0044)
+    assert verdict2["paper_mean"] == pytest.approx(0.6832)
+    assert verdict2["margin"] == pytest.approx(0.0044)
+    assert verdict2["strict_pass"] is False
+    assert verdict2["threshold_source"] == \
+        "BASELINE.md MAML++ paper table, mean - CI"

@@ -1,0 +1,528 @@
+"""Warm-start subsystem tests (parallel/aot.py, ISSUE 10).
+
+Tier-1 pins, in dependency order: the store contract (fingerprint
+sensitivity, manifest-framed save/load, integrity-checked loads with
+quarantine, unwritable-dir degradation, GuardedExec demotion, GC),
+AOT-vs-JIT bitwise training parity including every fallback path
+(corrupt payload → counted miss → JIT → identical weights), the
+prewarm-CLI → training handoff, serve-engine adoption, and the
+acceptance pin: a cache-warm restart through the REAL entrypoint
+(train_maml_system.py, fresh process) reaches its first train dispatch
+with ZERO XLA compiles (CompileWatcher count == 0 in the warm_start
+row). The slow profile adds the multi-phase (DA/MSL boundary) parity
+proof through subprocesses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "scripts"))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig  # noqa: E402
+from howtotrainyourmamlpytorch_tpu.parallel import aot  # noqa: E402
+from howtotrainyourmamlpytorch_tpu.parallel.mesh import (  # noqa: E402
+    make_mesh)
+from howtotrainyourmamlpytorch_tpu.telemetry import (  # noqa: E402
+    MetricsRegistry)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg(root, name="aot_exp", **kw):
+    base = dict(
+        experiment_name=name, experiment_root=str(root),
+        dataset_name="synthetic_aot",
+        image_height=8, image_width=8, image_channels=1,
+        num_classes_per_set=2, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2,
+        cnn_num_filters=4, num_stages=2,
+        number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1,
+        second_order=False, use_multi_step_loss_optimization=False,
+        total_epochs=1, total_iter_per_epoch=3,
+        num_evaluation_tasks=2, max_models_to_save=1,
+        compute_dtype="float32", meta_learning_rate=0.01,
+        live_progress=False)
+    base.update(kw)
+    return MAMLConfig(**base)
+
+
+def one_device_mesh(cfg):
+    return make_mesh(cfg.replace(mesh_shape=(1, 1)), jax.devices()[:1])
+
+
+def tiny_compiled(scale=2.0, shape=(4,)):
+    fn = jax.jit(lambda x: x * scale)
+    return fn, fn.lower(
+        jax.ShapeDtypeStruct(shape, np.float32)).compile()
+
+
+def events_rows(paths_base, event=None):
+    path = os.path.join(paths_base, "logs", "events.jsonl")
+    rows = [json.loads(line) for line in open(path) if line.strip()]
+    return [r for r in rows if event is None or r.get("event") == event]
+
+
+# ---------------------------------------------------------------------------
+# store contract
+
+
+def test_fingerprint_structural_vs_runtime_keys(tmp_path):
+    """Runtime-only knobs (names, paths, resume policy, watchdog
+    deadlines) share a fingerprint — restarts and ops tweaks stay warm;
+    anything baked into a compiled program (shapes, lr, the health
+    knob) changes it — a wrong-program hit is impossible by key."""
+    cfg = tiny_cfg(tmp_path)
+    mesh = one_device_mesh(cfg)
+    fp = aot.store_fingerprint(cfg, mesh)
+    assert fp == aot.store_fingerprint(cfg, mesh)  # deterministic
+    for runtime_kw in (dict(experiment_name="other"),
+                       dict(continue_from_epoch="latest"),
+                       dict(watchdog_step_timeout_s=5.0),
+                       dict(ckpt_async=1),
+                       dict(aot_store_dir="/elsewhere")):
+        assert aot.store_fingerprint(cfg.replace(**runtime_kw),
+                                     mesh) == fp, runtime_kw
+    for structural_kw in (dict(cnn_num_filters=8),
+                          dict(meta_learning_rate=0.02),
+                          dict(number_of_training_steps_per_iter=2),
+                          dict(health_metrics_every_n_steps=1),
+                          dict(transfer_images_uint8=False)):
+        assert aot.store_fingerprint(cfg.replace(**structural_kw),
+                                     mesh) != fp, structural_kw
+
+
+def test_store_roundtrip_and_counters(tmp_path):
+    reg = MetricsRegistry()
+    store = aot.AOTStore(str(tmp_path / "store"), "ab" * 32,
+                         doc={"k": 1}, registry=reg)
+    assert store.writable and store.readable
+    _, compiled = tiny_compiled()
+    assert store.load("double") is None           # cold: counted miss
+    assert store.save("double", compiled)
+    loaded = store.load("double")
+    assert loaded is not None
+    np.testing.assert_array_equal(
+        np.asarray(loaded(jnp.ones(4))), 2 * np.ones(4))
+    assert store.hits == 1 and store.misses == 1
+    assert reg.counter(aot.HITS).value == 1
+    assert reg.counter(aot.MISSES).value == 1
+    assert reg.counter(aot.LOAD_SECONDS).value > 0
+    # Manifest framing: the record is committed with real bytes + crc.
+    rec = store.manifest.get("double")
+    assert rec["status"] == "committed" and rec["bytes"] > 0
+
+
+def test_foreign_fingerprint_is_counted_miss_never_a_load(tmp_path):
+    """A store dir recording a DIFFERENT fingerprint under our key
+    (hand-copied dir) is never loaded from and never written into."""
+    reg = MetricsRegistry()
+    root = str(tmp_path / "store")
+    fp_a = "aa" * 32
+    store_a = aot.AOTStore(root, fp_a, doc={}, registry=reg)
+    _, compiled = tiny_compiled()
+    assert store_a.save("x", compiled)
+    # Forge: same dir key, different recorded fingerprint.
+    dir_a = store_a.dir
+    with open(os.path.join(dir_a, aot.STORE_FILE), "w") as f:
+        json.dump({"schema": aot.STORE_SCHEMA,
+                   "fingerprint": "ff" * 32}, f)
+    with pytest.warns(UserWarning, match="fingerprint"):
+        store_b = aot.AOTStore(root, fp_a, doc={}, registry=reg)
+    assert not store_b.readable and not store_b.writable
+    assert store_b.load("x") is None
+    assert not store_b.save("x", compiled)
+    assert reg.counter(aot.MISSES).value >= 1
+    # A DIFFERENT fingerprint simply keys a different subdir: miss.
+    store_c = aot.AOTStore(root, "bb" * 32, doc={}, registry=reg)
+    assert store_c.load("x") is None
+
+
+def test_corrupt_payload_quarantined_and_recompilable(tmp_path):
+    reg = MetricsRegistry()
+    store = aot.AOTStore(str(tmp_path / "store"), "cc" * 32,
+                         doc={}, registry=reg)
+    _, compiled = tiny_compiled()
+    assert store.save("f", compiled)
+    path = os.path.join(store.dir, "f.aotx")
+    # Truncation (a torn copy) fails the byte-count/CRC ladder.
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    assert store.load("f") is None
+    assert os.path.exists(path + ".corrupt")
+    assert store.manifest.get("f") is None   # record dropped with it
+    assert reg.counter(aot.QUARANTINED).value == 1
+    assert reg.counter(aot.MISSES).value >= 1
+    # The slot is reusable: a fresh save-and-load round trip works.
+    assert store.save("f", compiled)
+    assert store.load("f") is not None
+
+
+def test_unwritable_store_root_degrades_to_jit(tmp_path):
+    """A store root that cannot exist (here: the path is a FILE) must
+    cost counted misses/errors, never an exception."""
+    root = tmp_path / "not_a_dir"
+    root.write_text("occupied")
+    reg = MetricsRegistry()
+    store = aot.AOTStore(str(root), "dd" * 32, doc={}, registry=reg)
+    assert not store.writable and not store.readable
+    assert store.load("x") is None
+    _, compiled = tiny_compiled()
+    assert not store.save("x", compiled)
+    assert reg.counter(aot.MISSES).value == 1
+    assert reg.counter(aot.ERRORS).value >= 1
+    # load_or_compile still produces a working executable (lazy-free
+    # compile path) — the run proceeds as if the store never existed.
+    fn = jax.jit(lambda x: x + 1)
+    out, hit = aot.load_or_compile(
+        store, "x", fn, (jax.ShapeDtypeStruct((2,), np.float32),))
+    assert not hit
+    np.testing.assert_array_equal(np.asarray(out(jnp.zeros(2))),
+                                  np.ones(2))
+
+
+def test_guarded_exec_demotes_on_signature_mismatch():
+    reg = MetricsRegistry()
+    fn, compiled = tiny_compiled(shape=(4,))
+    guarded = aot.GuardedExec(compiled, fn, "t", registry=reg)
+    np.testing.assert_array_equal(np.asarray(guarded(jnp.ones(4))),
+                                  2 * np.ones(4))
+    # Wrong shape: the stored executable rejects BEFORE execution; the
+    # call falls back to jit and the slot demotes permanently.
+    with pytest.warns(UserWarning, match="demoted"):
+        out = guarded(jnp.ones(8))
+    np.testing.assert_array_equal(np.asarray(out), 2 * np.ones(8))
+    assert reg.counter(aot.EXEC_FALLBACKS).value == 1
+    assert guarded._compiled is None
+
+
+def test_gc_keeps_newest_fingerprint_dirs(tmp_path):
+    import time as _time
+    root = tmp_path / "store"
+    root.mkdir()
+    for i in range(6):
+        d = root / f"{i:02d}fingerprint0000"
+        d.mkdir()
+        with open(d / aot.STORE_FILE, "w") as f:
+            json.dump({"fingerprint": f"{i:02d}" * 32}, f)
+        # All stale past the GC age floor; i=0 oldest.
+        stamp = _time.time() - aot.GC_MIN_AGE_S - (600 - 60 * i)
+        os.utime(d, (stamp, stamp))
+    # A FRESH dir beyond the keep budget (another config's
+    # just-prewarmed store on a shared root) must survive regardless.
+    fresh = root / "fffresh000000000"
+    fresh.mkdir()
+    with open(fresh / aot.STORE_FILE, "w") as f:
+        json.dump({"fingerprint": "f0" * 32}, f)
+    aot.AOTStore(str(root), "ee" * 32, doc={})
+    dirs = sorted(p for p in os.listdir(root))
+    # Live store + fresh shared-root neighbor + the newest stale
+    # predecessors up to the keep budget.
+    assert "ee" * 8 in dirs
+    assert "fffresh000000000" in dirs       # age floor protects it
+    assert "00fingerprint0000" not in dirs  # oldest stale swept
+    assert "01fingerprint0000" not in dirs
+    assert "05fingerprint0000" in dirs      # newest stale kept
+    assert len(dirs) == aot.GC_KEEP_FINGERPRINTS + 1
+
+
+def test_sweep_spares_live_cowriter_tmp(tmp_path, monkeypatch):
+    """The startup sweep must not unlink another LIVE writer's
+    in-flight tmp (the multi-writer contract: trainer + engine +
+    prewarmer legally share one store; a big executable's tmp write
+    takes seconds). A tmp survives while its embedded pid is alive, or
+    while it is younger than the grace window (another host's writer
+    on shared storage); genuinely dead wreckage is still swept."""
+    import time as _time
+    root = str(tmp_path / "store")
+    store = aot.AOTStore(root, "ab" * 32, doc={})
+    dead_pid = 987654321
+    live = os.path.join(store.dir, f"x.aotx.tmp.{os.getpid()}")
+    dead_old = os.path.join(store.dir, f"y.aotx.tmp.{dead_pid}")
+    dead_young = os.path.join(store.dir, f"z.aotx.tmp.{dead_pid}")
+    for p in (live, dead_old, dead_young):
+        with open(p, "wb") as f:
+            f.write(b"half-written")
+    old = _time.time() - aot.SWEEP_TMP_GRACE_S - 60
+    os.utime(dead_old, (old, old))
+    os.utime(live, (old, old))  # age alone must not condemn a live pid
+    real_kill = os.kill
+
+    def fake_kill(pid, sig):
+        if pid == dead_pid:
+            raise ProcessLookupError(pid)
+        return real_kill(pid, sig)
+
+    monkeypatch.setattr(aot.os, "kill", fake_kill)
+    aot.AOTStore(root, "ab" * 32, doc={})
+    assert os.path.exists(live)          # alive pid: in flight
+    assert os.path.exists(dead_young)    # grace window: maybe a peer host
+    assert not os.path.exists(dead_old)  # dead + stale: wreckage
+
+
+# ---------------------------------------------------------------------------
+# training parity + fallback, in process
+
+
+def _final_state_leaves(builder):
+    return [np.asarray(x) for x in jax.tree.leaves(
+        jax.device_get(builder.state.params))]
+
+
+def test_aot_vs_jit_bitwise_parity_and_corrupt_fallback(tmp_path):
+    """THE parity pin: identical tiny runs with (a) no store, (b) a cold
+    store, (c) a store whose train payload was corrupted mid-flight all
+    finish with BITWISE-identical weights — the store changes where the
+    executable comes from, never what it computes; every fallback is a
+    counted miss."""
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    store = str(tmp_path / "store")
+
+    jit_b = ExperimentBuilder(tiny_cfg(tmp_path / "jit"))
+    jit_b.run_experiment()
+    jit_leaves = _final_state_leaves(jit_b)
+
+    cold_b = ExperimentBuilder(
+        tiny_cfg(tmp_path / "cold", aot_store_dir=store))
+    cold_b.run_experiment()
+    (ws,) = events_rows(cold_b.paths["base"], "warm_start")
+    assert ws["aot_misses"] == 2 and ws["aot_hits"] == 0  # train + eval
+    for a, b in zip(jit_leaves, _final_state_leaves(cold_b)):
+        np.testing.assert_array_equal(a, b)
+
+    # Corrupt the stored train executable: the next run must quarantine
+    # it, fall back (counted), and STILL train bitwise-identically.
+    fp_dir = os.path.join(store, os.listdir(store)[0])
+    target = os.path.join(fp_dir, "train_so0_msl0.aotx")
+    blob = open(target, "rb").read()
+    with open(target, "wb") as f:
+        f.write(blob[:100])
+    corrupt_b = ExperimentBuilder(
+        tiny_cfg(tmp_path / "corrupt", aot_store_dir=store))
+    corrupt_b.run_experiment()
+    (ws,) = events_rows(corrupt_b.paths["base"], "warm_start")
+    assert ws["aot_misses"] == 1 and ws["aot_hits"] == 1  # eval still hit
+    assert corrupt_b.registry.counter(aot.QUARANTINED).value == 1
+    for a, b in zip(jit_leaves, _final_state_leaves(corrupt_b)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_deferred_phase_compiles_populate_store_off_critical_path(tmp_path):
+    """With precompile_phases on, a cold multi-phase run adopts only
+    the FIRST phase key (+ eval) ahead of the first step; LATER phase
+    keys defer their compile to the phase-warmup thread, which still
+    populates the store before run_experiment returns (joined on
+    normal exit) — so the follow-up run adopts everything as hits with
+    zero misses. The cold-run-is-the-prewarm contract survives the
+    time-to-first-step optimization."""
+    from howtotrainyourmamlpytorch_tpu.ckpt.manifest import Manifest
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    store = str(tmp_path / "store")
+
+    def cfg_for(name):
+        return tiny_cfg(tmp_path / name, name=name,
+                        aot_store_dir=store, precompile_phases=True,
+                        total_epochs=2, total_iter_per_epoch=2,
+                        second_order=True,
+                        use_multi_step_loss_optimization=True,
+                        multi_step_loss_num_epochs=1,
+                        number_of_training_steps_per_iter=2)
+
+    cold_cfg = cfg_for("defer_cold")
+    phase_names = {aot.train_exec_name(
+        (cold_cfg.use_second_order(e), cold_cfg.use_msl(e)))
+        for e in range(cold_cfg.total_epochs)}
+    assert len(phase_names) == 2  # the schedule crosses a phase boundary
+
+    cold_b = ExperimentBuilder(cold_cfg)
+    cold_b.run_experiment()
+    (ws,) = events_rows(cold_b.paths["base"], "warm_start")
+    assert ws["aot_hits"] == 0 and ws["aot_misses"] == 3
+    # The deferred compile landed in the store (the join-before-exit
+    # contract), not just in jit's in-process cache.
+    fp_dir = os.path.join(store, os.listdir(store)[0])
+    committed = {r["tag"] for r in Manifest(fp_dir).committed()}
+    assert phase_names | {"eval"} <= committed
+
+    warm_b = ExperimentBuilder(cfg_for("defer_warm"))
+    warm_b.run_experiment()
+    (ws,) = events_rows(warm_b.paths["base"], "warm_start")
+    assert ws["aot_hits"] == 3 and ws["aot_misses"] == 0
+    assert ws["compiles_before_first_step"] == 0
+    # Deferral changes WHEN the later executable is compiled, never
+    # what it computes: cold and warm weights stay bitwise identical.
+    for a, b in zip(_final_state_leaves(cold_b),
+                    _final_state_leaves(warm_b)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prewarm_cli_to_training_handoff(tmp_path, capsys):
+    """The scheduler flow: aot_prewarm.py fills the store (artifact
+    contract pinned), a second prewarm is all hits, and a training run
+    against the same store starts fully warm — zero misses."""
+    import aot_prewarm
+    cfg = tiny_cfg(tmp_path, aot_store_dir=str(tmp_path / "store"))
+    cfg_path = tmp_path / "cfg.json"
+    with open(cfg_path, "w") as f:
+        json.dump(cfg.to_dict(), f)
+
+    def run_prewarm():
+        rc = aot_prewarm.main(["--config", str(cfg_path), "--serve",
+                               "--backend-timeout", "0"])
+        lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.startswith("{")]
+        return rc, json.loads(lines[-1])
+
+    rc, art = run_prewarm()
+    assert rc == 0
+    assert art["metric"] == "aot_prewarm" and art["ok"] is True
+    assert art["misses"] == 4 and art["hits"] == 0  # train, eval, 2 serve
+    assert {e["name"] for e in art["executables"]} == {
+        "train_so0_msl0", "eval", "serve_adapt_s2", "serve_predict_q2"}
+    rc, art = run_prewarm()
+    assert rc == 0 and art["hits"] == 4 and art["misses"] == 0
+
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    builder = ExperimentBuilder(cfg)
+    builder.run_experiment()
+    (ws,) = events_rows(builder.paths["base"], "warm_start")
+    assert ws["aot_hits"] == 2 and ws["aot_misses"] == 0
+
+
+def test_serve_engine_aot_adoption(tmp_path):
+    """A second serving process warms up from the store the first one
+    populated, and serves correctly through the loaded executables."""
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.serve.batcher import FewShotRequest
+    from howtotrainyourmamlpytorch_tpu.serve.engine import ServingEngine
+    cfg = tiny_cfg(tmp_path, aot_store_dir=str(tmp_path / "store"))
+    model_init, _ = make_model(cfg)
+    state = init_train_state(cfg, model_init, jax.random.PRNGKey(0))
+
+    reg1 = MetricsRegistry()
+    with ServingEngine(cfg, state, registry=reg1) as engine:
+        engine.warmup()
+    assert reg1.counter(aot.MISSES).value >= 2  # adapt + predict
+
+    reg2 = MetricsRegistry()
+    with ServingEngine(cfg, state, registry=reg2) as engine:
+        engine.warmup()
+        assert reg2.counter(aot.HITS).value >= 2
+        assert reg2.counter(aot.MISSES).value == 0
+        h, w, c = cfg.image_shape
+        engine.submit(FewShotRequest(
+            support_x=np.zeros((2, h, w, c), np.uint8),
+            support_y=np.array([0, 1], np.int32),
+            query_x=np.zeros((2, h, w, c), np.uint8)))
+        (resp,) = engine.drain()
+        assert resp.error is None
+        assert resp.predictions.shape == (2,)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pin: zero-compile warm restart through the REAL
+# entrypoint, fresh processes (an in-process rerun would hit the jit
+# cache and prove nothing about the store)
+
+
+def _run_entrypoint(cfg_path, *overrides):
+    env = dict(os.environ, MAML_JAX_PLATFORM="cpu")
+    env.pop("MAML_FAULTS", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "train_maml_system.py"),
+         "--name_of_args_json_file", str(cfg_path), *overrides],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+
+
+def test_zero_compile_warm_restart_real_entrypoint(tmp_path):
+    cfg = tiny_cfg(tmp_path / "exp", name="warmrestart",
+                   total_epochs=2, total_epochs_before_pause=1,
+                   aot_store_dir=str(tmp_path / "store"))
+    cfg_path = tmp_path / "cfg.json"
+    with open(cfg_path, "w") as f:
+        json.dump(cfg.to_dict(), f)
+
+    cold = _run_entrypoint(cfg_path)
+    assert cold.returncode == 0, cold.stderr[-2000:]
+    base = os.path.join(str(tmp_path / "exp"), "warmrestart")
+    (ws_cold,) = events_rows(base, "warm_start")
+    assert ws_cold["aot_misses"] == 2
+    assert ws_cold["compiles_before_first_step"] > 0  # cold paid them
+
+    warm = _run_entrypoint(cfg_path, "--continue_from_epoch", "latest")
+    assert warm.returncode == 0, warm.stderr[-2000:]
+    rows = events_rows(base, "warm_start")
+    assert len(rows) == 2
+    ws_warm = rows[-1]
+    # THE acceptance criterion: a cache-warm restart reaches its first
+    # train dispatch with zero XLA compiles, every executable a hit.
+    assert ws_warm["compiles_before_first_step"] == 0, ws_warm
+    assert ws_warm["aot_hits"] == 2 and ws_warm["aot_misses"] == 0
+    assert ws_warm["time_to_first_step_seconds"] is not None
+    assert "resumed from checkpoint" in warm.stdout
+
+
+@pytest.mark.slow
+def test_aot_parity_across_phase_boundaries_slow(tmp_path):
+    """Multi-phase parity through subprocesses: a DA+MSL config whose
+    schedule crosses an executable swap trains BITWISE-identically on
+    every armed-store path — cold (compile-and-populate), warm (all
+    deserialized), and broken-store (every load a counted miss, the
+    in-process fallback) — and the warm restart is compile-free for
+    BOTH phase executables.
+
+    The donating store-OFF world is deliberately NOT in the bitwise
+    set: donation changes the code XLA emits (last-ulp gradient
+    differences on this second-order program, amplified by Adam into
+    real weight divergence — measured while building ISSUE 10), which
+    is exactly why an armed store runs the undonated programs
+    EVERYWHERE (parallel/mesh.py § make_sharded_steps): within that
+    world, where the executable came from provably cannot change
+    training results."""
+    from howtotrainyourmamlpytorch_tpu.ckpt.manifest import file_crc32
+
+    def cfg_for(name, **kw):
+        return tiny_cfg(tmp_path / name, name=name,
+                        total_epochs=2, total_iter_per_epoch=2,
+                        second_order=True,
+                        use_multi_step_loss_optimization=True,
+                        multi_step_loss_num_epochs=1,
+                        number_of_training_steps_per_iter=2, **kw)
+
+    def run(name, **kw):
+        cfg = cfg_for(name, **kw)
+        cfg_path = tmp_path / f"{name}.json"
+        with open(cfg_path, "w") as f:
+            json.dump(cfg.to_dict(), f)
+        r = _run_entrypoint(cfg_path)
+        assert r.returncode == 0, r.stderr[-2000:]
+        ckpt = os.path.join(str(tmp_path / name), name, "saved_models",
+                            "train_model_latest.ckpt")
+        return file_crc32(ckpt)
+
+    broken = tmp_path / "not_a_store"
+    broken.write_text("occupied")  # store root is a file: every load
+    #                                misses, every save fails (counted)
+    crc_fallback = run("phases_fallback", aot_store_dir=str(broken))
+    crc_cold = run("phases_cold", aot_store_dir=str(tmp_path / "store"))
+    crc_warm = run("phases_warm", aot_store_dir=str(tmp_path / "store"))
+    assert crc_fallback == crc_cold == crc_warm
+    (ws,) = events_rows(os.path.join(str(tmp_path / "phases_warm"),
+                                     "phases_warm"), "warm_start")
+    # Both phase executables + eval loaded; zero compiles at dispatch.
+    assert ws["aot_hits"] == 3 and ws["aot_misses"] == 0
+    assert ws["compiles_before_first_step"] == 0

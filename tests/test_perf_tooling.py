@@ -231,6 +231,92 @@ def test_expanded_flops_microbatch_invariant():
     assert f4["trip_counts"], "no counted loops found in mb=4 program"
 
 
+_TRIPS_HLO = """\
+HloModule tiny
+
+%body.1 (p.0: (s32[], f32[8,8])) -> (s32[], f32[8,8]) {
+  %p.0 = (s32[], f32[8,8]) parameter(0)
+  %gte.0 = s32[] get-tuple-element(%p.0), index=0
+  %c.1 = s32[] constant(1)
+  %add.0 = s32[] add(%gte.0, %c.1)
+  %gte.1 = f32[8,8] get-tuple-element(%p.0), index=1
+  %d.0 = f32[8,8] dot(%gte.1, %gte.1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  ROOT %tuple.0 = (s32[], f32[8,8]) tuple(%add.0, %d.0)
+}
+
+%cond.1 (p.1: (s32[], f32[8,8])) -> pred[] {
+  %p.1 = (s32[], f32[8,8]) parameter(0)
+  %gte.2 = s32[] get-tuple-element(%p.1), index=0
+  %c.5 = s32[] constant(5)
+  ROOT %lt.0 = pred[] compare(%gte.2, %c.5), direction=LT
+}
+
+ENTRY %main.1 (a.0: f32[8,8]) -> (s32[], f32[8,8]) {
+  %a.0 = f32[8,8] parameter(0)
+  %c.0 = s32[] constant(0)
+  %t.0 = (s32[], f32[8,8]) tuple(%c.0, %a.0)
+  ROOT %w.0 = (s32[], f32[8,8]) while(%t.0), condition=%cond.1, body=%body.1
+}
+"""
+
+
+def test_trip_override_applies_and_is_validated_at_init(monkeypatch):
+    """ADVICE r5: PERF_CEILING_TRIPS is parsed + validated ONCE at
+    counter init — a matching override applies, a typo'd loop name
+    warns (instead of being silently ignored), and a malformed count
+    raises immediately."""
+    from howtotrainyourmamlpytorch_tpu.utils.hlo_flops import (
+        HloFlopsCounter)
+    monkeypatch.setenv("PERF_CEILING_TRIPS", "cond.1:7")
+    counter = HloFlopsCounter(_TRIPS_HLO)
+    counter.total()
+    assert counter.trip_counts == {"cond.1": 7}
+
+    monkeypatch.setenv("PERF_CEILING_TRIPS", "cond.typo:3")
+    with pytest.warns(UserWarning, match="cond.typo"):
+        counter = HloFlopsCounter(_TRIPS_HLO)
+    counter.total()  # heuristic count still used, as the warning says
+    assert counter.trip_counts == {"cond.1": 5}
+
+    monkeypatch.setenv("PERF_CEILING_TRIPS", "cond.1:not_an_int")
+    with pytest.raises(ValueError, match="not an integer"):
+        HloFlopsCounter(_TRIPS_HLO)
+
+
+def test_verify_trip_counts_tripwire():
+    """VERDICT Next #6: detected trip counts are tripwired against the
+    config's known scan extents (K, task_microbatches; 1 is always
+    legitimate) — a misread loop bound becomes a visible artifact
+    warning, never a silently-inflated MFU."""
+    from howtotrainyourmamlpytorch_tpu.utils.hlo_flops import (
+        verify_trip_counts)
+    assert verify_trip_counts({"cond.1": 5, "cond.2": 1}, {5, 12}) == []
+    warns = verify_trip_counts({"cond.1": 1000}, {5, 12})
+    assert len(warns) == 1
+    assert "cond.1" in warns[0] and "1000" in warns[0]
+    assert "PERF_CEILING_TRIPS" in warns[0]  # the documented override
+
+
+def test_compiler_option_parse_is_reentrant():
+    """ADVICE r5: the duplicate --compiler-option check tests the
+    CURRENT invocation's options, not the module global a previous
+    main() populated — a second run in one process must accept the
+    same options again."""
+    saved = dict(bench.COMPILER_OPTIONS)
+    try:
+        bench.COMPILER_OPTIONS.clear()
+        bench.COMPILER_OPTIONS["xla_knob"] = "1"  # simulate prior main()
+        assert bench.parse_compiler_options(
+            ["xla_knob=2", "other=3"]) == {"xla_knob": "2", "other": "3"}
+        with pytest.raises(ValueError, match="given twice"):
+            bench.parse_compiler_options(["k=1", "k=2"])
+        with pytest.raises(ValueError, match="KEY=VAL"):
+            bench.parse_compiler_options(["k="])
+    finally:
+        bench.COMPILER_OPTIONS.clear()
+        bench.COMPILER_OPTIONS.update(saved)
+
+
 def test_phase_key_matches_flagship_schedule():
     cfg = {"second_order": True, "first_order_to_second_order_epoch": 40,
            "use_multi_step_loss_optimization": True,

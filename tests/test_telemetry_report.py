@@ -23,13 +23,13 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # v3 the resilience section, v4 the data-plane section, v5 the
 # watchdog section, v6 the optimization-health section, v7 the
 # checkpoint-lifecycle section, v8 the pod-fault-domain cluster
-# section).
+# section, v9 the AOT warm-start section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
-    "watchdog", "health", "checkpoint", "cluster",
+    "watchdog", "health", "checkpoint", "cluster", "warm_start",
 }
 
 
@@ -208,6 +208,7 @@ def test_summarize_events_fixture(tmp_path):
     assert s["health"] == UNAVAILABLE
     assert s["checkpoint"] == UNAVAILABLE
     assert s["cluster"] == UNAVAILABLE
+    assert s["warm_start"] == UNAVAILABLE
     # The table renders every row without raising.
     table = format_table(s)
     assert "feed stall fraction" in table and "0.1" in table
@@ -418,6 +419,42 @@ def test_cluster_section_from_heartbeats_alone():
     assert cl["last_suspect_host"] == UNAVAILABLE
     assert cl["consensus_epoch"] == UNAVAILABLE
     assert cl["max_peer_lease_age_seconds"] == pytest.approx(0.9)
+
+
+def test_summarize_events_warm_start_section():
+    """v9: aot/* counters accumulate reset-aware across process
+    segments (a restart resets them to 0 — the very event warm-start
+    exists for) and the LAST warm_start row — the most recent restart —
+    wins the per-session numbers."""
+    events = [
+        # Cold session: 2 misses, a compile-paying first dispatch.
+        {"event": "warm_start", "iter": 0,
+         "time_to_first_step_seconds": 31.5,
+         "compiles_before_first_step": 2, "aot_hits": 0, "aot_misses": 2},
+        {"event": "metrics",
+         "metrics": {"aot/hits": 0.0, "aot/misses": 2.0,
+                     "aot/load_seconds": 0.01}},
+        # Restart (counters reset): everything loads, zero compiles.
+        {"event": "warm_start", "iter": 8,
+         "time_to_first_step_seconds": 0.4,
+         "compiles_before_first_step": 0, "aot_hits": 2, "aot_misses": 0},
+        {"event": "metrics",
+         "metrics": {"aot/hits": 2.0, "aot/misses": 0.0,
+                     "aot/load_seconds": 0.2}},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    ws = s["warm_start"]
+    assert ws["aot_hits"] == 2
+    assert ws["aot_misses"] == 2          # both segments counted
+    # Reset detection sees 0.2 > 0.01 as a continuation (the known
+    # cross-section limitation of the Prometheus rate() rule when a new
+    # segment immediately exceeds the old): delta-accumulates to 0.2.
+    assert ws["aot_load_seconds"] == pytest.approx(0.2)
+    assert ws["time_to_first_step_seconds"] == pytest.approx(0.4)
+    assert ws["compiles_before_first_step"] == 0
+    assert ws["sessions"] == 2
+    assert "warm start" in format_table(s)
 
 
 def test_health_section_nonfinite_grad_norm_visible():
