@@ -622,7 +622,7 @@ def main() -> int:
                 # Avals, not the live state: the timed loop above
                 # DONATED wl.state's buffers.
                 savals = aot_mod.state_avals(wl.state, mesh)
-                bavals = aot_mod.episode_aval(cfg, mesh, cfg.batch_size)
+                bavals = aot_mod.episode_aval(cfg, mesh, cfg.padded_batch_size)
                 t0 = time.perf_counter()
                 twin_compiled = timed_compile(
                     twin.lower(savals, bavals, aot_mod.epoch_aval()),

@@ -467,6 +467,31 @@ class MAMLConfig:
     cluster_peer_dead_s: float = 0.0       # lease age past which a peer
                                            # counts as dead; 0 = auto:
                                            # cluster_collective_timeout_s
+    # Elastic pod (resilience/elastic.py, docs/RESILIENCE.md § Elastic
+    # pod): on an ATTRIBUTED peer loss within budget, survivors agree a
+    # degraded roster through the lease directory and restart-in-place
+    # over the survivor set (resuming from the committed epoch) instead
+    # of exiting EXIT_PEER_LOST (73). Requires the pod fault domain
+    # (cluster_collective_timeout_s > 0); 0 = off (the default): the
+    # exit-73 whole-job-restart path is byte-for-byte unchanged.
+    elastic_mode: int = 0                  # 1 = reshard-and-continue on
+                                           # attributed peer loss
+    elastic_max_lost_hosts: int = 1        # cumulative lost-host budget
+                                           # (vs the ORIGINAL roster)
+                                           # beyond which a loss falls
+                                           # back to exit 73
+    elastic_reshard_timeout_s: float = 0.0 # roster-consensus deadline;
+                                           # 0 = auto:
+                                           # cluster_collective_timeout_s
+    elastic_pad_tasks: int = 0             # INTERNAL (set by the
+                                           # degraded-roster derivation,
+                                           # parallel/mesh.py §
+                                           # derive_degraded_config):
+                                           # zero-weight tasks padding
+                                           # the global meta-batch up to
+                                           # a multiple of the degraded
+                                           # mesh size; masked exactly
+                                           # in the train step
 
     # Keys found in a loaded JSON that we accepted-and-ignored (for logging).
     ignored_keys: Tuple[str, ...] = ()
@@ -602,6 +627,35 @@ class MAMLConfig:
                 f"cluster_peer_dead_s {self.cluster_peer_dead_s} < "
                 f"cluster_peer_stalled_s {self.cluster_peer_stalled_s}: "
                 f"a dead peer must first be stalled")
+        if self.elastic_mode not in (0, 1):
+            raise ValueError(
+                f"elastic_mode must be 0 (exit 73 on peer loss) or 1 "
+                f"(survivors reshard and continue), got {self.elastic_mode}")
+        if self.elastic_mode and self.cluster_collective_timeout_s <= 0:
+            raise ValueError(
+                "elastic_mode=1 requires the pod fault domain "
+                "(cluster_collective_timeout_s > 0): resharding is routed "
+                "from the attributed peer-lost trip — without it the "
+                "elastic policy could never fire and the config would "
+                "silently promise a resilience it cannot deliver")
+        if self.elastic_max_lost_hosts < 1:
+            raise ValueError(
+                f"elastic_max_lost_hosts must be >= 1, got "
+                f"{self.elastic_max_lost_hosts}")
+        if self.elastic_reshard_timeout_s < 0:
+            raise ValueError(
+                "elastic_reshard_timeout_s must be >= 0 (0 = auto: the "
+                "cluster collective budget)")
+        if self.elastic_pad_tasks < 0:
+            raise ValueError("elastic_pad_tasks must be >= 0")
+        if (self.elastic_pad_tasks
+                and (self.batch_size + self.elastic_pad_tasks)
+                % max(int(math.prod(self.mesh_shape)), 1) != 0):
+            raise ValueError(
+                f"elastic_pad_tasks {self.elastic_pad_tasks} does not pad "
+                f"batch_size {self.batch_size} to a multiple of the mesh "
+                f"size {int(math.prod(self.mesh_shape))}; the pad exists "
+                f"only to make the degraded geometry divisible")
         if self.fault_spec:
             # Parse-validate now: a typo'd chaos spec that silently
             # injects nothing would "prove" recovery that never ran.
@@ -710,6 +764,16 @@ class MAMLConfig:
         return mean, inv_std, identity
 
     @property
+    def padded_batch_size(self) -> int:
+        """The train batch extent the executables actually see:
+        ``batch_size`` real tasks plus ``elastic_pad_tasks`` zero-weight
+        pads (a degraded elastic roster pads the global meta-batch up to
+        a multiple of the survivor mesh size; the train step masks the
+        pads exactly — meta/outer.py). 0 pads (the default) keeps this
+        identical to ``batch_size``."""
+        return self.batch_size + self.elastic_pad_tasks
+
+    @property
     def effective_eval_batch_size(self) -> int:
         """Meta-batch used for val/test sweeps.
 
@@ -745,7 +809,7 @@ class MAMLConfig:
         bench.py, scripts/perf_ceiling.py — resolves through this one
         helper so executed and reported geometry cannot drift.
         """
-        local = max(self.batch_size // max(mesh_size, 1), 1)
+        local = max(self.padded_batch_size // max(mesh_size, 1), 1)
         return math.gcd(self.task_microbatches, local)
 
     @property

@@ -146,13 +146,44 @@ class MetaLearningDataLoader:
         eps = [self._sample_episode(sampler, i) for i in indices]
         return Episode(*(np.stack(field) for field in zip(*eps)))
 
+    def _zero_episodes(self, n: int) -> Episode:
+        """``n`` all-zero pad tasks in the wire dtype contract
+        (parallel/aot.py § episode_aval). Elastic pad positions only —
+        the train step masks them to exactly zero weight, so their
+        content never reaches the optimizer; zeros keep every forward
+        finite and make the pad bytes roster-deterministic."""
+        cfg = self.cfg
+        h, w, c = cfg.image_shape
+        img = np.uint8 if cfg.transfer_images_uint8 else np.float32
+        return Episode(
+            np.zeros((n, cfg.num_support_per_task, h, w, c), img),
+            np.zeros((n, cfg.num_support_per_task), np.int32),
+            np.zeros((n, cfg.num_target_per_task, h, w, c), img),
+            np.zeros((n, cfg.num_target_per_task), np.int32))
+
+    @staticmethod
+    def _concat_episodes(parts) -> Episode:
+        parts = list(parts)
+        if len(parts) == 1:
+            return parts[0]
+        return Episode(*(np.concatenate(field)
+                         for field in zip(*parts)))
+
     def _batches(self, split: str, start_idx: int,
-                 num_batches: int, batch_size: int) -> Iterator[Episode]:
+                 num_batches: int, batch_size: int,
+                 pad_tasks: int = 0) -> Iterator[Episode]:
         sampler = self.sampler(split)
         prefetch = max(1, self.cfg.prefetch_batches)
         q: "queue.Queue" = queue.Queue(maxsize=prefetch)
         abandoned = threading.Event()
 
+        # Elastic pad (degraded survivor mesh): the EXECUTABLE sees
+        # batch_size + pad_tasks positions, but the episode STREAM stays
+        # indexed by the real batch_size — pad positions (the global
+        # tail) are zero episodes the train step masks, so the stream a
+        # resumed degraded run consumes is position-for-position the one
+        # any run of this config consumes.
+        padded_size = batch_size + pad_tasks
         if self._multihost:
             # Loop-invariant: the sharding and per-device slice map depend
             # only on (mesh, batch_size).
@@ -160,7 +191,7 @@ class MetaLearningDataLoader:
                 assemble_global_batch, batch_sharding,
                 local_batch_positions)
             mh_sharding = batch_sharding(self.mesh)
-            mh_positions = local_batch_positions(mh_sharding, batch_size)
+            mh_positions = local_batch_positions(mh_sharding, padded_size)
 
         def put_bounded(item) -> None:
             # Bounded put so an abandoned consumer can't strand the worker
@@ -190,15 +221,28 @@ class MetaLearningDataLoader:
                                          step=start_idx + b):
                         faults.hang()
                     base = (start_idx + b) * batch_size + salt
+
+                    def sample_range(s: int, e: int) -> Episode:
+                        # Global positions [s, e) of the PADDED batch:
+                        # real positions map onto the episode stream,
+                        # pad positions (>= batch_size) are zeros.
+                        parts = []
+                        if s < batch_size:
+                            parts.append(self._sample_batch(
+                                sampler,
+                                range(base + s,
+                                      base + min(e, batch_size))))
+                        if e > batch_size:
+                            parts.append(self._zero_episodes(
+                                e - max(s, batch_size)))
+                        return self._concat_episodes(parts)
+
                     if self._multihost:
                         batch = assemble_global_batch(
-                            lambda s, e: self._sample_batch(
-                                sampler, range(base + s, base + e)),
-                            batch_size, mh_sharding,
+                            sample_range, padded_size, mh_sharding,
                             positions=mh_positions)
                     else:
-                        batch = self._sample_batch(
-                            sampler, range(base, base + batch_size))
+                        batch = sample_range(0, padded_size)
                     put_bounded(self._place(batch))
             except Exception as e:  # surface in consumer, don't hang
                 put_bounded(e)
@@ -240,7 +284,8 @@ class MetaLearningDataLoader:
                           num_iters: int) -> Iterator[Episode]:
         """Batches for train iterations [start_iter, start_iter+num_iters)."""
         return self._batches("train", start_iter, num_iters,
-                             self.cfg.batch_size)
+                             self.cfg.batch_size,
+                             pad_tasks=self.cfg.elastic_pad_tasks)
 
     def _eval_batches(self, split: str) -> Iterator[Episode]:
         cfg = self.cfg

@@ -50,7 +50,7 @@ from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
 from howtotrainyourmamlpytorch_tpu.ckpt.writer import CheckpointWriter
 from howtotrainyourmamlpytorch_tpu import resilience
 from howtotrainyourmamlpytorch_tpu.resilience import (
-    DivergenceGuard, cluster, faults, flightrec, watchdog)
+    DivergenceGuard, cluster, elastic, faults, flightrec, watchdog)
 from howtotrainyourmamlpytorch_tpu.resilience.flightrec import (
     write_crash_bundle)
 from howtotrainyourmamlpytorch_tpu.telemetry import (
@@ -84,6 +84,18 @@ class ExperimentBuilder:
         # chaos builder can't leak faults into a later clean builder.
         faults.configure(os.environ.get(faults.ENV_VAR, "")
                          or cfg.fault_spec)
+        # Elastic pod (resilience/elastic.py): a process restarted in
+        # place over a survivor roster carries the MAML_ELASTIC_* env
+        # trio; the config is degraded to that roster's geometry HERE,
+        # before any mesh/plan/loader consumes it (generation 0 — the
+        # ordinary case — returns the config untouched).
+        cfg, self._roster = elastic.apply_roster(cfg)
+        if self._roster is not None:
+            print(f"elastic: generation {self._roster.generation} roster "
+                  f"{list(self._roster.roster)} of "
+                  f"{self._roster.orig_processes} original hosts; mesh "
+                  f"{cfg.mesh_shape}, {cfg.elastic_pad_tasks} pad "
+                  f"task(s)", flush=True)
         self.paths = build_experiment_folder(cfg.experiment_root,
                                              cfg.experiment_name)
 
@@ -193,6 +205,10 @@ class ExperimentBuilder:
         # heartbeat leases + attributed peer-lost abort (exit 73). None
         # (the default) keeps every hook site a single None check.
         self._cluster: Optional[cluster.ClusterFaultDomain] = None
+        # Elastic policy (resilience/elastic.py): attached to the
+        # cluster domain for the run's duration iff elastic_mode=1 —
+        # the structural pin is `domain.elastic is None` when off.
+        self._elastic: Optional[elastic.ElasticPolicy] = None
         # Phase keys whose first REAL step call this process has made:
         # that call pays (or waits out) the XLA compile, so it runs
         # under the separate, much larger compile deadline.
@@ -941,6 +957,35 @@ class ExperimentBuilder:
             # Eager registration: a cluster-armed run must report
             # "0 peer losses", not omit the counter.
             self.registry.counter(cluster.PEER_LOSSES_COUNTER)
+            if elastic.elastic_enabled(cfg):
+                ros = self._roster
+                n = jax.process_count()
+                self._elastic = elastic.ElasticPolicy(
+                    lease_dir=self._cluster.lease.lease_dir,
+                    process_index=jax.process_index(),
+                    roster=(ros.roster if ros is not None
+                            else list(range(n))),
+                    generation=(ros.generation if ros is not None else 0),
+                    orig_processes=(ros.orig_processes
+                                    if ros is not None else n),
+                    max_lost_hosts=cfg.elastic_max_lost_hosts,
+                    timeout_s=elastic.reshard_timeout(cfg),
+                    mesh_dcn=int(cfg.mesh_shape[0]),
+                    lease=self._cluster.lease,
+                    registry=self.registry, jsonl=self.jsonl,
+                    prom_path=f"{self.paths['logs']}/metrics.prom")
+                self._cluster.elastic = self._elastic
+                # Eager registration + the generation gauge: an elastic
+                # run must report "0 reshards" (and its generation), not
+                # omit the section.
+                for name in (elastic.RESHARDS_COUNTER,
+                             elastic.DEGRADED_EPOCHS_COUNTER,
+                             elastic.RE_EXPANSIONS_COUNTER):
+                    self.registry.counter(name)
+                self.registry.gauge(elastic.GENERATION_GAUGE).set(
+                    float(self._elastic.generation))
+                self.registry.gauge(elastic.LOST_HOSTS_GAUGE).set(
+                    float(len(self._elastic.missing_hosts())))
         if wd_enabled:
             self._flightrec = flightrec.FlightRecorder(
                 cfg.flight_recorder_events)
@@ -1009,6 +1054,7 @@ class ExperimentBuilder:
                 self._cluster.close()
                 cluster.install(prev_cluster)
                 self._cluster = None
+                self._elastic = None
             if wd_enabled:
                 watchdog.install_beacon(prev_beacon)
                 flightrec.install(prev_recorder)
@@ -1175,6 +1221,14 @@ class ExperimentBuilder:
                     # host exiting while others start the next epoch would
                     # hang their first psum.
                     self._preempted = any_process_true(self._preempted)
+                if (not self._preempted and self._elastic is not None
+                        and self._elastic.degraded):
+                    # Degraded elastic segment: count the epoch and
+                    # probe for re-expansion (a backfilled host's
+                    # rejoin files completing the original roster).
+                    self.registry.counter(
+                        elastic.DEGRADED_EPOCHS_COUNTER).inc()
+                    self._maybe_re_expand()
             # Normal (non-preempt) exits wait for the deferred AOT
             # phase compiles to land in the store — the
             # cold-run-is-the-prewarm contract. Preempt returns above
@@ -1201,6 +1255,68 @@ class ExperimentBuilder:
             # resubmits instead of marking success.
             return {"preempted_at_iter": self.current_iter}
         return {"paused_at_iter": self.current_iter}
+
+    def _maybe_re_expand(self) -> None:
+        """Epoch-boundary re-expansion (docs/RESILIENCE.md § Elastic
+        pod): when every host missing from the degraded roster has a
+        rejoin file (a backfilled replacement waiting in
+        ``elastic.backfill_wait``), the survivors agree (one AND-reduced
+        collective, so a straggling filesystem view delays rather than
+        splits the decision), write the next-generation FULL roster,
+        drain checkpoints, and restart in place at the original
+        geometry from the committed epoch. Not ready: keep training
+        degraded — the probe costs one directory listing per epoch."""
+        pol = self._elastic
+        missing = pol.missing_hosts()
+        rejoins = elastic.read_rejoins(pol.lease_dir)
+        ready = all(h in rejoins for h in missing)
+        if self._multihost:
+            # AND across survivors: NOT any(NOT ready).
+            ready = not any_process_true(not ready)
+        if not ready:
+            return
+        # Everything queued must be committed before the image is
+        # replaced — the resumed full-roster run loads from the
+        # manifest this drain completes.
+        self.ckpt_writer.drain()
+        if self.is_main_process:
+            # A previous attempt's candidate socket (stale read-back
+            # below) must not leak its fd/port across retries.
+            prev_sock = getattr(self, "_re_expand_sock", None)
+            if prev_sock is not None:
+                try:
+                    prev_sock.close()
+                except OSError:
+                    pass
+            # The socket is pinned on self so the reserved port stays
+            # bound until exec (close-on-exec releases it exactly when
+            # the new image's coordination service needs it).
+            self._re_expand_sock, coord = \
+                elastic.bind_coordinator_candidate()
+            try:
+                elastic.write_roster(pol.lease_dir,
+                                     pol.full_roster_doc(coord))
+            except OSError as e:
+                # One storage hiccup must degrade to keep-training-
+                # degraded-and-retry (the elastic fail-soft rule), not
+                # kill the survivor run. The read-back below sees the
+                # unchanged generation and returns.
+                logging.getLogger(__name__).warning(
+                    "elastic re-expansion roster write failed (%s: %s); "
+                    "retrying at the next epoch boundary",
+                    type(e).__name__, e)
+        if self._multihost:
+            barrier("elastic_re_expand")
+        doc = elastic.read_roster(pol.lease_dir)
+        if doc is None or int(doc.get("generation", 0)) <= pol.generation:
+            # The roster write failed (or a stale read): keep training
+            # degraded and retry at the next boundary.
+            return
+        try:
+            self.ckpt_writer.close()
+        except Exception:
+            pass
+        pol.exec_into(doc)  # no return (tests inject pol._exec)
 
     def _handle_signal(self, signum=None, frame=None) -> None:
         """SIGTERM/SIGINT handler. First signal: request the graceful

@@ -223,9 +223,33 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
             health as health_mod)
 
     num_micro = cfg.task_microbatches  # >= 1, validated by the config
-    if cfg.batch_size % num_micro != 0:
+    if cfg.padded_batch_size % num_micro != 0:
         raise ValueError(f"task_microbatches {num_micro} must divide "
-                         f"batch_size {cfg.batch_size}")
+                         f"batch_size {cfg.padded_batch_size}")
+    # Elastic pad-and-mask (docs/RESILIENCE.md § Elastic pod): a degraded
+    # survivor mesh whose size does not divide the global meta-batch pads
+    # the batch with `elastic_pad_tasks` trailing zero episodes. Each
+    # task's per-task outputs are scaled by `padded/real` for real tasks
+    # and 0 for pads, so every downstream mean-over-padded-tasks (and the
+    # mesh pmean of those means) equals the exact mean over the REAL
+    # tasks — the serve-bucket zero-weight-padding idiom, applied to the
+    # meta-batch. pad == 0 (the default) takes none of these branches:
+    # the traced graph is exactly the pre-elastic one.
+    pad = cfg.elastic_pad_tasks
+
+    def _pad_scale(local_n: int) -> jax.Array:
+        """(local_n,) per-task scale for this shard: padded/real on real
+        global positions, 0 on pads (pads are the global TAIL; the batch
+        axis is dcn-major over `reduce_axes`, matching
+        parallel/mesh.py § batch_sharding)."""
+        total, real = cfg.padded_batch_size, cfg.batch_size
+        shard = jnp.int32(0)
+        for ax in (reduce_axes or ()):
+            shard = shard * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
+        positions = shard * local_n + jnp.arange(local_n)
+        return jnp.where(positions < real,
+                         jnp.float32(total) / jnp.float32(real),
+                         jnp.float32(0.0))
 
     def train_step(state: MetaTrainState, batch: Episode, epoch: jax.Array,
                    *, second_order: bool,
@@ -233,7 +257,7 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
         batch = normalize_episode(cfg, batch)  # uint8 wire format -> f32
         msl_w = per_step_loss_importance(cfg, epoch) if use_msl else None
 
-        def batch_loss(trainable, bn_state, chunk):
+        def batch_loss(trainable, bn_state, chunk, scale=None):
             def one_task(ep: Episode) -> TaskResult:
                 # Scope label survives into the HLO op metadata: trace
                 # captures attribute inner-loop work to "task_adapt"
@@ -245,6 +269,14 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
                         num_steps=num_steps, second_order=second_order,
                         use_msl=use_msl, msl_weights=msl_w)
             res = jax.vmap(one_task)(chunk)
+            if scale is not None:
+                # One scaling point: every per-task leaf (losses,
+                # accuracy, bn stats, per-step trajectories) is weighted
+                # before the means below, so pads contribute exactly 0
+                # and real tasks re-normalize the mean denominators.
+                res = jax.tree.map(
+                    lambda a: a * scale.reshape(
+                        scale.shape[:1] + (1,) * (a.ndim - 1)), res)
             # Mean over the task shard; under a mesh XLA turns these means
             # into psums over the tasks axis — the single collective per
             # outer step (per micro-chunk when accumulating).
@@ -263,24 +295,33 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
             return loss, aux
 
         trainable = {"params": state.params, "lslr": state.lslr}
+        # Per-shard pad scale (None when pad == 0 — the default; the
+        # trace is then byte-identical to the pre-elastic step).
+        scale = _pad_scale(batch.support_y.shape[0]) if pad else None
         if num_micro <= 1:
             (loss, aux), grads = jax.value_and_grad(
-                batch_loss, has_aux=True)(trainable, state.bn_state, batch)
+                batch_loss, has_aux=True)(trainable, state.bn_state, batch,
+                                          scale)
         else:
             # Gradient accumulation over task micro-batches: the memory
             # lever for pod-scale meta-batches (SURVEY.md §2.2). The mean
             # over the full batch equals the mean of equal-size chunk
             # means, so accumulating chunk grads/aux and dividing by the
-            # chunk count reproduces the single-shot math exactly.
+            # chunk count reproduces the single-shot math exactly (with
+            # a pad, the same holds for the weighted sums: chunk means
+            # of scaled leaves average to the exact real-task mean).
             chunked = jax.tree.map(
                 lambda x: x.reshape((num_micro, x.shape[0] // num_micro)
                                     + x.shape[1:]),
                 batch)
+            s_chunked = (scale.reshape((num_micro, -1))
+                         if scale is not None else None)
 
-            def one_chunk(carry, chunk):
+            def one_chunk(carry, xs):
+                chunk, s_c = xs if pad else (xs, None)
                 (loss_c, aux_c), grads_c = jax.value_and_grad(
                     batch_loss, has_aux=True)(trainable, state.bn_state,
-                                              chunk)
+                                              chunk, s_c)
                 carry = jax.tree.map(jnp.add, carry,
                                      ((loss_c, aux_c), grads_c))
                 return carry, None
@@ -289,10 +330,13 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
                 jnp.zeros_like,
                 jax.eval_shape(
                     lambda t, b: jax.value_and_grad(
-                        batch_loss, has_aux=True)(t, b, jax.tree.map(
-                            lambda x: x[0], chunked)),
+                        batch_loss, has_aux=True)(
+                        t, b, jax.tree.map(lambda x: x[0], chunked),
+                        s_chunked[0] if pad else None),
                     trainable, state.bn_state))
-            acc_out, _ = jax.lax.scan(one_chunk, zero, chunked)
+            acc_out, _ = jax.lax.scan(
+                one_chunk, zero,
+                (chunked, s_chunked) if pad else chunked)
             ((loss, aux), grads) = jax.tree.map(
                 lambda a: a / num_micro, acc_out)
 

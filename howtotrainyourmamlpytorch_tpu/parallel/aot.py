@@ -133,6 +133,10 @@ _RUNTIME_ONLY_KEYS = frozenset({
     "flight_recorder_events", "require_mesh",
     "cluster_collective_timeout_s", "cluster_lease_interval_s",
     "cluster_peer_stalled_s", "cluster_peer_dead_s",
+    # Elastic-pod POLICY knobs change no compiled program (and the
+    # survivor run must hit a store prewarmed without them); the
+    # DERIVED geometry (mesh_shape, elastic_pad_tasks) stays structural.
+    "elastic_mode", "elastic_max_lost_hosts", "elastic_reshard_timeout_s",
     "ckpt_async", "ckpt_queue_policy", "ckpt_publish",
     "serve_registry_poll_s", "serve_canary_episodes",
     "serve_canary_acc_drop", "serve_canary_latency_factor",
@@ -149,12 +153,20 @@ def enabled(cfg: MAMLConfig) -> bool:
     return bool(cfg.aot_store_dir)
 
 
-def fingerprint_doc(cfg: MAMLConfig, mesh) -> Dict[str, Any]:
+def fingerprint_doc(cfg: MAMLConfig, mesh,
+                    process_count: Optional[int] = None) -> Dict[str, Any]:
     """Everything that determines the compiled programs, as one JSON
     doc: the structural config resolution, jax/jaxlib + XLA backend
     versions, device kind, pod/mesh topology and the donation/sharding
     layout tag. Hashed by :func:`store_fingerprint`; recorded verbatim
-    in STORE.json so a mismatch is diagnosable, not just detected."""
+    in STORE.json so a mismatch is diagnosable, not just detected.
+
+    ``process_count`` overrides the live ``jax.process_count()`` — the
+    degraded-roster prewarm (``scripts/aot_prewarm.py --degraded``)
+    compiles executables FOR a survivor topology it is not running AS,
+    and the store they land in must be the one the survivor group's own
+    fingerprint resolves after the reshard. One store root legally
+    holds every roster's fingerprint dir side by side."""
     import jaxlib
 
     devices = list(mesh.devices.flat)
@@ -173,15 +185,17 @@ def fingerprint_doc(cfg: MAMLConfig, mesh) -> Dict[str, Any]:
         "backend_version": backend_version,
         "device_kind": devices[0].device_kind,
         "num_devices": len(devices),
-        "process_count": jax.process_count(),
+        "process_count": (int(process_count) if process_count is not None
+                          else jax.process_count()),
         "mesh_shape": list(mesh.devices.shape),
         "mesh_axes": list(mesh.axis_names),
         "layout": LAYOUT_TAG,
     }
 
 
-def store_fingerprint(cfg: MAMLConfig, mesh) -> str:
-    doc = fingerprint_doc(cfg, mesh)
+def store_fingerprint(cfg: MAMLConfig, mesh,
+                      process_count: Optional[int] = None) -> str:
+    doc = fingerprint_doc(cfg, mesh, process_count=process_count)
     blob = json.dumps(doc, sort_keys=True, default=str).encode()
     return hashlib.sha256(blob).hexdigest()
 
@@ -356,13 +370,20 @@ class AOTStore:
 
     @classmethod
     def from_config(cls, cfg: MAMLConfig, mesh, registry=None,
-                    writer: bool = True) -> Optional["AOTStore"]:
-        """The wiring entry point: None when the subsystem is off."""
+                    writer: bool = True,
+                    process_count: Optional[int] = None
+                    ) -> Optional["AOTStore"]:
+        """The wiring entry point: None when the subsystem is off.
+        ``process_count`` overrides the topology fingerprint for
+        degraded-roster prewarms (see :func:`fingerprint_doc`)."""
         if not enabled(cfg):
             return None
-        return cls(cfg.aot_store_dir, store_fingerprint(cfg, mesh),
-                   doc=fingerprint_doc(cfg, mesh), registry=registry,
-                   writer=writer)
+        return cls(cfg.aot_store_dir,
+                   store_fingerprint(cfg, mesh,
+                                     process_count=process_count),
+                   doc=fingerprint_doc(cfg, mesh,
+                                       process_count=process_count),
+                   registry=registry, writer=writer)
 
     # -- internals -------------------------------------------------------
     def _count(self, name: str, value: float = 1) -> None:
@@ -725,7 +746,7 @@ def adopt_train_plan(cfg: MAMLConfig, plan: MeshPlan, mesh, store: AOTStore,
     warmup thread), so a cold start's time-to-first-step pays only the
     FIRST phase executable, not the whole schedule's."""
     savals = state_avals(state, mesh)
-    train_batch = episode_aval(cfg, mesh, cfg.batch_size)
+    train_batch = episode_aval(cfg, mesh, cfg.padded_batch_size)
     eval_batch = episode_aval(cfg, mesh, cfg.effective_eval_batch_size)
     hits = misses = 0
     deferred: List[Tuple[Tuple[bool, bool], str, Tuple]] = []

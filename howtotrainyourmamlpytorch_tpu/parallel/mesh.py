@@ -164,17 +164,18 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
     tests/test_hlo_collectives.py walks the optimized HLO and fails on
     anything else.
     """
-    if cfg.batch_size % mesh.size != 0:
+    if cfg.padded_batch_size % mesh.size != 0:
         raise ValueError(
-            f"batch_size {cfg.batch_size} not divisible by mesh size "
-            f"{mesh.size}")
+            f"batch_size {cfg.padded_batch_size} (incl. "
+            f"{cfg.elastic_pad_tasks} elastic pad tasks) not divisible "
+            f"by mesh size {mesh.size}")
     if cfg.effective_eval_batch_size % mesh.size != 0:
         raise ValueError(
             f"eval batch size {cfg.effective_eval_batch_size} not "
             f"divisible by mesh size {mesh.size}")
     eff = cfg.effective_task_microbatches(mesh.size)
     if eff != cfg.task_microbatches:
-        local = cfg.batch_size // mesh.size
+        local = cfg.padded_batch_size // mesh.size
         if eff == 1 and cfg.task_microbatches > 1 and local > 1:
             # ADVICE r4: a value that degrades to gcd 1 at a multi-task
             # shard shares NO factor with the geometry — it was never a
@@ -273,3 +274,63 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
     )
     return MeshPlan(mesh=mesh, train_steps=train_steps,
                     eval_step=eval_step, aot_train_steps=aot_train_steps)
+
+
+# ---------------------------------------------------------------------------
+# degraded-mesh plan derivation (elastic pod, resilience/elastic.py)
+
+def degraded_mesh_shape(mesh_shape: Sequence[int], survivors: int,
+                        orig_processes: int) -> Tuple[int, ...]:
+    """The survivor-roster mesh: the ``dcn`` (host) axis shrinks to the
+    surviving process count; the per-host ``tasks`` axis is untouched
+    (each survivor still owns all of its local chips). Refuses
+    geometries where the dcn axis is not the host axis — scaling a
+    mesh whose first axis does not track processes would silently
+    build a mesh the survivor group cannot realize."""
+    shape = tuple(int(v) for v in mesh_shape)
+    if shape[0] != int(orig_processes):
+        raise ValueError(
+            f"mesh_shape {shape} has dcn extent {shape[0]} but the "
+            f"original roster had {orig_processes} processes; elastic "
+            f"degradation only knows how to shrink a per-host dcn axis")
+    if not 1 <= int(survivors) <= int(orig_processes):
+        raise ValueError(
+            f"survivor count {survivors} outside [1, {orig_processes}]")
+    return (int(survivors),) + shape[1:]
+
+
+def derive_degraded_config(cfg: MAMLConfig, survivors: int,
+                           orig_processes: int) -> MAMLConfig:
+    """The config a survivor roster of ``survivors`` hosts runs: same
+    workload, re-partitioned geometry.
+
+    * ``mesh_shape`` — dcn axis shrunk to the survivor count.
+    * ``elastic_pad_tasks`` — the global meta-batch stays ``batch_size``
+      REAL tasks; when the degraded mesh size no longer divides it, the
+      batch is padded up with zero-weight tasks that the train step
+      masks exactly (meta/outer.py § _pad_scale — the serve bucket
+      padding idiom). The optimizer trajectory is a pure function of
+      (config, roster, committed epoch): a restarted-in-place survivor
+      group and a cold run launched directly at the survivor geometry
+      derive the SAME config here and train bitwise identically.
+    * ``task_microbatches`` — pre-resolved through
+      ``effective_task_microbatches`` at the degraded geometry so the
+      recorded config matches what executes.
+    * ``eval_batch_size`` — pinned to the original effective value
+      rounded up to a degraded-mesh multiple (eval pads are real extra
+      episodes; ``_evaluate`` truncates to ``num_evaluation_tasks``).
+
+    A full roster (``survivors == orig_processes``) returns ``cfg``
+    unchanged — re-expansion resumes the original geometry bit-for-bit.
+    """
+    if int(survivors) == int(orig_processes) and not cfg.elastic_pad_tasks:
+        return cfg
+    shape = degraded_mesh_shape(cfg.mesh_shape, survivors, orig_processes)
+    m = int(np.prod(shape))
+    pad = (-cfg.batch_size) % m
+    eval_b = cfg.effective_eval_batch_size
+    eval_b = -(-eval_b // m) * m
+    derived = cfg.replace(mesh_shape=shape, elastic_pad_tasks=pad,
+                          eval_batch_size=eval_b)
+    return derived.replace(
+        task_microbatches=derived.effective_task_microbatches(m))

@@ -333,6 +333,11 @@ class ClusterFaultDomain:
         self.bundle_dir = bundle_dir
         self.prom_path = prom_path
         self.on_trip = on_trip
+        # Elastic pod (resilience/elastic.py): when installed, an
+        # attributed within-budget peer loss routes to a coordinated
+        # reshard instead of the exit below. None (elastic_mode=0, the
+        # default) keeps the exit-73 path byte-for-byte unchanged.
+        self.elastic: Optional[Any] = None
         self.tripped: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
         self._backstop: Optional[threading.Timer] = None
@@ -436,6 +441,28 @@ class ClusterFaultDomain:
                     float(suspects[0]) if suspects else -1.0)
             except Exception:
                 pass
+        # Elastic routing (resilience/elastic.py): an attributed loss
+        # within the lost-host budget reshards instead of exiting —
+        # initiate() execs into the survivor generation and never
+        # returns. Any refusal (unattributed, over budget, consensus
+        # timeout, roster excluded us) falls through to the ordinary
+        # attributed exit 73 below. The backstop is re-armed for the
+        # consensus window first, so a reshard that wedges (dead shared
+        # storage) still escalates to the exit rather than hanging the
+        # survivor forever.
+        policy = self.elastic
+        if policy is not None and policy.should_reshard(suspects):
+            backstop = self._backstop
+            if backstop is not None:
+                backstop.cancel()
+            self._backstop = threading.Timer(
+                policy.timeout_s + max(self.collective_timeout_s, 1.0),
+                self.trip_peer_lost, args=(info,))
+            self._backstop.daemon = True
+            self._backstop.start()
+            if policy.initiate(row, ages, suspects):
+                self.close()  # injected-exec (tests): the run continues
+                return
         if self.bundle_dir:
             try:
                 flightrec.write_crash_bundle(

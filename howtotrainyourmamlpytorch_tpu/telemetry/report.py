@@ -23,8 +23,12 @@ from typing import Any, Dict, List, Optional, Union
 # resilience/cluster.py: peer losses, suspect attribution, consensus
 # resume, lease ages); v9: + "warm_start" (AOT executable store,
 # parallel/aot.py: hits/misses/load seconds + per-session
-# time-to-first-step and the compiles-before-first-dispatch count)
-SCHEMA = "maml_tpu_telemetry_report_v9"
+# time-to-first-step and the compiles-before-first-dispatch count);
+# v10: + "elastic" (elastic pod, resilience/elastic.py: reshard events,
+# current/lost roster, degraded-epoch count, re-expansions — counters
+# reset-aware across the restart-in-place segments the subsystem
+# creates by design)
+SCHEMA = "maml_tpu_telemetry_report_v10"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -473,6 +477,65 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "sessions": ws_rows,
         }
 
+    # Elastic section (resilience/elastic.py, schema v10): a resharding
+    # run EXECs itself per generation, so every counter crosses a
+    # process boundary — reshards/degraded epochs/re-expansions
+    # accumulate reset-aware (cross-checked against the explicit
+    # elastic_reshard / elastic_re_expand event rows, which survive
+    # even when the pre-exec registry flush was lost); the generation,
+    # roster and lost-host count track the most recent signal in log
+    # order — the liveness picture at the end of the log. Runs without
+    # elastic_mode summarize to "unavailable".
+    el_totals: Dict[str, float] = {}
+    el_prev: Dict[str, float] = {}
+    el_reshard_rows = 0
+    el_expand_rows = 0
+    el_seen = False
+    el_generation: Metric = UNAVAILABLE
+    el_roster: Union[List[int], str] = UNAVAILABLE
+    el_lost: Metric = UNAVAILABLE
+    for e in events:
+        if e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if not any(k.startswith("elastic/") for k in m):
+                continue
+            el_seen = True
+            for key in ("elastic/reshards", "elastic/degraded_epochs",
+                        "elastic/re_expansions"):
+                if m.get(key) is not None:
+                    _accumulate_counter(el_totals, el_prev, key,
+                                        float(m[key]))
+            if m.get("elastic/generation") is not None:
+                el_generation = int(m["elastic/generation"])
+            if m.get("elastic/lost_hosts") is not None:
+                el_lost = int(m["elastic/lost_hosts"])
+        elif e.get("event") in ("elastic_reshard", "elastic_re_expand"):
+            el_seen = True
+            if e.get("event") == "elastic_reshard":
+                el_reshard_rows += 1
+            else:
+                el_expand_rows += 1
+            if e.get("generation") is not None:
+                el_generation = int(e["generation"])
+            if isinstance(e.get("roster"), list):
+                el_roster = [int(h) for h in e["roster"]]
+            if isinstance(e.get("dead"), list):
+                el_lost = len(e["dead"])
+    elastic_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if el_seen:
+        elastic_sec = {
+            "reshards": max(int(el_totals.get("elastic/reshards", 0)),
+                            el_reshard_rows),
+            "re_expansions": max(
+                int(el_totals.get("elastic/re_expansions", 0)),
+                el_expand_rows),
+            "degraded_epochs": int(
+                el_totals.get("elastic/degraded_epochs", 0)),
+            "generation": el_generation,
+            "roster": el_roster,
+            "lost_hosts": el_lost,
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -509,6 +572,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "checkpoint": ckpt_sec,
         "cluster": cluster_sec,
         "warm_start": warm_start_sec,
+        "elastic": elastic_sec,
     }
 
 
@@ -543,6 +607,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("checkpoint", summary["checkpoint"]),
         ("cluster", summary["cluster"]),
         ("warm start", summary["warm_start"]),
+        ("elastic", summary["elastic"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

@@ -55,6 +55,21 @@ def main(argv=None) -> int:
     ap.add_argument("--serve", action="store_true",
                     help="also prewarm the serve buckets' adapt/predict "
                          "executables (ServingEngine.warmup's set)")
+    ap.add_argument("--degraded", type=int, default=0, metavar="K",
+                    help="also prewarm the N-1..N-K survivor-roster "
+                         "topologies (elastic pod, resilience/elastic.py):"
+                         " each k derives the degraded config exactly as "
+                         "a resharded survivor group would and stores its"
+                         " executables under that roster's fingerprint, "
+                         "so the reshard pays zero compiles. Multi-host "
+                         "survivor topologies must be prewarmed on a "
+                         "machine exposing the survivor device count; "
+                         "unrealizable ones are recorded as skipped.")
+    ap.add_argument("--degraded-only", action="store_true",
+                    help="skip the full-roster executables (useful when "
+                         "the full topology is prewarmed by the pod "
+                         "itself and this box only covers the degraded "
+                         "rosters)")
     ap.add_argument("--backend-timeout", type=float, default=600.0,
                     help="seconds to poll for JAX backend availability "
                          "(0 = fail on first init error)")
@@ -94,8 +109,11 @@ def main(argv=None) -> int:
         make_mesh, make_sharded_steps)
     from howtotrainyourmamlpytorch_tpu.serve.adapt import make_serve_steps
 
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+        derive_degraded_config)
+
     n_mesh = int(np.prod(cfg.mesh_shape))
-    if n_mesh > len(devices):
+    if n_mesh > len(devices) and not args.degraded_only:
         print(json.dumps({"metric": "aot_prewarm", "ok": False,
                           "error": f"mesh_shape {cfg.mesh_shape} needs "
                                    f"{n_mesh} devices, got "
@@ -103,77 +121,115 @@ def main(argv=None) -> int:
                                    f"on the job's topology (the "
                                    f"fingerprint records it)"}))
         return 1
-    cfg = cfg.replace(
-        task_microbatches=cfg.effective_task_microbatches(n_mesh))
-    mesh = make_mesh(cfg, devices[:n_mesh])
-    model_init, apply_fn = make_model(cfg)
-    plan = make_sharded_steps(cfg, apply_fn, mesh)
-    store = aot.AOTStore.from_config(cfg, mesh)
-
-    # Avals only — the prewarmer never allocates a training state.
-    template = jax.eval_shape(
-        lambda: init_train_state(cfg, model_init,
-                                 jax.random.PRNGKey(cfg.seed)))
-    savals = aot.state_avals(template, mesh)
-
-    phase_keys, seen = [], set()
-    for e in range(cfg.total_epochs):
-        key = (cfg.use_second_order(e), cfg.use_msl(e))
-        if key not in seen:
-            seen.add(key)
-            phase_keys.append(key)
 
     executables = []
     hits = misses = failures = 0
     t_start = time.perf_counter()
+    stores = []
 
-    def warm_one(name, jit_fn, avals):
+    def prewarm_topology(tcfg, label, process_count=None):
+        """Every executable one (cfg, topology) pair needs, into that
+        pair's fingerprint dir of the shared store root."""
         nonlocal hits, misses, failures
-        t0 = time.perf_counter()
-        _, hit = aot.load_or_compile(store, name, jit_fn, avals)
-        ready = store.manifest.get(name) is not None and \
-            store.manifest.get(name).get("status") == "committed"
-        hits, misses = hits + hit, misses + (not hit)
-        if not ready:
-            failures += 1
-        executables.append({
-            "name": name,
-            "disposition": "hit" if hit else
-                           ("compiled" if ready else "failed"),
-            "seconds": round(time.perf_counter() - t0, 3)})
-        print(json.dumps(executables[-1]), flush=True)
+        t_mesh = int(np.prod(tcfg.mesh_shape))
+        tcfg = tcfg.replace(
+            task_microbatches=tcfg.effective_task_microbatches(t_mesh))
+        mesh = make_mesh(tcfg, devices[:t_mesh])
+        model_init, apply_fn = make_model(tcfg)
+        plan = make_sharded_steps(tcfg, apply_fn, mesh)
+        store = aot.AOTStore.from_config(tcfg, mesh,
+                                         process_count=process_count)
+        stores.append(store)
 
-    train_batch = aot.episode_aval(cfg, mesh, cfg.batch_size)
-    for key in phase_keys:
-        # The store holds the UNDONATED twins (parallel/mesh.py §
-        # MeshPlan): deserialized donating executables are unsafe.
-        warm_one(aot.train_exec_name(key), plan.aot_train_steps[key],
-                 (savals, train_batch, aot.epoch_aval()))
-    warm_one("eval", plan.eval_step,
-             (savals, aot.episode_aval(cfg, mesh,
-                                       cfg.effective_eval_batch_size)))
+        # Avals only — the prewarmer never allocates a training state.
+        template = jax.eval_shape(
+            lambda: init_train_state(tcfg, model_init,
+                                     jax.random.PRNGKey(tcfg.seed)))
+        savals = aot.state_avals(template, mesh)
 
-    if args.serve:
-        steps = make_serve_steps(cfg, apply_fn, mesh)
-        # Signatures from aot's shared builders — the engine adopts
-        # through the SAME ones (serve/engine.py § _adopt_serve_bucket),
-        # so a prewarmed name can never carry a signature the engine
-        # would demote on first call.
-        done_s, done_q = set(), set()
-        for s_b, q_b in cfg.serve_bucket_shapes:
-            adapt_avals = aot.serve_adapt_avals(
-                cfg, mesh, savals.params, savals.lslr, savals.bn_state,
-                s_b)
-            if s_b not in done_s:
-                done_s.add(s_b)
-                warm_one(aot.serve_adapt_name(s_b), steps.aot_adapt,
-                         adapt_avals)
-            if q_b not in done_q:
-                done_q.add(q_b)
-                warm_one(aot.serve_predict_name(q_b), steps.aot_predict,
-                         aot.serve_predict_avals(
-                             cfg, mesh, steps.adapt, adapt_avals,
-                             savals.params, q_b))
+        phase_keys, seen = [], set()
+        for e in range(tcfg.total_epochs):
+            key = (tcfg.use_second_order(e), tcfg.use_msl(e))
+            if key not in seen:
+                seen.add(key)
+                phase_keys.append(key)
+
+        def warm_one(name, jit_fn, avals):
+            nonlocal hits, misses, failures
+            t0 = time.perf_counter()
+            _, hit = aot.load_or_compile(store, name, jit_fn, avals)
+            ready = store.manifest.get(name) is not None and \
+                store.manifest.get(name).get("status") == "committed"
+            hits, misses = hits + hit, misses + (not hit)
+            if not ready:
+                failures += 1
+            executables.append({
+                "name": (f"{label}:{name}" if label else name),
+                "disposition": "hit" if hit else
+                               ("compiled" if ready else "failed"),
+                "seconds": round(time.perf_counter() - t0, 3)})
+            print(json.dumps(executables[-1]), flush=True)
+
+        train_batch = aot.episode_aval(tcfg, mesh,
+                                       tcfg.padded_batch_size)
+        for key in phase_keys:
+            # The store holds the UNDONATED twins (parallel/mesh.py §
+            # MeshPlan): deserialized donating executables are unsafe.
+            warm_one(aot.train_exec_name(key), plan.aot_train_steps[key],
+                     (savals, train_batch, aot.epoch_aval()))
+        warm_one("eval", plan.eval_step,
+                 (savals, aot.episode_aval(
+                     tcfg, mesh, tcfg.effective_eval_batch_size)))
+
+        if args.serve:
+            steps = make_serve_steps(tcfg, apply_fn, mesh)
+            # Signatures from aot's shared builders — the engine adopts
+            # through the SAME ones (serve/engine.py §
+            # _adopt_serve_bucket), so a prewarmed name can never carry
+            # a signature the engine would demote on first call.
+            done_s, done_q = set(), set()
+            for s_b, q_b in tcfg.serve_bucket_shapes:
+                adapt_avals = aot.serve_adapt_avals(
+                    tcfg, mesh, savals.params, savals.lslr,
+                    savals.bn_state, s_b)
+                if s_b not in done_s:
+                    done_s.add(s_b)
+                    warm_one(aot.serve_adapt_name(s_b), steps.aot_adapt,
+                             adapt_avals)
+                if q_b not in done_q:
+                    done_q.add(q_b)
+                    warm_one(aot.serve_predict_name(q_b),
+                             steps.aot_predict,
+                             aot.serve_predict_avals(
+                                 tcfg, mesh, steps.adapt, adapt_avals,
+                                 savals.params, q_b))
+
+    if not args.degraded_only:
+        prewarm_topology(cfg, label="")
+
+    # Degraded survivor rosters (elastic pod): derive each N-k config
+    # EXACTLY as a resharded survivor group would (parallel/mesh.py §
+    # derive_degraded_config) and stamp its fingerprint with the
+    # survivor process count, so the restart-in-place reshard resolves
+    # this store dir and pays zero compiles. Rosters whose mesh this
+    # box cannot realize are recorded as skipped, not failed — a
+    # laptop legitimately prewarms only the rosters it can compile.
+    orig_processes = int(cfg.mesh_shape[0])
+    for k in range(1, max(args.degraded, 0) + 1):
+        survivors = orig_processes - k
+        if survivors < 1:
+            break
+        dcfg = derive_degraded_config(cfg, survivors, orig_processes)
+        d_mesh = int(np.prod(dcfg.mesh_shape))
+        label = f"degraded{survivors}"
+        if d_mesh > len(devices):
+            executables.append({"name": f"{label}:*",
+                                "disposition": "skipped",
+                                "reason": f"needs {d_mesh} devices, "
+                                          f"have {len(devices)}"})
+            print(json.dumps(executables[-1]), flush=True)
+            continue
+        prewarm_topology(dcfg, label=label, process_count=survivors)
 
     ok = failures == 0
     print(json.dumps({
@@ -185,8 +241,9 @@ def main(argv=None) -> int:
         "misses": misses,
         "failures": failures,
         "seconds": round(time.perf_counter() - t_start, 3),
-        "store_dir": store.dir,
-        "fingerprint": store.fingerprint,
+        "store_dir": (stores[-1].dir if stores else None),
+        "fingerprint": (stores[-1].fingerprint if stores else None),
+        "fingerprints": [s.fingerprint for s in stores],
         "workload": cfg.experiment_name,
         "executables": executables,
     }), flush=True)

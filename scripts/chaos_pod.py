@@ -282,6 +282,175 @@ def run_restart(out: str, committed_epoch, committed_crc) -> dict:
     return facts
 
 
+def _last_json(text: str):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return {}
+
+
+def run_elastic(out: str) -> dict:
+    """Elastic phases (ISSUE 12 acceptance): kill a peer mid-epoch with
+    ``elastic_mode=1`` — the survivor reshards onto the N-1 mesh within
+    one collective budget, resumes from the committed epoch with ZERO
+    XLA compiles (degraded-prewarmed AOT store), and finishes the run;
+    then a COLD run launched directly at the survivor geometry from a
+    snapshot of the same committed state must produce bitwise-identical
+    final weights."""
+    from howtotrainyourmamlpytorch_tpu.resilience import elastic as el
+
+    eout = os.path.join(out, "elastic")
+    store = os.path.join(eout, "aot_store")
+    os.makedirs(eout, exist_ok=True)
+    cfg = pod_cfg_dict(eout, aot_store_dir=store,
+                       elastic_mode=1, elastic_max_lost_hosts=1)
+    cfg_path = os.path.join(eout, "elastic_cfg.json")
+    with open(cfg_path, "w") as f:
+        json.dump(cfg, f)
+
+    base_env = dict(os.environ)
+    for key in ("MAML_FAULTS", "JAX_COORDINATOR_ADDRESS",
+                "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                el.GEN_ENV, el.ROSTER_ENV, el.ORIG_ENV):
+        base_env.pop(key, None)
+    base_env.update({
+        "MAML_JAX_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                      + " --xla_force_host_platform_device_count=4"
+                      ).strip(),
+    })
+
+    # 1. Prewarm the SURVIVOR topology (N-1 = 1 host x 4 chips) into the
+    # shared store — the reshard must pay zero compiles.
+    prew = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "aot_prewarm.py"),
+         "--config", cfg_path, "--degraded", "1", "--degraded-only"],
+        env=base_env, capture_output=True, text=True, timeout=1800)
+    prew_art = _last_json(prew.stdout)
+
+    # 2. Kill host 1 mid-epoch-1; host 0 must reshard and keep going.
+    procs, logs = launch_pod(eout, cfg, fault_host=1,
+                             fault_spec=f"kill_peer@{KILL_ITER}")
+    victim, survivor = procs[1], procs[0]
+    try:
+        victim.wait(timeout=1800)
+    except subprocess.TimeoutExpired:
+        pass
+    victim_dead_at = time.time()
+
+    # 3. Snapshot the committed state for the cold-parity leg while the
+    # survivor is still stranded in its collective (the trip needs a
+    # full collective budget to fire, the epoch-0 files have been
+    # stable since iter 4). Wait for the manifest to show epoch 0
+    # committed first — the async writer may still be draining.
+    cold_root = os.path.join(out, "elastic_cold")
+    for _ in range(40):
+        epoch, it, crc0 = committed_view(eout)
+        if epoch == 0:
+            break
+        time.sleep(0.25)
+    shutil.rmtree(cold_root, ignore_errors=True)
+    shutil.copytree(os.path.join(eout, "pod_chaos"),
+                    os.path.join(cold_root, "pod_chaos"))
+
+    try:
+        survivor.wait(timeout=1800)
+    except subprocess.TimeoutExpired:
+        pass
+    wait_all(procs, logs, timeout_s=5.0)
+
+    roster_path = os.path.join(eout, "pod_chaos", "cluster",
+                               "ROSTER.json")
+    roster_doc, reshard_latency = {}, None
+    if os.path.exists(roster_path):
+        reshard_latency = os.path.getmtime(roster_path) - victim_dead_at
+        with open(roster_path) as f:
+            roster_doc = json.load(f)
+    events = read_events(eout)
+    reshards = [e for e in events if e.get("event") == "elastic_reshard"]
+    warms = [e for e in events if e.get("event") == "warm_start"]
+    last_warm = warms[-1] if warms else {}
+    with open(os.path.join(eout, "worker0.log")) as f:
+        w0 = f.read()
+    final_ckpt = os.path.join(eout, "pod_chaos", "saved_models",
+                              "train_model_1.ckpt")
+    crc_elastic = None
+    if os.path.exists(final_ckpt):
+        with open(final_ckpt, "rb") as f:
+            crc_elastic = zlib.crc32(f.read())
+
+    facts = {
+        "prewarm_ok": bool(prew_art.get("ok")),
+        "victim_exit_code": victim.returncode,
+        "survivor_exit_code": survivor.returncode,
+        "reshard_latency_s": (round(reshard_latency, 3)
+                              if reshard_latency is not None else None),
+        "reshard_rows": len(reshards),
+        "reshard_suspects": (reshards[-1].get("suspects")
+                             if reshards else None),
+        "roster_generation": roster_doc.get("generation"),
+        "roster": roster_doc.get("roster"),
+        "warm_compiles_before_first_step": last_warm.get(
+            "compiles_before_first_step"),
+        "warm_aot_misses": last_warm.get("aot_misses"),
+        "resumed_at_iter_4": "at iter 4" in w0.split("elastic:")[-1],
+        "test_protocol_ran": "test:" in w0,
+        "final_ckpt_crc": crc_elastic,
+    }
+    facts["kill_ok"] = bool(
+        facts["prewarm_ok"]
+        and victim.returncode == -9
+        and survivor.returncode == 0          # NOT 73: it kept training
+        and facts["reshard_rows"] >= 1
+        and facts["reshard_suspects"] == [1]
+        and facts["roster_generation"] == 1
+        and facts["roster"] == [0]
+        and reshard_latency is not None
+        and reshard_latency <= COLLECTIVE_TIMEOUT_S + TRIP_SLACK_S
+        and facts["warm_compiles_before_first_step"] == 0
+        and facts["warm_aot_misses"] == 0
+        and facts["resumed_at_iter_4"]
+        and facts["test_protocol_ran"]
+        and crc_elastic is not None)
+    if not facts["kill_ok"]:
+        facts["survivor_log_tail"] = w0[-1500:]
+        facts["prewarm_tail"] = (prew.stdout + prew.stderr)[-800:]
+        return facts
+
+    # 4. Cold N-1 parity: launch ONE process directly at the survivor
+    # geometry (same roster env, same shared store) from the snapshot;
+    # its continued training must be bitwise the survivor's.
+    cold_env = dict(base_env)
+    cold_env.update({el.GEN_ENV: "1", el.ROSTER_ENV: "0",
+                     el.ORIG_ENV: str(NUM_PROCESSES)})
+    cold = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "train_maml_system.py"),
+         "--name_of_args_json_file", cfg_path,
+         "--experiment_root", cold_root],
+        env=cold_env, capture_output=True, text=True, timeout=1800)
+    cold_ckpt = os.path.join(cold_root, "pod_chaos", "saved_models",
+                             "train_model_1.ckpt")
+    crc_cold = None
+    if os.path.exists(cold_ckpt):
+        with open(cold_ckpt, "rb") as f:
+            crc_cold = zlib.crc32(f.read())
+    facts.update({
+        "cold_exit_code": cold.returncode,
+        "cold_final_ckpt_crc": crc_cold,
+        "bitwise_equal_cold_n1": bool(crc_cold is not None
+                                      and crc_cold == crc_elastic),
+    })
+    facts["ok"] = bool(facts["kill_ok"] and cold.returncode == 0
+                       and facts["bitwise_equal_cold_n1"])
+    if not facts["ok"]:
+        facts["cold_log_tail"] = (cold.stdout + cold.stderr)[-1500:]
+    return facts
+
+
 def run_parity(out: str) -> dict:
     """Phase 3: all cluster knobs at 0/off vs armed — bitwise-identical
     weights and cache-warm compile counts (the watchdog standard)."""
@@ -301,6 +470,8 @@ def run_parity(out: str) -> dict:
     on_kw = dict(cluster_collective_timeout_s=300.0,
                  cluster_lease_interval_s=0.1)
     off_kw = dict(cluster_collective_timeout_s=0.0)
+    elastic_kw = dict(cluster_collective_timeout_s=300.0,
+                      cluster_lease_interval_s=0.1, elastic_mode=1)
     # Run 1 (off) pays the process's cold compiles; the on/off pair is
     # equally cache-warm, so their compile counts isolate the domain.
     single("parity_cold", **off_kw)
@@ -308,16 +479,28 @@ def run_parity(out: str) -> dict:
     compiles_on = b_on.registry.counter("compile/count").value
     b_off = single("parity_off", **off_kw)
     compiles_off = b_off.registry.counter("compile/count").value
+    # Elastic leg: policy installed (cluster on + elastic_mode=1) but it
+    # never fires — weights and compile counts must stay identical (the
+    # zero-cost-when-armed half of the elastic_mode=0 parity pin).
+    b_el = single("parity_elastic", **elastic_kw)
+    compiles_el = b_el.registry.counter("compile/count").value
     weights_equal = all(
         np.array_equal(np.asarray(a), np.asarray(b))
         for a, b in zip(jax.tree.leaves(b_on.state.params),
                         jax.tree.leaves(b_off.state.params)))
+    weights_equal_elastic = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(b_el.state.params),
+                        jax.tree.leaves(b_off.state.params)))
     facts = {
         "weights_equal": weights_equal,
+        "weights_equal_elastic": weights_equal_elastic,
         "compiles_on": int(compiles_on),
         "compiles_off": int(compiles_off),
+        "compiles_elastic": int(compiles_el),
     }
-    facts["ok"] = bool(weights_equal and compiles_on == compiles_off)
+    facts["ok"] = bool(weights_equal and weights_equal_elastic
+                       and compiles_on == compiles_off == compiles_el)
     return facts
 
 
@@ -328,8 +511,8 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=None, metavar="DIR",
                     help="experiment root (default: fresh temp dir, "
                          "removed on success)")
-    ap.add_argument("--phases", default="peer_kill,restart,parity",
-                    help="comma list of peer_kill,restart,parity")
+    ap.add_argument("--phases", default="peer_kill,restart,parity,elastic",
+                    help="comma list of peer_kill,restart,parity,elastic")
     ap.add_argument("--quick", action="store_true",
                     help="accepted for CLI symmetry; the config is "
                          "already CI-sized")
@@ -379,6 +562,11 @@ def main(argv=None) -> int:
             results.update(
                 {f"parity_{k}": v for k, v in run_parity(out).items()})
             ok = ok and results["parity_ok"]
+        elif phase == "elastic":
+            results.update(
+                {f"elastic_{k}": v for k, v in run_elastic(out).items()})
+            ok = ok and results.get("elastic_ok",
+                                    results["elastic_kill_ok"])
         else:
             raise SystemExit(f"unknown phase {phase!r}")
 

@@ -53,6 +53,15 @@ PROFILES = {
     "core": "core",          # the <5-minute pre-commit gate
 }
 
+# Tier-1 time-budget tripwire: the driver runs the quick profile under
+# `timeout -k 10 870` (ROADMAP.md § Tier-1 verify). Past this floor the
+# suite is one slow new test away from a silent timeout-kill, so a
+# quick-profile run whose summed shard wall-clock crosses it warns
+# loudly and stamps the artifact — new tests must fit the headroom or
+# ride the slow profile.
+TIER1_DRIVER_BUDGET_S = 870.0
+TIER1_WARN_S = 800.0
+
 
 def collect_shards(n_shards: int) -> list:
     """Per-file shards, round-robin over the size-sorted file list so
@@ -195,6 +204,22 @@ def main(argv=None) -> int:
              for k in ("passed", "failed", "errors", "skipped",
                        "xfailed", "xpassed", "deselected")}
     ok = bool(results) and all(r["ok"] for r in results)
+    # Tier-1 budget tripwire: the quick profile's summed shard seconds
+    # approximate one sequential `pytest -m 'not slow'` run — the thing
+    # the 870s driver timeout actually kills. Recorded for every
+    # profile; warned only for quick (the full profile legitimately
+    # runs for an hour+).
+    shard_seconds = {str(r["shard"]): r["seconds"] for r in results}
+    tier1_seconds = round(sum(r["seconds"] for r in results), 1)
+    tier1_exceeded = (args.profile == "quick"
+                      and only is None
+                      and tier1_seconds > TIER1_WARN_S)
+    if tier1_exceeded:
+        print(f"[pyramid] WARNING: tier-1 profile took {tier1_seconds:.0f}s"
+              f" > {TIER1_WARN_S:.0f}s of the {TIER1_DRIVER_BUDGET_S:.0f}s"
+              f" driver budget — trim or slow-mark tests before the "
+              f"driver timeout starts killing the suite",
+              file=sys.stderr, flush=True)
     print(json.dumps({
         "metric": "pyramid",
         "value": total["passed"],
@@ -205,6 +230,9 @@ def main(argv=None) -> int:
         "shards_total": len(shards),
         **total,
         "seconds": round(time.monotonic() - t0, 1),
+        "shard_seconds": shard_seconds,
+        "tier1_budget_warn_s": TIER1_WARN_S,
+        "tier1_budget_exceeded": tier1_exceeded,
         "log": log_path,
     }), flush=True)
     return 0 if ok else 1

@@ -8,8 +8,40 @@ lives in one place.
 """
 
 import io
+import os
+import socket
 
 import numpy as np
+import pytest
+
+
+def _can_bind_localhost() -> bool:
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+        return True
+    except OSError:
+        return False
+
+
+# Formal quarantine for the multi-process gloo CPU transport race
+# (docs/measurements/r6/pyramid_notes.md): 2-process `jax.distributed`
+# training on a single-core box intermittently aborts inside gloo with
+# `op.preamble.length <= op.nbytes` (and the occasional worker
+# segfault) — an environment limitation of oversubscribed gloo CPU
+# rings, not a product defect; the same scenarios pass on >=2-core
+# boxes. Tests carrying this marker report an attributed skip instead
+# of an environmental failure. Socket availability is probed here too
+# so a sandbox without localhost binds skips for the honest reason.
+GLOO_MIN_CORES = 2
+_cores = os.cpu_count() or 1
+gloo_multiprocess_quarantine = pytest.mark.skipif(
+    _cores < GLOO_MIN_CORES or not _can_bind_localhost(),
+    reason=(f"multi-process gloo CPU transport is flaky below "
+            f"{GLOO_MIN_CORES} cores (op.preamble.length abort class, "
+            f"docs/measurements/r6/pyramid_notes.md): "
+            f"{_cores} core(s), localhost sockets "
+            f"{'available' if _can_bind_localhost() else 'unavailable'}"))
 
 
 def write_png(path, rng, size=(12, 12)):

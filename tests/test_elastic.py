@@ -1,0 +1,727 @@
+"""Elastic pod units (ISSUE 12).
+
+Tier-1 keeps the cheap layers: the pure roster-consensus fixpoint,
+ElasticPolicy routing (attributed + within-budget -> reshard; anything
+else -> the unchanged exit-73 path), the degraded MeshPlan derivation
+and its pad-and-mask partitioning determinism, AOT fingerprint
+distinctness across rosters, the backfill startup gate, and the
+structural elastic_mode=0-installs-nothing pin (the cluster/watchdog
+zero-config discipline). The real 2-process SIGKILL -> reshard ->
+bitwise-cold-N-1 proof lives in scripts/chaos_pod.py's elastic phase.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu import resilience
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.resilience import (
+    cluster, elastic, faults, flightrec, watchdog)
+from howtotrainyourmamlpytorch_tpu.resilience.cluster import (
+    ClusterFaultDomain)
+from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+from howtotrainyourmamlpytorch_tpu.utils.tracing import (
+    JsonlLogger, read_jsonl)
+
+
+@pytest.fixture(autouse=True)
+def _clean_process_state():
+    faults.configure("")
+    prev_reg = resilience.set_registry(None)
+    prev_beacon = watchdog.install_beacon(None)
+    prev_rec = flightrec.install(None)
+    prev_dom = cluster.install(None)
+    yield
+    faults.configure("")
+    resilience.set_registry(prev_reg)
+    watchdog.install_beacon(prev_beacon)
+    flightrec.install(prev_rec)
+    cluster.install(prev_dom)
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+def test_config_elastic_validation():
+    with pytest.raises(ValueError, match="elastic_mode"):
+        MAMLConfig(elastic_mode=2)
+    # elastic without the pod fault domain is a contradiction: the
+    # policy is routed from the attributed trip.
+    with pytest.raises(ValueError, match="cluster_collective_timeout_s"):
+        MAMLConfig(elastic_mode=1)
+    with pytest.raises(ValueError, match="elastic_max_lost_hosts"):
+        MAMLConfig(elastic_max_lost_hosts=0)
+    with pytest.raises(ValueError, match="elastic_reshard_timeout_s"):
+        MAMLConfig(elastic_reshard_timeout_s=-1.0)
+    with pytest.raises(ValueError, match="elastic_pad_tasks"):
+        MAMLConfig(elastic_pad_tasks=-1)
+    # A pad that does not make the batch divisible is refused.
+    with pytest.raises(ValueError, match="elastic_pad_tasks"):
+        MAMLConfig(batch_size=6, mesh_shape=(1, 4), elastic_pad_tasks=1)
+    cfg = MAMLConfig(elastic_mode=1, cluster_collective_timeout_s=12.0)
+    assert elastic.elastic_enabled(cfg)
+    assert not elastic.elastic_enabled(MAMLConfig())
+    # Auto reshard timeout = one collective budget.
+    assert elastic.reshard_timeout(cfg) == pytest.approx(12.0)
+    assert elastic.reshard_timeout(
+        cfg.replace(elastic_reshard_timeout_s=5.0)) == pytest.approx(5.0)
+    # Pad participates in the padded batch the executables see.
+    padded = MAMLConfig(batch_size=6, mesh_shape=(1, 4),
+                        elastic_pad_tasks=2)
+    assert padded.padded_batch_size == 8
+
+
+# ---------------------------------------------------------------------------
+# pure roster math
+# ---------------------------------------------------------------------------
+
+def test_roster_consensus_fixpoint():
+    # Lone survivor convicting the dead peer agrees with itself.
+    assert elastic.roster_consensus({0: [1]}, [0, 1]) == ([0], [1], True)
+    # Incomplete until every non-convicted member proposes.
+    roster, dead, complete = elastic.roster_consensus(
+        {0: [3]}, [0, 1, 2, 3])
+    assert roster == [0, 1, 2] and dead == [3] and not complete
+    roster, dead, complete = elastic.roster_consensus(
+        {0: [3], 1: [3], 2: [3]}, [0, 1, 2, 3])
+    assert (roster, dead, complete) == ([0, 1, 2], [3], True)
+    # Double loss during the reshard: host 2 dies before proposing and
+    # nobody has convicted it yet — the consensus stays incomplete (the
+    # caller times out into exit 73).
+    roster, dead, complete = elastic.roster_consensus(
+        {0: [3], 1: [3]}, [0, 1, 2, 3])
+    assert roster == [0, 1, 2] and not complete
+    # ...unless a survivor's leases convict it too.
+    roster, dead, complete = elastic.roster_consensus(
+        {0: [2, 3], 1: [3]}, [0, 1, 2, 3])
+    assert (roster, dead, complete) == ([0, 1], [2, 3], True)
+    # Mutual accusation: the union removes both; no split-brain is
+    # representable because there is exactly one union.
+    roster, dead, complete = elastic.roster_consensus(
+        {0: [1], 1: [0]}, [0, 1])
+    assert roster == [] and dead == [0, 1] and not complete
+
+
+def test_rerank_and_exec_env():
+    assert elastic.rerank([0, 2, 3], 2) == 1
+    doc = {"generation": 2, "roster": [0, 2, 3], "orig_processes": 4,
+           "coordinator": "10.0.0.1:7777"}
+    env = elastic.exec_env(doc, 3, environ={"MAML_FAULTS": "kill@3",
+                                            "OTHER": "kept"})
+    assert env[elastic.GEN_ENV] == "2"
+    assert env[elastic.ROSTER_ENV] == "0,2,3"
+    assert env[elastic.ORIG_ENV] == "4"
+    assert env["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:7777"
+    assert env["JAX_NUM_PROCESSES"] == "3"
+    assert env["JAX_PROCESS_ID"] == "2"
+    assert env["OTHER"] == "kept"
+    # A fault plan is per-launch: the resharded segment must not replay
+    # the injection that killed the peer.
+    assert "MAML_FAULTS" not in env
+    # Lone survivor drops the distributed trio entirely — bitwise the
+    # same environment a cold single-process run at the degraded
+    # geometry uses.
+    solo = elastic.exec_env(
+        {"generation": 1, "roster": [1], "orig_processes": 2,
+         "coordinator": "x:1"}, 1,
+        environ={"JAX_NUM_PROCESSES": "2", "JAX_PROCESS_ID": "1",
+                 "JAX_COORDINATOR_ADDRESS": "x:0"})
+    for key in ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                "JAX_COORDINATOR_ADDRESS"):
+        assert key not in solo
+    # Round trip through the parser the restarted image runs.
+    state = elastic.parse_roster_env(env)
+    assert state == elastic.RosterState(2, (0, 2, 3), 4)
+    assert state.degraded
+    assert elastic.parse_roster_env({}) is None
+
+
+def test_adopt_env_drops_removed_keys():
+    """The backfill gate's in-process adoption must DELETE keys the
+    roster env removes — a stale MAML_FAULTS would re-arm the fault
+    plan that killed the rejoined host's predecessor."""
+    env = {"MAML_FAULTS": "kill_peer@6", "JAX_COORDINATOR_ADDRESS": "a:1",
+           "JAX_NUM_PROCESSES": "2", "JAX_PROCESS_ID": "1", "KEEP": "x"}
+    elastic.adopt_env({"generation": 2, "roster": [0, 1],
+                       "orig_processes": 2, "coordinator": "b:2"},
+                      1, environ=env)
+    assert "MAML_FAULTS" not in env
+    assert env["JAX_COORDINATOR_ADDRESS"] == "b:2"
+    assert env["JAX_PROCESS_ID"] == "1" and env["KEEP"] == "x"
+    # Lone roster drops the distributed trio entirely.
+    env2 = {"JAX_NUM_PROCESSES": "2", "JAX_PROCESS_ID": "0",
+            "JAX_COORDINATOR_ADDRESS": "a:1"}
+    elastic.adopt_env({"generation": 1, "roster": [0],
+                       "orig_processes": 2, "coordinator": "b:2"},
+                      0, environ=env2)
+    assert not any(k.startswith("JAX_") for k in env2)
+
+
+def test_apply_roster_derives_and_forces_resume():
+    cfg = MAMLConfig(batch_size=8, mesh_shape=(2, 4),
+                     continue_from_epoch="from_scratch",
+                     elastic_mode=1, cluster_collective_timeout_s=12.0)
+    # No roster env: untouched (the generation-0 structural pin).
+    out, state = elastic.apply_roster(cfg, environ={})
+    assert out is cfg and state is None
+    env = {elastic.GEN_ENV: "1", elastic.ROSTER_ENV: "0",
+           elastic.ORIG_ENV: "2"}
+    out, state = elastic.apply_roster(cfg, environ=env)
+    assert state == elastic.RosterState(1, (0,), 2)
+    assert out.mesh_shape == (1, 4)
+    # A resharded segment is by definition a resume.
+    assert out.continue_from_epoch == "latest"
+
+
+# ---------------------------------------------------------------------------
+# degraded MeshPlan derivation
+# ---------------------------------------------------------------------------
+
+def test_derive_degraded_config_partitioning():
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+        degraded_mesh_shape, derive_degraded_config)
+
+    cfg = MAMLConfig(batch_size=8, mesh_shape=(2, 4),
+                     task_microbatches=2, num_classes_per_set=3)
+    # 2 -> 1 hosts: mesh (1, 4), batch 8 still divisible -> no pad.
+    d1 = derive_degraded_config(cfg, 1, 2)
+    assert d1.mesh_shape == (1, 4) and d1.elastic_pad_tasks == 0
+    assert d1.batch_size == 8
+    assert d1.effective_eval_batch_size % 4 == 0
+    # 4 -> 3 hosts with batch 8: 8 % 12 != 0 is impossible (3 hosts x 4
+    # chips > batch) — use a batch that genuinely needs the pad.
+    cfg4 = MAMLConfig(batch_size=16, mesh_shape=(4, 3),
+                      task_microbatches=4, num_classes_per_set=3)
+    d3 = derive_degraded_config(cfg4, 3, 4)
+    assert d3.mesh_shape == (3, 3)
+    # 16 real tasks over 9 devices -> pad 2 to 18.
+    assert d3.elastic_pad_tasks == 2 and d3.padded_batch_size == 18
+    assert d3.padded_batch_size % 9 == 0
+    # Microbatches pre-resolved at the degraded geometry (gcd with the
+    # per-device padded task count 18/9 = 2).
+    assert d3.task_microbatches == d3.effective_task_microbatches(9)
+    # Determinism: the derivation is a pure function of (cfg, roster).
+    assert derive_degraded_config(cfg4, 3, 4) == d3
+    # Full roster: untouched (re-expansion resumes the original
+    # geometry bit-for-bit).
+    assert derive_degraded_config(cfg, 2, 2) is cfg
+    # A mesh whose dcn axis does not track processes is refused.
+    with pytest.raises(ValueError, match="dcn"):
+        degraded_mesh_shape((2, 4), 1, 3)
+    with pytest.raises(ValueError, match="survivor count"):
+        degraded_mesh_shape((2, 4), 0, 2)
+
+
+def test_degraded_pad_and_mask_step_exactness():
+    """The padded-masked train step over the degraded mesh computes the
+    EXACT masked mean: allclose to the unpadded single-device step on
+    the same 6 real tasks, and bitwise-deterministic for a given
+    roster."""
+    import jax
+    import jax.numpy as jnp
+    from howtotrainyourmamlpytorch_tpu.meta import (
+        Episode, init_train_state)
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+        derive_degraded_config, make_mesh, make_sharded_steps,
+        replicate_state, shard_batch)
+
+    base = dict(dataset_name="syn", image_height=6, image_width=6,
+                image_channels=1, num_classes_per_set=2,
+                num_samples_per_class=1, num_target_samples=1,
+                cnn_num_filters=2, num_stages=2,
+                number_of_training_steps_per_iter=1,
+                number_of_evaluation_steps_per_iter=1,
+                second_order=False,
+                use_multi_step_loss_optimization=False,
+                batch_size=6, cluster_collective_timeout_s=5.0)
+    cfg1 = MAMLConfig(**base, mesh_shape=(1, 1))
+    cfgd = derive_degraded_config(
+        MAMLConfig(**base, mesh_shape=(2, 4)), 1, 2)
+    assert cfgd.elastic_pad_tasks == 2
+
+    rng = np.random.default_rng(0)
+
+    def episodes(n):
+        return Episode(
+            rng.standard_normal((n, 2, 6, 6, 1)).astype(np.float32),
+            np.tile(np.arange(2), (n, 1)).astype(np.int32),
+            rng.standard_normal((n, 2, 6, 6, 1)).astype(np.float32),
+            np.tile(np.arange(2), (n, 1)).astype(np.int32))
+
+    real = episodes(6)
+    padded = Episode(*(np.concatenate(
+        [f, np.zeros((2,) + f.shape[1:], f.dtype)]) for f in real))
+
+    init, apply = make_model(cfg1)
+    dv = jax.devices()
+    key = (False, False)
+
+    mesh1 = make_mesh(cfg1, dv[:1])
+    plan1 = make_sharded_steps(cfg1, apply, mesh1)
+    s1 = replicate_state(init_train_state(cfg1, init,
+                                          jax.random.PRNGKey(0)), mesh1)
+    s1, m1 = plan1.train_steps[key](s1, shard_batch(real, mesh1),
+                                    jnp.float32(0.0))
+
+    meshd = make_mesh(cfgd, dv[:4])
+    pland = make_sharded_steps(cfgd, apply, meshd)
+
+    def run_degraded():
+        s = replicate_state(init_train_state(cfgd, init,
+                                             jax.random.PRNGKey(0)),
+                            meshd)
+        return pland.train_steps[key](s, shard_batch(padded, meshd),
+                                      jnp.float32(0.0))
+
+    sd, md = run_degraded()
+    # The pads contribute exactly zero: loss/accuracy/weights match the
+    # unpadded reference up to cross-mesh reduction reassociation.
+    np.testing.assert_allclose(float(m1.loss), float(md.loss),
+                               rtol=2e-5)
+    np.testing.assert_allclose(float(m1.accuracy), float(md.accuracy),
+                               rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(s1.params),
+                    jax.tree.leaves(sd.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)
+    # Bitwise determinism for a given roster — the property the
+    # chaos proof's cold-N-1 parity gate rests on.
+    sd2, _ = run_degraded()
+    for a, b in zip(jax.tree.leaves(sd.params),
+                    jax.tree.leaves(sd2.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_loader_pads_train_batches_with_zero_tail(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.data.loader import (
+        MetaLearningDataLoader)
+
+    cfg = MAMLConfig(
+        dataset_name="synthetic_padtest", image_height=6, image_width=6,
+        image_channels=1, num_classes_per_set=2, num_samples_per_class=1,
+        num_target_samples=1, batch_size=6, mesh_shape=(1, 4),
+        elastic_pad_tasks=2, prefetch_batches=1)
+    loader = MetaLearningDataLoader(cfg)  # mesh None: host batches
+    ref = MetaLearningDataLoader(cfg.replace(elastic_pad_tasks=0))
+    batch = next(iter(loader.get_train_batches(3, 1)))
+    unpadded = next(iter(ref.get_train_batches(3, 1)))
+    assert batch.support_x.shape[0] == 8
+    # Real positions are the SAME episode stream (indexed by the real
+    # batch size), pads are zeros.
+    np.testing.assert_array_equal(np.asarray(batch.support_x[:6]),
+                                  np.asarray(unpadded.support_x))
+    assert not np.asarray(batch.support_x[6:]).any()
+    assert not np.asarray(batch.target_y[6:]).any()
+
+
+# ---------------------------------------------------------------------------
+# policy routing
+# ---------------------------------------------------------------------------
+
+def test_should_reshard_routing():
+    policy = elastic.ElasticPolicy(
+        lease_dir="/nonexistent", process_index=0, roster=[0, 1, 2, 3],
+        generation=0, orig_processes=4, max_lost_hosts=2, timeout_s=1.0,
+        mesh_dcn=4)
+    # Attributed within budget -> reshard.
+    assert policy.should_reshard([1])
+    assert policy.should_reshard([1, 2])
+    # Unattributed -> exit 73 (never blame nobody).
+    assert not policy.should_reshard([])
+    # Over budget -> exit 73.
+    assert not policy.should_reshard([1, 2, 3])
+    # Budget is CUMULATIVE across generations: one host already lost.
+    degraded = elastic.ElasticPolicy(
+        lease_dir="/nonexistent", process_index=0, roster=[0, 1, 2],
+        generation=1, orig_processes=4, max_lost_hosts=2, timeout_s=1.0,
+        mesh_dcn=3)
+    assert degraded.should_reshard([1])
+    assert not degraded.should_reshard([1, 2])
+    # A mesh whose dcn axis does not track the roster cannot be
+    # degraded — exit 73.
+    wrong_mesh = elastic.ElasticPolicy(
+        lease_dir="/nonexistent", process_index=0, roster=[0, 1],
+        generation=0, orig_processes=2, max_lost_hosts=1, timeout_s=1.0,
+        mesh_dcn=1)
+    assert not wrong_mesh.should_reshard([1])
+
+
+def _stale_peer(lease_dir, host, age_s=120.0):
+    os.makedirs(lease_dir, exist_ok=True)
+    path = cluster.lease_path(lease_dir, host)
+    with open(path, "w") as f:
+        f.write("{}")
+    past = time.time() - age_s
+    os.utime(path, (past, past))
+
+
+def test_trip_routes_to_reshard_with_exec_env(tmp_path):
+    """The full attributed-trip -> consensus -> exec pipeline with an
+    injected exec: proposal and roster files land, the elastic_reshard
+    row and counters land, and the exec env is the survivor's."""
+    reg = MetricsRegistry()
+    jsonl = JsonlLogger(str(tmp_path / "events.jsonl"))
+    domain = ClusterFaultDomain(
+        lease_dir=str(tmp_path / "cluster"), process_index=0,
+        num_processes=2, collective_timeout_s=2.0, stalled_after_s=1.0,
+        dead_after_s=2.0, lease_interval_s=0.1, registry=reg,
+        jsonl=jsonl, prom_path=str(tmp_path / "metrics.prom"))
+    execs = []
+    policy = elastic.ElasticPolicy(
+        lease_dir=domain.lease.lease_dir, process_index=0,
+        roster=[0, 1], generation=0, orig_processes=2,
+        max_lost_hosts=1, timeout_s=2.0, mesh_dcn=2,
+        lease=domain.lease, registry=reg, jsonl=jsonl,
+        argv=["train_maml_system.py", "--x", "1"])
+    policy._exec = lambda exe, argv, env: execs.append((exe, argv, env))
+    domain.elastic = policy
+    rec = flightrec.FlightRecorder(32)
+    flightrec.install(rec)
+
+    domain.heartbeat(force=True)
+    _stale_peer(domain.lease.lease_dir, 1)
+    domain.trip_peer_lost({"phase": "collective", "detail": "gather",
+                           "age_seconds": 2.5,
+                           "deadline_seconds": 2.0})
+    domain.close()
+
+    assert len(execs) == 1
+    _, argv, env = execs[0]
+    assert argv[1:] == ["train_maml_system.py", "--x", "1"]
+    assert env[elastic.GEN_ENV] == "1"
+    assert env[elastic.ROSTER_ENV] == "0"
+    # Lone survivor: the distributed trio is dropped.
+    assert "JAX_NUM_PROCESSES" not in env
+    # Consensus artifacts on disk: our proposal + the agreed roster.
+    props = elastic.read_proposals(policy.lease_dir, 1)
+    assert props[0]["dead"] == [1]
+    doc = elastic.read_roster(policy.lease_dir)
+    assert doc["generation"] == 1 and doc["roster"] == [0]
+    assert doc["dead"] == [1] and doc["orig_processes"] == 2
+    # Telemetry: reshard row + counter; peer loss still counted.
+    events = read_jsonl(str(tmp_path / "events.jsonl"))
+    rows = [e for e in events if e["event"] == elastic.RESHARD_EVENT]
+    assert len(rows) == 1 and rows[0]["roster"] == [0]
+    assert rows[0]["suspects"] == [1]
+    assert reg.counter(elastic.RESHARDS_COUNTER).value == 1
+    assert reg.counter(cluster.PEER_LOSSES_COUNTER).value == 1
+    assert any(e["kind"] == elastic.RESHARD_EVENT for e in rec.events())
+
+
+def test_unattributed_or_over_budget_trip_still_exits_73(tmp_path):
+    """The exit-73 contract survives elastic: over-budget and
+    unattributed losses take the unchanged whole-job-restart path."""
+    trips = []
+    reg = MetricsRegistry()
+    jsonl = JsonlLogger(str(tmp_path / "events.jsonl"))
+    domain = ClusterFaultDomain(
+        lease_dir=str(tmp_path / "cluster"), process_index=0,
+        num_processes=3, collective_timeout_s=1.0, stalled_after_s=1.0,
+        dead_after_s=1.5, lease_interval_s=0.1, registry=reg,
+        jsonl=jsonl, on_trip=trips.append)
+    execs = []
+    policy = elastic.ElasticPolicy(
+        lease_dir=domain.lease.lease_dir, process_index=0,
+        roster=[0, 1, 2], generation=0, orig_processes=3,
+        max_lost_hosts=1, timeout_s=1.0, mesh_dcn=3, registry=reg)
+    policy._exec = lambda *a: execs.append(a)
+    domain.elastic = policy
+    domain.heartbeat(force=True)
+    _stale_peer(domain.lease.lease_dir, 1)
+    _stale_peer(domain.lease.lease_dir, 2)
+    # TWO dead peers > max_lost_hosts 1: the policy refuses, the trip
+    # completes as the ordinary attributed exit (on_trip injected).
+    domain.trip_peer_lost({"phase": "collective", "detail": "gather",
+                           "age_seconds": 1.6, "deadline_seconds": 1.0})
+    domain.close()
+    assert not execs
+    assert len(trips) == 1 and sorted(trips[0]["suspect_hosts"]) == [1, 2]
+    events = read_jsonl(str(tmp_path / "events.jsonl"))
+    assert [e for e in events if e["event"] == "peer_lost"]
+
+
+def test_consensus_timeout_falls_back_to_exit(tmp_path):
+    """A second survivor that never proposes (double loss mid-reshard,
+    wedged storage) times the consensus out -> False -> exit 73."""
+    trips = []
+    reg = MetricsRegistry()
+    domain = ClusterFaultDomain(
+        lease_dir=str(tmp_path / "cluster"), process_index=0,
+        num_processes=3, collective_timeout_s=1.0, stalled_after_s=1.0,
+        dead_after_s=1.5, lease_interval_s=0.1, registry=reg,
+        on_trip=trips.append)
+    execs = []
+    policy = elastic.ElasticPolicy(
+        lease_dir=domain.lease.lease_dir, process_index=0,
+        roster=[0, 1, 2], generation=0, orig_processes=3,
+        max_lost_hosts=1, timeout_s=1.0, mesh_dcn=3, registry=reg)
+    policy._exec = lambda *a: execs.append(a)
+    domain.elastic = policy
+    domain.heartbeat(force=True)
+    _stale_peer(domain.lease.lease_dir, 2)
+    # Host 1 is LIVE (fresh lease) but never writes a proposal: the
+    # fixpoint stays incomplete and the deadline fires.
+    peer1 = cluster.lease_path(domain.lease.lease_dir, 1)
+    with open(peer1, "w") as f:
+        f.write("{}")
+    domain.trip_peer_lost({"phase": "collective", "detail": "gather",
+                           "age_seconds": 1.6, "deadline_seconds": 1.0})
+    domain.close()
+    assert not execs
+    assert len(trips) == 1
+    assert reg.counter(elastic.REFUSALS_COUNTER).value == 1
+
+
+def test_mutual_accusation_refuses_own_reshard(tmp_path):
+    """Peers convicted US while we convicted them: the union excludes
+    both; each refuses its own reshard and exits 73 (no split-brain)."""
+    trips = []
+    reg = MetricsRegistry()
+    domain = ClusterFaultDomain(
+        lease_dir=str(tmp_path / "cluster"), process_index=0,
+        num_processes=3, collective_timeout_s=1.0, stalled_after_s=1.0,
+        dead_after_s=1.5, lease_interval_s=0.1, registry=reg,
+        on_trip=trips.append)
+    execs = []
+    policy = elastic.ElasticPolicy(
+        lease_dir=domain.lease.lease_dir, process_index=0,
+        roster=[0, 1, 2], generation=0, orig_processes=3,
+        max_lost_hosts=1, timeout_s=2.0, mesh_dcn=3, registry=reg)
+    policy._exec = lambda *a: execs.append(a)
+    domain.elastic = policy
+    domain.heartbeat(force=True)
+    _stale_peer(domain.lease.lease_dir, 1)
+    _stale_peer(domain.lease.lease_dir, 2, age_s=0.0)  # host 2 is live
+    # Host 2 already proposed gen 1 convicting US (and not host 1).
+    elastic.write_proposal(domain.lease.lease_dir, 1, 2,
+                           {"host": 2, "dead": [0], "coordinator": "c"})
+    domain.trip_peer_lost({"phase": "collective", "detail": "gather",
+                           "age_seconds": 1.6, "deadline_seconds": 1.0})
+    domain.close()
+    assert not execs
+    assert len(trips) == 1
+    assert reg.counter(elastic.REFUSALS_COUNTER).value == 1
+
+
+def test_stale_newer_roster_refuses(tmp_path):
+    """A roster generation newer than ours already on disk means the
+    peers resharded past this (wedged) host: exit 73, never a rival
+    reshard."""
+    execs, trips = [], []
+    reg = MetricsRegistry()
+    domain = ClusterFaultDomain(
+        lease_dir=str(tmp_path / "cluster"), process_index=0,
+        num_processes=2, collective_timeout_s=1.0, stalled_after_s=1.0,
+        dead_after_s=1.5, lease_interval_s=0.1, registry=reg,
+        on_trip=trips.append)
+    policy = elastic.ElasticPolicy(
+        lease_dir=domain.lease.lease_dir, process_index=0,
+        roster=[0, 1], generation=0, orig_processes=2,
+        max_lost_hosts=1, timeout_s=1.0, mesh_dcn=2, registry=reg)
+    policy._exec = lambda *a: execs.append(a)
+    domain.elastic = policy
+    domain.heartbeat(force=True)
+    _stale_peer(domain.lease.lease_dir, 1)
+    elastic.write_roster(domain.lease.lease_dir,
+                         {"generation": 1, "roster": [1],
+                          "orig_processes": 2, "coordinator": "c"})
+    domain.trip_peer_lost({"phase": "collective", "detail": "gather",
+                           "age_seconds": 1.6, "deadline_seconds": 1.0})
+    domain.close()
+    assert not execs and len(trips) == 1
+
+
+# ---------------------------------------------------------------------------
+# AOT fingerprints across rosters
+# ---------------------------------------------------------------------------
+
+def test_aot_fingerprint_distinct_across_rosters():
+    import jax
+    from howtotrainyourmamlpytorch_tpu.parallel import aot
+    from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
+        derive_degraded_config, make_mesh)
+
+    cfg = MAMLConfig(batch_size=8, mesh_shape=(2, 4),
+                     aot_store_dir="/tmp/unused",
+                     cluster_collective_timeout_s=12.0, elastic_mode=1)
+    dv = jax.devices()
+    full_mesh = make_mesh(cfg, dv[:8])
+    dcfg = derive_degraded_config(cfg, 1, 2)
+    deg_mesh = make_mesh(dcfg, dv[:4])
+    fp_full = aot.store_fingerprint(cfg, full_mesh, process_count=2)
+    fp_deg = aot.store_fingerprint(dcfg, deg_mesh, process_count=1)
+    # Survivor topology resolves its OWN fingerprint dir.
+    assert fp_full != fp_deg
+    # The process-count override alone separates rosters that share a
+    # mesh shape (prewarming FOR a pod from a single-process box).
+    assert aot.store_fingerprint(cfg, full_mesh, process_count=2) \
+        != aot.store_fingerprint(cfg, full_mesh, process_count=1)
+    # Elastic POLICY knobs are runtime-only: toggling them must not
+    # re-fingerprint (the survivor must hit a store prewarmed without
+    # them).
+    assert aot.store_fingerprint(
+        cfg.replace(elastic_mode=0, elastic_max_lost_hosts=1),
+        full_mesh, process_count=2) == fp_full
+    # The derived PAD is structural: it changes the compiled program.
+    padded = dcfg.replace(elastic_pad_tasks=4, batch_size=4)
+    assert aot.store_fingerprint(padded, deg_mesh, process_count=1) \
+        != aot.store_fingerprint(dcfg, deg_mesh, process_count=1)
+
+
+# ---------------------------------------------------------------------------
+# backfill gate + re-expansion
+# ---------------------------------------------------------------------------
+
+def test_startup_disposition_and_backfill_wait(tmp_path):
+    lease_dir = str(tmp_path / "cluster")
+    doc = {"generation": 1, "roster": [0], "orig_processes": 2,
+           "coordinator": "127.0.0.1:1"}
+    # Live degraded group (fresh rank-0 lease): the excluded host must
+    # wait; a member of the roster (or a full roster) proceeds.
+    assert elastic.startup_disposition(1, doc, {0: 0.2}, 1.5) \
+        == "backfill_wait"
+    assert elastic.startup_disposition(0, doc, {0: 0.2}, 1.5) == "full"
+    assert elastic.startup_disposition(1, doc, {0: 99.0}, 1.5) == "full"
+    assert elastic.startup_disposition(1, None, {}, 1.5) == "full"
+    full = {"generation": 2, "roster": [0, 1], "orig_processes": 2}
+    assert elastic.startup_disposition(1, full, {0: 0.2}, 1.5) == "full"
+
+    # backfill_wait returns the generation that includes us.
+    elastic.write_roster(lease_dir, doc)
+    lease = cluster.HeartbeatLease(lease_dir, 0, 0.05)
+    lease.touch(force=True)
+
+    def promote():
+        time.sleep(0.4)
+        lease.touch(force=True)
+        elastic.write_roster(lease_dir, {
+            "generation": 2, "roster": [0, 1], "orig_processes": 2,
+            "coordinator": "127.0.0.1:2"})
+
+    t = threading.Thread(target=promote)
+    t.start()
+    joined = elastic.backfill_wait(lease_dir, 1, stalled_after_s=5.0,
+                                   poll_s=0.1, timeout_s=10.0)
+    t.join()
+    assert joined is not None and joined["generation"] == 2
+    # The rejoin file is cleaned up on exit.
+    assert elastic.read_rejoins(lease_dir) == []
+
+    # A dead group (stale leases) releases the backfill to launch full.
+    past = time.time() - 120.0
+    os.utime(lease.path, (past, past))
+    assert elastic.backfill_wait(lease_dir, 1, stalled_after_s=1.5,
+                                 poll_s=0.1, timeout_s=10.0) is None
+
+
+def test_maybe_re_expand_writes_full_roster_and_execs(tmp_path):
+    """Epoch-boundary re-expansion: with every missing host's rejoin
+    file present, the survivor writes the next-generation FULL roster
+    and restarts in place (injected exec observes the env)."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    builder = ExperimentBuilder(_cfg(
+        tmp_path, cluster_collective_timeout_s=300.0, elastic_mode=1))
+    execs = []
+    policy = elastic.ElasticPolicy(
+        lease_dir=os.path.join(builder.paths["base"], cluster.LEASE_DIR),
+        process_index=0, roster=[0], generation=1, orig_processes=2,
+        max_lost_hosts=1, timeout_s=1.0, mesh_dcn=1,
+        registry=builder.registry, jsonl=builder.jsonl)
+    policy._exec = lambda exe, argv, env: execs.append(env)
+    builder._elastic = policy
+
+    # No rejoin file yet: nothing happens.
+    builder._maybe_re_expand()
+    assert not execs
+    # The missing host announces itself.
+    elastic.write_rejoin(policy.lease_dir, 1)
+    builder._maybe_re_expand()
+    assert len(execs) == 1
+    env = execs[0]
+    assert env[elastic.GEN_ENV] == "2"
+    assert env[elastic.ROSTER_ENV] == "0,1"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["JAX_PROCESS_ID"] == "0"
+    doc = elastic.read_roster(policy.lease_dir)
+    assert doc["generation"] == 2 and doc["roster"] == [0, 1]
+    events = read_jsonl(os.path.join(builder.paths["logs"],
+                                     "events.jsonl"))
+    rows = [e for e in events if e["event"] == elastic.RE_EXPAND_EVENT]
+    assert len(rows) == 1 and rows[0]["generation"] == 2
+    assert builder.registry.counter(
+        elastic.RE_EXPANSIONS_COUNTER).value == 1
+
+
+# ---------------------------------------------------------------------------
+# structural pin: elastic_mode=0 installs nothing
+# ---------------------------------------------------------------------------
+
+def test_run_installs_elastic_iff_enabled(tmp_path, monkeypatch):
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+
+    seen = {}
+
+    def probe(builder):
+        def stub():
+            seen["cluster"] = builder._cluster
+            seen["elastic"] = builder._elastic
+            seen["attached"] = (builder._cluster.elastic
+                                if builder._cluster is not None else None)
+            return {"paused_at_iter": builder.current_iter}
+        return stub
+
+    # Cluster armed, elastic OFF (the default): no policy anywhere —
+    # the exit-73 path is byte-for-byte the PR 8 one.
+    builder = ExperimentBuilder(_cfg(tmp_path / "off",
+                                     cluster_collective_timeout_s=30.0))
+    monkeypatch.setattr(builder, "_run_experiment", probe(builder))
+    builder.run_experiment()
+    assert seen["cluster"] is not None
+    assert seen["elastic"] is None and seen["attached"] is None
+
+    # Elastic ON: the policy is attached to the domain with the
+    # generation-0 identity, and restored after the run.
+    builder = ExperimentBuilder(_cfg(tmp_path / "on",
+                                     cluster_collective_timeout_s=30.0,
+                                     elastic_mode=1))
+    monkeypatch.setattr(builder, "_run_experiment", probe(builder))
+    builder.run_experiment()
+    assert isinstance(seen["elastic"], elastic.ElasticPolicy)
+    assert seen["attached"] is seen["elastic"]
+    assert seen["elastic"].roster == (0,)
+    assert seen["elastic"].generation == 0
+    assert not seen["elastic"].degraded
+    assert builder._elastic is None  # scoped lifetime
+
+
+def test_elastic_armed_run_end_to_end_report(tmp_path):
+    """One tiny real run with elastic armed (nothing trips): completes,
+    and the telemetry report renders the v10 elastic section with
+    measured zeros and generation 0."""
+    from test_experiment import _cfg
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    from howtotrainyourmamlpytorch_tpu.telemetry import summarize_events
+
+    builder = ExperimentBuilder(_cfg(
+        tmp_path, cluster_collective_timeout_s=300.0,
+        cluster_lease_interval_s=0.05, elastic_mode=1,
+        dispatch_sync_every=1))
+    result = builder.run_experiment()
+    assert "test_accuracy_mean" in result
+    events = read_jsonl(os.path.join(builder.paths["logs"],
+                                     "events.jsonl"))
+    sec = summarize_events(events)["elastic"]
+    assert sec["reshards"] == 0 and sec["re_expansions"] == 0
+    assert sec["degraded_epochs"] == 0
+    assert sec["generation"] == 0
+    assert not [e for e in events
+                if e.get("event") == elastic.RESHARD_EVENT]

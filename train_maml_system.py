@@ -129,6 +129,50 @@ def main(argv=None) -> int:
         from howtotrainyourmamlpytorch_tpu.utils.backend import (
             wait_for_backend)
         wait_for_backend(timeout_s=backend_timeout)
+    # Elastic startup gate (docs/RESILIENCE.md § Elastic pod): a process
+    # launched with the ORIGINAL env while a degraded survivor group is
+    # LIVE is a backfill — it must rejoin through the roster file, not
+    # stand up a rival full-geometry coordination ring. Runs before the
+    # distributed bootstrap below because the verdict changes the JAX_*
+    # env the bootstrap reads. Generation-carrying processes (already
+    # resharded) and non-elastic configs skip straight through.
+    if cfg.elastic_mode:
+        from howtotrainyourmamlpytorch_tpu.resilience import (
+            cluster as _cluster, elastic as _elastic)
+        lease_dir = os.path.join(cfg.experiment_root, cfg.experiment_name,
+                                 _cluster.LEASE_DIR)
+        if _elastic.parse_roster_env() is None:
+            doc = _elastic.read_roster(lease_dir)
+            self_host = int(os.environ.get("JAX_PROCESS_ID", "0"))
+            stalled = _cluster.stalled_after(cfg)
+            n_ranks = len((doc or {}).get("roster", [])) or 1
+            ages = _cluster.read_lease_ages(lease_dir,
+                                            expected_hosts=n_ranks)
+            verdict = _elastic.startup_disposition(self_host, doc, ages,
+                                                   stalled)
+            if verdict == "backfill_wait":
+                print(f"elastic: host {self_host} is a backfill for a "
+                      f"live degraded group (roster "
+                      f"{(doc or {}).get('roster')}); waiting to rejoin",
+                      flush=True)
+                joined = _elastic.backfill_wait(lease_dir, self_host,
+                                                stalled)
+                if joined is not None:
+                    # Adopt the re-expanded generation's env in-process
+                    # (JAX is not initialized yet — no exec needed;
+                    # removed keys like a stale MAML_FAULTS are dropped
+                    # too — see elastic.adopt_env).
+                    _elastic.adopt_env(joined, self_host)
+                    print(f"elastic: rejoining at generation "
+                          f"{joined['generation']}", flush=True)
+                else:
+                    print("elastic: degraded group is gone; launching "
+                          "at the original geometry", flush=True)
+                    _elastic.archive_roster(lease_dir)
+            elif doc is not None:
+                # Whole-job restart over a stale roster: retire it so
+                # the lost-host budget restarts at zero.
+                _elastic.archive_roster(lease_dir)
     # Multi-host bootstrap (no-op single-process); must run before any
     # device query so jax.devices() is the global pod device list.
     from howtotrainyourmamlpytorch_tpu.parallel import initialize_distributed
