@@ -296,6 +296,37 @@ class MAMLConfig:
                                            # compile — executables are
                                            # shared)
 
+    # ---- serving fleet (serve/fleet/, docs/SERVING.md § Fleet) ---------
+    serve_l2_dir: str = ""                 # shared L2 adapted-params tier
+                                           # directory ("" = off): on L1
+                                           # miss the engine probes this
+                                           # content-addressed blob store
+                                           # before paying the adapt
+                                           # executable, and publishes
+                                           # fresh adaptations into it
+    serve_l2_max_entries: int = 512        # L2 GC cap (LRU by file
+                                           # recency; each entry is one
+                                           # CRC-framed file)
+    fleet_lease_interval_s: float = 0.5    # replica membership lease
+                                           # touch cadence (mtime is the
+                                           # liveness signal, payload
+                                           # carries port + stats)
+    fleet_replica_stalled_s: float = 0.0   # lease age beyond which the
+                                           # router treats a replica as
+                                           # stalled (0 = 3 lease
+                                           # intervals, the cluster rule)
+    fleet_replica_dead_s: float = 0.0      # lease age beyond which a
+                                           # replica leaves the ring
+                                           # entirely (0 = 6 intervals;
+                                           # never below stalled)
+    fleet_vnodes: int = 64                 # virtual nodes per replica on
+                                           # the consistent-hash ring
+    fleet_load_factor: float = 1.25        # bounded-load cap: a replica
+                                           # holds at most ceil(factor *
+                                           # mean in-flight) requests
+                                           # before its keys spill to the
+                                           # next ring position
+
     # ---- checkpoint lifecycle (ckpt/ subsystem, docs/CHECKPOINT.md) ----
     ckpt_async: int = 0                    # 1 = epoch saves snapshot host-
                                            # side and write on a background
@@ -607,6 +638,19 @@ class MAMLConfig:
             raise ValueError("serve_canary_acc_drop must be >= 0")
         if self.serve_canary_latency_factor <= 0:
             raise ValueError("serve_canary_latency_factor must be > 0")
+        if self.serve_l2_max_entries < 1:
+            raise ValueError("serve_l2_max_entries must be >= 1")
+        if self.fleet_lease_interval_s <= 0:
+            raise ValueError("fleet_lease_interval_s must be > 0")
+        if self.fleet_vnodes < 1:
+            raise ValueError("fleet_vnodes must be >= 1")
+        if self.fleet_load_factor < 1.0:
+            raise ValueError("fleet_load_factor must be >= 1.0 (1.0 = "
+                             "strict least-loaded, no affinity slack)")
+        for name in ("fleet_replica_stalled_s", "fleet_replica_dead_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"{name} must be >= 0 (0 = derived "
+                                 f"from fleet_lease_interval_s)")
         if self.flight_recorder_events < 1:
             raise ValueError("flight_recorder_events must be >= 1")
         if self.require_mesh not in (0, 1):
@@ -829,6 +873,21 @@ class MAMLConfig:
             return tuple(sorted((int(s), int(q))
                                 for s, q in self.serve_buckets))
         return ((self.num_support_per_task, self.num_target_per_task),)
+
+    @property
+    def effective_fleet_stalled_s(self) -> float:
+        """Replica lease age that reads as stalled: explicit knob, else
+        3 lease intervals (one missed touch is scheduling jitter, three
+        is a wedged process — the resilience/cluster.py rule)."""
+        return (self.fleet_replica_stalled_s
+                or 3.0 * self.fleet_lease_interval_s)
+
+    @property
+    def effective_fleet_dead_s(self) -> float:
+        """Replica lease age that drops it from the ring: explicit knob,
+        else 6 lease intervals; never below the stalled threshold."""
+        v = self.fleet_replica_dead_s or 6.0 * self.fleet_lease_interval_s
+        return max(v, self.effective_fleet_stalled_s)
 
     def use_second_order(self, epoch: int) -> bool:
         """Derivative-order annealing (reference:

@@ -141,7 +141,13 @@ _RUNTIME_ONLY_KEYS = frozenset({
     "serve_registry_poll_s", "serve_canary_episodes",
     "serve_canary_acc_drop", "serve_canary_latency_factor",
     "serve_max_queue_depth", "serve_default_deadline_ms",
-    "serve_cache_capacity", "health_grad_norm_warn_factor",
+    "serve_cache_capacity",
+    # Fleet knobs are routing/caching POLICY: no compiled program ever
+    # sees them, and every replica (and the prewarm child) must resolve
+    # the same store dir whatever its L2/lease wiring is.
+    "serve_l2_dir", "serve_l2_max_entries", "fleet_lease_interval_s",
+    "fleet_replica_stalled_s", "fleet_replica_dead_s", "fleet_vnodes",
+    "fleet_load_factor", "health_grad_norm_warn_factor",
     "dispatch_sync_every", "live_progress", "use_tensorboard",
     "profile_dir", "profile_epoch", "profile_num_steps",
     "compilation_cache_dir", "aot_store_dir", "prefetch_batches",

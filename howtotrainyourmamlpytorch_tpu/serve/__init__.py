@@ -24,6 +24,11 @@ Pieces (docs/SERVING.md has the full lifecycle):
   through the telemetry registry (PR 1).
 * scripts/serve_bench.py — synthetic open-loop load generator emitting
   a latency/throughput artifact.
+* serve/fleet/ — the multi-replica layer (docs/SERVING.md § Fleet):
+  jax-free consistent-hash front router with bounded-load spill, the
+  shared L2 adapted-params tier the engine probes on L1 miss, the
+  rolling hot-swap controller, and the replica worker process
+  (scripts/fleet_bench.py drives the whole fleet on one box).
 """
 
 from howtotrainyourmamlpytorch_tpu.serve.batcher import (

@@ -39,14 +39,34 @@ def support_fingerprint(support_x, support_y, num_steps: int,
     return h.hexdigest()
 
 
+def entry_nbytes(value: Any) -> int:
+    """Approximate in-memory size of one cache entry: the sum of array
+    ``nbytes`` over the entry's leaves (nested dicts/lists/tuples —
+    NamedTuples included — walked without jax). Approximate by design:
+    container overhead and replicated-device copies are ignored; the
+    number exists to feed the ``serve/cache_bytes`` autoscale gauge,
+    not an allocator. Fail-soft: anything unwalkable counts 0."""
+    try:
+        if isinstance(value, dict):
+            return sum(entry_nbytes(v) for v in value.values())
+        if isinstance(value, (list, tuple)):
+            return sum(entry_nbytes(v) for v in value)
+        return int(getattr(value, "nbytes", 0) or 0)
+    except Exception:  # noqa: BLE001 — sizing must never break caching
+        return 0
+
+
 class AdaptedParamsLRU:
     """Thread-safe LRU of fingerprint -> adapted (fast params, bn state).
 
     ``get`` refreshes recency; ``put`` evicts the least-recently-used
     entry past ``capacity``. Capacity 0 disables caching (every get
     misses, puts are dropped) — the engine stays cache-agnostic.
-    Hit/miss/eviction counts are plain attributes; the engine mirrors
-    them into telemetry counters after each step.
+    Hit/miss/eviction counts and the approximate resident byte total
+    (``approx_bytes``, maintained put/evict/clear-incrementally via
+    :func:`entry_nbytes`) are plain attributes; the engine mirrors them
+    into telemetry counters/gauges after each step — eviction churn and
+    resident bytes are the L1 half of the fleet autoscale signal.
     """
 
     def __init__(self, capacity: int):
@@ -54,10 +74,12 @@ class AdaptedParamsLRU:
             raise ValueError(f"capacity must be >= 0, got {capacity}")
         self.capacity = int(capacity)
         self._entries: "OrderedDict[str, Any]" = OrderedDict()
+        self._nbytes: dict = {}
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.approx_bytes = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -74,14 +96,21 @@ class AdaptedParamsLRU:
     def put(self, key: str, value: Any) -> None:
         if self.capacity == 0:
             return
+        nb = entry_nbytes(value)
         with self._lock:
             if key in self._entries:
                 self._entries.move_to_end(key)
+                self.approx_bytes -= self._nbytes.get(key, 0)
             self._entries[key] = value
+            self._nbytes[key] = nb
+            self.approx_bytes += nb
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+                evicted, _ = self._entries.popitem(last=False)
+                self.approx_bytes -= self._nbytes.pop(evicted, 0)
                 self.evictions += 1
 
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._nbytes.clear()
+            self.approx_bytes = 0

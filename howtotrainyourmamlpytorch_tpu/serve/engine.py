@@ -70,6 +70,8 @@ from howtotrainyourmamlpytorch_tpu.serve.batcher import (
     FewShotRequest, QueueFullError, RequestBatcher, pad_group)
 from howtotrainyourmamlpytorch_tpu.serve.cache import (
     AdaptedParamsLRU, support_fingerprint)
+from howtotrainyourmamlpytorch_tpu.serve.fleet.l2cache import (
+    L2AdaptedParamsCache)
 from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
 from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
@@ -86,13 +88,17 @@ class FewShotResponse:
     """Per-request result. ``predictions`` are argmax class ids over the
     request's REAL query rows (padding sliced off); ``logits`` the
     matching (Q, N) array. ``error`` is set (and the arrays None) for
-    deadline misses."""
+    deadline misses. ``cache_tier`` names WHERE the adaptation came
+    from — ``"l1"`` (in-proc LRU), ``"l2"`` (shared fleet tier), or
+    None (freshly adapted / errored) — the fleet bench asserts tenant
+    migration on it."""
     request_id: int
     predictions: Optional[np.ndarray]
     logits: Optional[np.ndarray]
     cache_hit: bool
     latency_seconds: float
     error: Optional[str] = None
+    cache_tier: Optional[str] = None
 
 
 class ServingEngine:
@@ -134,6 +140,56 @@ class ServingEngine:
         self.cache = AdaptedParamsLRU(cfg.serve_cache_capacity)
         self.registry = registry if registry is not None else (
             MetricsRegistry())
+        # Shared L2 adapted-params tier (serve/fleet/l2cache.py): on an
+        # L1 miss the engine probes it before paying the adapt
+        # executable, and publishes fresh adaptations into it — so a
+        # tenant adapted on ANY replica is a disk read, not an adapt,
+        # everywhere else. Off ("" — the default) it is one falsy check
+        # on the miss path. Keys are the same support fingerprint the
+        # L1 uses (adapt steps + checkpoint fingerprint folded in), so
+        # a hot-swap invalidates the tier structurally.
+        self.l2: Optional[L2AdaptedParamsCache] = None
+        self._l2_queue: Optional[Any] = None
+        self._l2_writer: Optional[Any] = None
+        if cfg.serve_l2_dir:
+            self.l2 = L2AdaptedParamsCache(
+                cfg.serve_l2_dir, max_entries=cfg.serve_l2_max_entries,
+                registry=self.registry)
+            # Publishes run on a dedicated writer thread (the
+            # ckpt/writer.py async discipline, minus the bitwise
+            # constraints — l2.put is fail-soft and nothing on the
+            # response path consumes it): a publish is a device_get +
+            # fsync'd file write, which must not sit inside step()'s
+            # per-miss loop inflating cold-tenant latency. Bounded
+            # queue; a full queue drops the publish (counted — it only
+            # costs the next CROSS-replica repeat an adapt).
+            import queue as _queue
+            self._l2_queue = _queue.Queue(maxsize=64)
+
+            def _l2_publish_loop():
+                while True:
+                    item = self._l2_queue.get()
+                    try:
+                        if item is None:
+                            return
+                        key, entry = item
+                        self.l2.put(key,
+                                    fast=jax.device_get(entry.fast),
+                                    bn_state=jax.device_get(
+                                        entry.bn_state))
+                    except Exception:  # noqa: BLE001 — fail-soft tier
+                        try:
+                            self.registry.counter(
+                                "resilience/cache_errors").inc()
+                        except Exception:
+                            pass
+                    finally:
+                        self._l2_queue.task_done()
+            import threading as _threading
+            self._l2_writer = _threading.Thread(
+                target=_l2_publish_loop, name="l2-publisher",
+                daemon=True)
+            self._l2_writer.start()
         # Warm-start store (parallel/aot.py): per-bucket adapt/predict
         # executables load from disk instead of compiling — a restarted
         # serving process (and the hot-swap canary, which shares these
@@ -239,11 +295,35 @@ class ServingEngine:
         engine._state_fingerprint = fingerprint
         return engine
 
+    def l2_flush(self, timeout_s: float = 30.0) -> bool:
+        """Wait for every queued L2 publish to land (bounded). Callers
+        that need publish VISIBILITY — a replica about to drain away
+        its tenants, a test asserting on the tier — flush; the serve
+        path never does."""
+        if self._l2_queue is None:
+            return True
+        deadline = time.monotonic() + timeout_s
+        while self._l2_queue.unfinished_tasks:
+            if time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
     def close(self) -> None:
         """Detach the process-wide compile listener and restore the
         previous resilience registry (a test or driver may build many
         engines; each should count only its own). The engine-owned
         watchdog/beacon/recorder, if any, follow the same discipline."""
+        if self._l2_queue is not None:
+            self.l2_flush(timeout_s=5.0)  # best-effort tail publishes
+            try:
+                # Non-blocking poison pill: if the queue is still full
+                # the writer is wedged (hung shared-storage fsync) —
+                # close() must not join that fate; the daemon thread
+                # dies with the process.
+                self._l2_queue.put_nowait(None)
+            except Exception:
+                pass
         self._compile_watch.uninstall()
         resilience.set_registry(self._prev_resilience_registry)
         if self._watchdog is not None:
@@ -390,7 +470,7 @@ class ServingEngine:
                                     context=self._fp_context)
                 for r in group]
         entries: Dict[int, Any] = {}
-        hit_flags: List[bool] = []
+        tiers: List[Optional[str]] = []
         misses: List[int] = []
         for i, key in enumerate(keys):
             try:
@@ -398,15 +478,35 @@ class ServingEngine:
             except Exception:
                 reg.counter("resilience/cache_errors").inc()
                 cached = None
-            hit_flags.append(cached is not None)
+            tier = "l1" if cached is not None else None
+            if cached is None and self.l2 is not None:
+                # Shared-tier probe: a tenant adapted on another
+                # replica (or a previous life of this one) costs a
+                # verified disk read instead of the adapt executable.
+                # l2.get is fail-soft by contract (damage = counted
+                # miss); the found entry also back-fills the L1 so the
+                # NEXT repeat never leaves the process.
+                blob = self.l2.get(key)
+                if blob is not None:
+                    cached = AdaptedTask(
+                        fast=blob["fast"], bn_state=blob["bn_state"],
+                        support_loss=np.zeros((), np.float32))
+                    tier = "l2"
+                    try:
+                        self.cache.put(key, cached)
+                    except Exception:
+                        reg.counter("resilience/cache_errors").inc()
+            tiers.append(tier)
             if cached is not None:
                 entries[i] = cached
             else:
                 misses.append(i)
+        hit_flags = [t is not None for t in tiers]
         # Flight-ring context for post-mortems: which group was in
-        # flight, and how much of it the cache absorbed.
+        # flight, and how much of it each cache tier absorbed.
         flightrec.record("serve_batch", group=len(group),
                          cache_hits=sum(hit_flags),
+                         l2_hits=sum(1 for t in tiers if t == "l2"),
                          cache_misses=len(misses))
 
         if misses:
@@ -425,6 +525,15 @@ class ServingEngine:
                 except Exception:
                     # A failed store only costs the NEXT repeat an adapt.
                     reg.counter("resilience/cache_errors").inc()
+                if self._l2_queue is not None:
+                    # Publish fleet-wide OFF the response path (the
+                    # writer thread pays the device_get + fsync); a
+                    # full queue sheds the publish, counted — it only
+                    # costs the next cross-replica repeat an adapt.
+                    try:
+                        self._l2_queue.put_nowait((keys[i], entry))
+                    except Exception:
+                        reg.counter("resilience/cache_errors").inc()
 
         logits = self._run_predict([entries[i] for i in range(len(group))],
                                    group, bucket)
@@ -439,7 +548,8 @@ class ServingEngine:
                 predictions=np.argmax(lg, axis=-1),
                 logits=lg,
                 cache_hit=hit_flags[i],
-                latency_seconds=t_done - req.arrival_time))
+                latency_seconds=t_done - req.arrival_time,
+                cache_tier=tiers[i]))
         self._mirror_cache_counters()
         return responses
 
@@ -511,6 +621,33 @@ class ServingEngine:
         return logits
 
     # -- hot-swap (model registry + canary) -------------------------------
+    def pin_rejected(self, version: int) -> None:
+        """Pin one registry version as rejected so this engine never
+        canaries or swaps to it. The local canary-fail path pins
+        automatically; this is the FLEET path — a rolling-swap halt on
+        any replica pins the version on every replica (the controller
+        publishes the list, replicas apply it here)."""
+        self._rejected_versions.add(int(version))
+
+    def adopt_version(self, rec: Dict[str, Any],
+                      state: MetaTrainState) -> None:
+        """Atomically (from the request path's perspective) flip the
+        live state, cache context and version together between steps.
+        Old cache entries die by key (the fingerprint context), not by
+        an explicit clear. The canary-passed swap path uses this; so
+        does the fleet replica's startup rollback away from a
+        fleet-rejected version (serve/fleet/replica.py)."""
+        self.state = state
+        self._fp_context = (f"ckpt:{rec['tag']}:"
+                            f"{rec.get('fingerprint')}")
+        self._state_fingerprint = rec.get("fingerprint")
+        self._model_version = int(rec.get("version") or 0)
+
+    def load_registry_version(self, rec: Dict[str, Any]) -> MetaTrainState:
+        """Public face of the version loader (the migrate/reconcile
+        chain + mesh replication) for fleet-side callers."""
+        return self._load_version(rec)
+
     def maybe_hot_swap(self, now: Optional[float] = None,
                        force: bool = False) -> Optional[Dict[str, Any]]:
         """Poll the model registry; canary + swap a newly published
@@ -586,16 +723,7 @@ class ServingEngine:
                     "reason": f"load failed: {type(e).__name__}: {e}"}
         verdict = self._run_canary(candidate)
         if verdict["pass"]:
-            # Atomic from the request path's perspective: state, cache
-            # context and fingerprint flip together between steps. Old
-            # cache entries die by key (the fingerprint context), not by
-            # an explicit clear — the LRU evicts them as traffic warms
-            # the new version's entries.
-            self.state = candidate
-            self._fp_context = (f"ckpt:{rec['tag']}:"
-                                f"{rec.get('fingerprint')}")
-            self._state_fingerprint = rec.get("fingerprint")
-            self._model_version = version
+            self.adopt_version(dict(rec, version=version), candidate)
             self.registry.counter("serve/hot_swaps").inc()
             flightrec.record("hot_swap", version=version, tag=rec["tag"])
             return {"version": version, "swapped": True,
@@ -763,6 +891,11 @@ class ServingEngine:
         reg.counter("serve/cache_evictions").inc(e - pe)
         self._cache_mirrored = (h, m, e)
         reg.gauge("serve/cache_size").set(len(self.cache))
+        # Approximate resident bytes: with eviction churn, the pair of
+        # (cache_bytes, cache_evictions) is the L1 half of the fleet
+        # autoscale signal — a replica evicting hot tenants is full, a
+        # near-empty one is drainable.
+        reg.gauge("serve/cache_bytes").set(self.cache.approx_bytes)
         total = h + m
         if total:
             reg.gauge("serve/cache_hit_frac").set(h / total)

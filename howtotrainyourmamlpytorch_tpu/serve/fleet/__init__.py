@@ -1,0 +1,38 @@
+"""Serving fleet: router + shared L2 cache + rolling-swap controller.
+
+The layer that turns ONE ServingEngine into a service (docs/SERVING.md
+§ Fleet): a jax-free front router doing consistent-hash + bounded-load
+routing on the support-set content (``fleet/router.py``), a shared
+filesystem L2 adapted-params tier between replicas
+(``fleet/l2cache.py``), a fleet controller that makes the registry
+hot-swap a one-replica-at-a-time rolling swap with fleet-wide canary
+pinning (``fleet/controller.py``), and the replica worker process the
+router routes to (``fleet/replica.py``).
+
+Import discipline: router/l2cache/controller have NO package imports
+(stdlib + numpy only) so a frontend process can load them by file path
+and stay jax-free — ``scripts/fleet_bench.py`` does. Importing them
+through THIS package is the convenient path for code that already pays
+the jax import (tests, the engine). ``replica`` is deliberately not
+imported here: it is a worker entrypoint that builds a full engine.
+"""
+
+from howtotrainyourmamlpytorch_tpu.serve.fleet.controller import (
+    FleetController,
+    advise,
+)
+from howtotrainyourmamlpytorch_tpu.serve.fleet.l2cache import (
+    L2AdaptedParamsCache,
+)
+from howtotrainyourmamlpytorch_tpu.serve.fleet.router import (
+    FleetRouter,
+    HashRing,
+    ReplicaLease,
+    read_members,
+    routing_key,
+)
+
+__all__ = [
+    "FleetController", "FleetRouter", "HashRing", "L2AdaptedParamsCache",
+    "ReplicaLease", "advise", "read_members", "routing_key",
+]

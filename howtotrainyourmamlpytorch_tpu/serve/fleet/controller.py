@@ -1,0 +1,334 @@
+r"""Fleet controller: rolling hot-swap + autoscale/drain signals.
+
+The single-engine hot-swap (``serve/engine.py § maybe_hot_swap``) makes
+one replica adopt a newly published checkpoint behind a canary. At
+fleet scale that must become a **rolling** swap — replicas swap one at
+a time *behind the router*, so the fleet never has fewer than N-1
+replicas serving and a bad version is caught by the FIRST replica's
+canary instead of torching all of them at once.
+
+Coordination is file-based over the same shared ``fleet_dir`` the
+leases live in (no new transport; a controller crash loses nothing —
+the state machine is one small JSON, re-entered on the next tick):
+
+* ``ROLLOUT.json`` — the rollout record ``{version, replicas, index,
+  state, rejected}``, atomically rewritten on every transition
+  (``ckpt/manifest.py`` idiom). ``rejected`` is the FLEET-WIDE pin
+  list: replicas read it every loop and refuse those versions locally,
+  so one canary fail stops the version everywhere, not just where it
+  failed.
+* **Drain = lease tombstone** (``router.py § drain_path``): the
+  controller tombstones exactly one replica at a time. A tombstoned
+  replica leaves the ring (the router spills its tenants to the next
+  ring position, where the shared L2 absorbs the re-adapt), finishes
+  its queue, runs the engine's canary + swap, and reports the outcome
+  through its lease payload (``version`` on success, ``swap_failed``
+  on a canary rejection). The controller's ``tick()`` reads that
+  payload and advances / halts.
+
+State machine (docs/SERVING.md § Fleet has the prose version)::
+
+    idle -> rolling --(replica acked version)--> rolling(index+1)
+                 \--(swap_failed / replica died)--> halted (version
+                    pinned in `rejected`, tombstone removed)
+    rolling(index == len(replicas)) -> done
+
+Autoscale/drain signals: :meth:`publish_signals` folds the per-replica
+serving stats the replicas already publish in their lease payloads
+(queue depth, p95, cache hit fraction — derived from the existing
+serve/* telemetry on the replica side) into ``fleet/*`` gauges and
+delta-accumulated counters in the controller's registry, so one flush
+row carries the whole fleet picture and the report's fleet section
+stays reset-aware across replica restarts.
+
+Stdlib-only, no package imports (loadable by file path — the jax-free
+router process hosts the controller).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+ROLLOUT_FILE = "ROLLOUT.json"
+ROLLOUT_SCHEMA = "maml_fleet_rollout_v1"
+
+IDLE = "idle"
+ROLLING = "rolling"
+DONE = "done"
+HALTED = "halted"
+
+# Eagerly-registered controller metrics.
+SWAPS_COUNTER = "fleet/rolling_swaps"
+SWAP_STEPS_COUNTER = "fleet/rolling_swap_steps"
+HALTS_COUNTER = "fleet/rolling_swap_halts"
+QUEUE_GAUGE = "fleet/queue_depth_total"
+P95_GAUGE = "fleet/p95_ms_max"
+HIT_FRAC_GAUGE = "fleet/cache_hit_frac_min"
+
+# Replica-side aggregate counters re-published fleet-wide (summed over
+# replica payloads, delta-accumulated so the controller's counters stay
+# monotonic even when a replica restarts and its own counts reset).
+# DISTINCT names from the replicas' own fleet/l2_* counters: a log that
+# carries both a replica's flush rows and the controller's would
+# otherwise feed the telemetry report the same hits twice.
+_AGG_COUNTERS = {
+    "l2_hits": "fleet/agg_l2_hits",
+    "l2_misses": "fleet/agg_l2_misses",
+    "l2_errors": "fleet/agg_l2_errors",
+    "responses": "fleet/agg_responses_total",
+}
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    # Mirrors ckpt/manifest.py § atomic_write_json (re-implemented so
+    # this module stays loadable by file path).
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class FleetController:
+    """Rolling-swap driver + fleet signal aggregator.
+
+    ``members`` is a zero-arg callable returning the router's
+    membership snapshot (``FleetRouter.refresh``'s return shape:
+    ``{rid: {"state", "age", "payload", "draining"}}``) — injected
+    rather than re-read here so the router and controller always act
+    on ONE view per loop, and so tests drive the state machine with a
+    plain dict.
+    """
+
+    def __init__(self, fleet_dir: str,
+                 members: Callable[[], Dict[int, Dict[str, Any]]],
+                 *, registry: Optional[Any] = None,
+                 step_stall_timeout_s: float = 600.0):
+        self.fleet_dir = fleet_dir
+        self.members = members
+        self.registry = registry
+        self.step_stall_timeout_s = float(step_stall_timeout_s)
+        self.rollout_path = os.path.join(fleet_dir, ROLLOUT_FILE)
+        self._agg_prev: Dict[str, Dict[int, float]] = {}
+        if registry is not None:
+            for name in (SWAPS_COUNTER, SWAP_STEPS_COUNTER, HALTS_COUNTER):
+                registry.counter(name)
+            for name in _AGG_COUNTERS.values():
+                registry.counter(name)
+
+    # -- rollout record ---------------------------------------------------
+    def read_rollout(self) -> Dict[str, Any]:
+        try:
+            with open(self.rollout_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return {"schema": ROLLOUT_SCHEMA, "state": IDLE,
+                    "version": None, "replicas": [], "index": 0,
+                    "rejected": []}
+        doc.setdefault("state", IDLE)
+        doc.setdefault("rejected", [])
+        doc.setdefault("replicas", [])
+        doc.setdefault("index", 0)
+        return doc
+
+    def _write_rollout(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        doc["schema"] = ROLLOUT_SCHEMA
+        doc["updated_ts"] = time.time()  # the stall clock (tick)
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        _atomic_write_json(self.rollout_path, doc)
+        return doc
+
+    # -- drain tombstones -------------------------------------------------
+    def _drain_path(self, rid: int) -> str:
+        # router.py § drain_path, inlined (no package imports).
+        return os.path.join(self.fleet_dir, f"replica_{int(rid)}.drain")
+
+    def drain(self, rid: int, reason: str = "drain",
+              version: Optional[int] = None) -> None:
+        """Tombstone one replica: it leaves the ring on the router's
+        next refresh while its lease stays alive. Also the manual
+        scale-down path — an operator drains, waits for in-flight to
+        settle, then stops the process."""
+        os.makedirs(self.fleet_dir, exist_ok=True)
+        _atomic_write_json(self._drain_path(rid),
+                           {"reason": reason, "version": version})
+
+    def undrain(self, rid: int) -> None:
+        try:
+            os.remove(self._drain_path(rid))
+        except OSError:
+            pass
+
+    # -- rolling swap -----------------------------------------------------
+    def start_rollout(self, version: int,
+                      replicas: Optional[List[int]] = None
+                      ) -> Dict[str, Any]:
+        """Begin a rolling swap to ``version``. Replicas default to the
+        current live membership in id order (deterministic — operators
+        and tests see the same order). Prior ``rejected`` pins carry
+        over: a version rejected once stays rejected."""
+        doc = self.read_rollout()
+        if version in doc.get("rejected", []):
+            return doc  # pinned: never roll a known-bad version
+        if replicas is None:
+            snapshot = self.members()
+            replicas = sorted(r for r, rec in snapshot.items()
+                              if rec.get("state") == "live")
+        doc.update({"state": ROLLING if replicas else DONE,
+                    "version": int(version),
+                    "replicas": [int(r) for r in replicas], "index": 0})
+        # Rollout record FIRST, tombstone second: a crash between the
+        # two leaves a rolling record whose next tick() re-drains (the
+        # drain write is idempotent) — the reverse order would strand
+        # a tombstoned replica with no record telling anyone to ever
+        # lift it.
+        doc = self._write_rollout(doc)
+        if replicas:
+            self.drain(replicas[0], reason="rolling_swap",
+                       version=int(version))
+        return doc
+
+    def tick(self) -> Dict[str, Any]:
+        """Advance the rollout one observation: read the draining
+        replica's lease payload and decide. Idempotent and re-entrant —
+        call it from the router loop at any cadence."""
+        doc = self.read_rollout()
+        if doc["state"] != ROLLING:
+            return doc
+        version = int(doc["version"])
+        replicas = doc["replicas"]
+        rid = replicas[doc["index"]]
+        rec = self.members().get(rid) or {}
+        payload = rec.get("payload") or {}
+        failed = (payload.get("swap_failed") == version
+                  or version in (payload.get("rejected") or []))
+        died = rec.get("state", "dead") == "dead"
+        if failed or died:
+            # Canary fail (or the replica died mid-swap — same verdict:
+            # this version does not roll) halts the WHOLE rollout and
+            # pins the version fleet-wide; replicas poll the rejected
+            # list and refuse it locally too.
+            self.undrain(rid)
+            doc["state"] = HALTED
+            doc["halt_reason"] = ("replica died mid-swap" if died
+                                  else "canary failed")
+            doc["halt_detail"] = payload.get("swap_reason")
+            doc["halt_replica"] = rid
+            if version not in doc["rejected"]:
+                doc["rejected"].append(version)
+            if self.registry is not None:
+                self.registry.counter(HALTS_COUNTER).inc()
+            return self._write_rollout(doc)
+        if int(payload.get("version") or -1) >= version:
+            # Acked: rejoin this replica, move to the next.
+            self.undrain(rid)
+            doc["index"] += 1
+            if self.registry is not None:
+                self.registry.counter(SWAP_STEPS_COUNTER).inc()
+            if doc["index"] >= len(replicas):
+                doc["state"] = DONE
+                if self.registry is not None:
+                    self.registry.counter(SWAPS_COUNTER).inc()
+            else:
+                self.drain(replicas[doc["index"]], reason="rolling_swap",
+                           version=version)
+            return self._write_rollout(doc)
+        # Still draining/swapping: wait — but make sure the tombstone
+        # actually exists (a crash between the rollout write and the
+        # drain, or an operator's stray cleanup, must heal rather than
+        # wait forever on a replica that was never told to swap).
+        if not os.path.exists(self._drain_path(rid)):
+            self.drain(rid, reason="rolling_swap", version=version)
+        # Stall backstop: a LIVE replica that can never decide (e.g.
+        # the target version was retired from the registry mid-rollout,
+        # so its maybe_hot_swap keeps seeing nothing to do) must not
+        # keep one replica tombstoned at N-1 capacity forever. A stall
+        # is NOT a canary verdict: halt WITHOUT pinning the version,
+        # so an operator can retry the same rollout once the cause is
+        # fixed.
+        age = time.time() - float(doc.get("updated_ts") or time.time())
+        if self.step_stall_timeout_s > 0 and age > self.step_stall_timeout_s:
+            self.undrain(rid)
+            doc["state"] = HALTED
+            doc["halt_reason"] = "rollout step stalled"
+            doc["halt_detail"] = (f"replica {rid} made no swap decision "
+                                  f"in {age:.0f}s")
+            doc["halt_replica"] = rid
+            if self.registry is not None:
+                self.registry.counter(HALTS_COUNTER).inc()
+            return self._write_rollout(doc)
+        return doc
+
+    # -- autoscale / drain signals ---------------------------------------
+    def publish_signals(self,
+                        snapshot: Optional[Dict[int, Dict[str, Any]]] = None
+                        ) -> Dict[str, Any]:
+        """Fold per-replica lease-payload stats into fleet/* metrics.
+
+        Gauges take the fleet-aggregate view (total queue depth, worst
+        p95, worst hit fraction — the autoscale inputs); counters sum
+        replica-published cumulative counts with per-replica reset
+        detection (a restarted replica's counts drop to 0; the delta
+        rule contributes only growth, the Prometheus rate() rule the
+        report also applies)."""
+        snapshot = self.members() if snapshot is None else snapshot
+        queue_total = 0.0
+        p95_max: Optional[float] = None
+        hit_min: Optional[float] = None
+        sums: Dict[str, float] = {k: 0.0 for k in _AGG_COUNTERS}
+        for rid, rec in sorted(snapshot.items()):
+            payload = rec.get("payload") or {}
+            stats = payload.get("stats") or {}
+            queue_total += float(stats.get("queue_depth") or 0.0)
+            v = stats.get("p95_ms")
+            if isinstance(v, (int, float)):
+                p95_max = v if p95_max is None else max(p95_max, v)
+            v = stats.get("cache_hit_frac")
+            if isinstance(v, (int, float)):
+                hit_min = v if hit_min is None else min(hit_min, v)
+            for label in _AGG_COUNTERS:
+                v = stats.get(label)
+                if not isinstance(v, (int, float)):
+                    continue
+                prev = self._agg_prev.setdefault(label, {})
+                p = prev.get(rid, 0.0)
+                delta = float(v) if v < p else float(v) - p
+                prev[rid] = float(v)
+                sums[label] += delta
+        if self.registry is not None:
+            self.registry.gauge(QUEUE_GAUGE).set(queue_total)
+            if p95_max is not None:
+                self.registry.gauge(P95_GAUGE).set(p95_max)
+            if hit_min is not None:
+                self.registry.gauge(HIT_FRAC_GAUGE).set(hit_min)
+            for label, name in _AGG_COUNTERS.items():
+                if sums[label] > 0:
+                    self.registry.counter(name).inc(sums[label])
+        return {"queue_depth_total": queue_total, "p95_ms_max": p95_max,
+                "cache_hit_frac_min": hit_min,
+                **{k: sums[k] for k in _AGG_COUNTERS}}
+
+
+def advise(signals: Dict[str, Any], *, live: int,
+           queue_per_replica_high: float = 32.0,
+           p95_high_ms: float = 2000.0,
+           queue_per_replica_low: float = 1.0,
+           min_replicas: int = 1) -> str:
+    """Pure autoscale verdict from one signal snapshot: ``scale_up``
+    when queueing or tail latency says the fleet is behind,
+    ``scale_down`` when it is idle beyond the floor, else ``hold``.
+    Deliberately a function, not a loop — the operator (or bench)
+    decides what to do with the advice."""
+    live = max(int(live), 1)
+    per = float(signals.get("queue_depth_total") or 0.0) / live
+    p95 = signals.get("p95_ms_max")
+    if per >= queue_per_replica_high or (
+            isinstance(p95, (int, float)) and p95 >= p95_high_ms):
+        return "scale_up"
+    if per <= queue_per_replica_low and live > max(min_replicas, 1):
+        return "scale_down"
+    return "hold"

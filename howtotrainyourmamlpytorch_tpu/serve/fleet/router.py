@@ -1,0 +1,443 @@
+"""Fleet front router: consistent-hash + bounded-load request routing.
+
+The single-process ServingEngine (PR 2) keeps its adapted-params LRU
+in-proc, so WHO serves a request decides whether the expensive adapt
+step runs at all. This router exists to exploit that: repeat tenants —
+the "adapt once, predict many" pattern the cache is built for — are
+routed by a **consistent hash of their support-set content** back to
+the replica whose L1 already holds their adaptation. Scaling the fleet
+then scales the *working set* (aggregate L1 capacity), which on any
+hardware is the serving win that raw per-replica FLOPs cannot give.
+
+Three pieces, all host-side and deliberately **jax-free**:
+
+* :class:`HashRing` — classic consistent hashing with virtual nodes:
+  each replica owns ``vnodes`` pseudo-random points on a 64-bit ring;
+  a key routes to the first replica clockwise from its hash. Adding or
+  removing one replica moves only ~1/N of the key space (pinned in
+  tests/test_fleet.py § ring churn).
+* **Bounded-load spill** (:meth:`FleetRouter.route`) — plain
+  consistent hashing lets one hot tenant melt one replica. Following
+  the bounded-load variant (Mirrokni et al.), a replica may hold at
+  most ``ceil(load_factor * (in_flight + 1) / N)`` outstanding
+  requests; a key whose primary is at capacity spills to the next ring
+  position (counted ``fleet/router_spills``) — affinity degrades
+  gracefully instead of queueing without bound.
+* **Membership from heartbeat leases** — replicas announce themselves
+  exactly the way pod hosts do (``resilience/cluster.py``): an
+  mtime-stamped lease file per replica under ``<fleet_dir>/``, aged
+  into live/stalled/dead (inclusive-boundary thresholds, negative ages
+  clamp to fresh — the ClusterMonitor rules, re-implemented here so
+  this module stays loadable by file path with no package imports, the
+  ``ckpt/registry.py`` discipline). Unlike cluster leases, the JSON
+  payload here is load-bearing (port, served version, queue/latency
+  stats), so it is written atomically (tmp + rename) and a torn or
+  unparseable payload degrades that replica to age-only membership,
+  never to a crash. **Drain = lease tombstone**: a sidecar
+  ``replica_<i>.drain`` file marks a replica draining — it keeps its
+  lease fresh (the process is alive) but leaves the ring, so its keys
+  spill to their next ring position while in-flight work completes.
+
+The module is stdlib-only (numpy arrays are accepted where they appear
+— ``routing_key`` needs only ``.tobytes()`` — but never imported) so a
+frontend process can load it by file path and route without ever
+initializing an accelerator runtime. ``scripts/fleet_bench.py`` does
+exactly that.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import math
+import os
+import pickle
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+LEASE_PREFIX = "replica_"
+LEASE_SUFFIX = ".lease"
+DRAIN_SUFFIX = ".drain"
+
+LIVE = "live"
+STALLED = "stalled"
+DEAD = "dead"
+
+# Eagerly-registered router metrics (telemetry satellite): a flush row
+# must show "0 spills", not an absent key.
+REQUESTS_COUNTER = "fleet/router_requests"
+SPILLS_COUNTER = "fleet/router_spills"
+NO_REPLICA_COUNTER = "fleet/router_no_replica"
+LIVE_GAUGE = "fleet/replicas_live"
+DRAINING_GAUGE = "fleet/replicas_draining"
+
+
+def lease_path(fleet_dir: str, replica_id: int) -> str:
+    return os.path.join(fleet_dir,
+                        f"{LEASE_PREFIX}{int(replica_id)}{LEASE_SUFFIX}")
+
+
+def drain_path(fleet_dir: str, replica_id: int) -> str:
+    return os.path.join(fleet_dir,
+                        f"{LEASE_PREFIX}{int(replica_id)}{DRAIN_SUFFIX}")
+
+
+def routing_key(support_x: Any, support_y: Any) -> str:
+    """Content key of one tenant's support set, for ROUTING only.
+
+    Same construction as ``serve/cache.py § support_fingerprint`` minus
+    the adapt-step count and checkpoint context: the router must keep a
+    tenant pinned to its replica ACROSS hot-swaps (the new version
+    re-adapts fastest where the tenant's traffic already lands), so the
+    routing identity is the tenant content alone. The engine-side cache
+    key stays the full fingerprint — the two are deliberately different
+    keys for different jobs.
+    """
+    h = hashlib.sha256()
+    for arr in (support_x, support_y):
+        h.update(str(getattr(arr, "dtype", type(arr))).encode())
+        h.update(str(getattr(arr, "shape", ())).encode())
+        h.update(arr.tobytes() if hasattr(arr, "tobytes") else bytes(arr))
+    return h.hexdigest()
+
+
+def _point(token: str) -> int:
+    """64-bit ring position of one token (replica vnode or key)."""
+    return int.from_bytes(
+        hashlib.sha256(token.encode()).digest()[:8], "big")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Pure and immutable: membership churn builds a NEW ring (they are
+    tiny — N replicas x vnodes points), which is what makes the
+    stability property testable as a function.
+    """
+
+    def __init__(self, members: Sequence[int], vnodes: int = 64):
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.members = sorted(int(m) for m in set(members))
+        self.vnodes = int(vnodes)
+        points: List[tuple] = []
+        for m in self.members:
+            for v in range(self.vnodes):
+                points.append((_point(f"replica:{m}:vnode:{v}"), m))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [m for _, m in points]
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+    def candidates(self, key: str) -> List[int]:
+        """Every member, in ring order starting at ``key``'s position —
+        element 0 is the primary, the rest are the spill order (each
+        member listed once)."""
+        if not self.members:
+            return []
+        idx = bisect.bisect_left(self._points, _point(f"key:{key}"))
+        seen: List[int] = []
+        n = len(self._points)
+        for i in range(n):
+            owner = self._owners[(idx + i) % n]
+            if owner not in seen:
+                seen.append(owner)
+                if len(seen) == len(self.members):
+                    break
+        return seen
+
+    def primary(self, key: str) -> Optional[int]:
+        c = self.candidates(key)
+        return c[0] if c else None
+
+
+class ReplicaLease:
+    """Write side of one replica's membership lease.
+
+    The ``resilience/cluster.py § HeartbeatLease`` idiom (mtime IS the
+    liveness signal, rate-limited, fail-soft, a failed write does not
+    consume the rate-limit window) with one deliberate difference: the
+    payload is load-bearing here (port, version, serving stats the
+    router and controller read), so the write is atomic (tmp + rename)
+    — a reader must never parse a torn JSON and drop a live replica
+    from the ring.
+    """
+
+    def __init__(self, fleet_dir: str, replica_id: int, interval_s: float):
+        self.fleet_dir = fleet_dir
+        self.replica_id = int(replica_id)
+        self.interval_s = float(interval_s)
+        self.path = lease_path(fleet_dir, replica_id)
+        self._lock = threading.Lock()
+        self._last_touch = -math.inf
+        self.touches = 0
+        self.errors = 0
+
+    @property
+    def due(self) -> bool:
+        """Whether the rate-limit window has elapsed — lets callers
+        skip building an expensive payload that ``touch`` would only
+        discard."""
+        return time.monotonic() - self._last_touch >= self.interval_s
+
+    def touch(self, payload: Optional[Dict[str, Any]] = None,
+              force: bool = False) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last_touch < self.interval_s:
+                return False
+            prev = self._last_touch
+            self._last_touch = now
+        try:
+            os.makedirs(self.fleet_dir, exist_ok=True)
+            doc = {"replica": self.replica_id, "pid": os.getpid(),
+                   "ts": time.time()}
+            doc.update(payload or {})
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, self.path)
+            self.touches += 1
+            return True
+        except OSError:
+            self.errors += 1
+            with self._lock:
+                if self._last_touch == now:
+                    self._last_touch = prev
+            return False
+
+
+def read_members(fleet_dir: str,
+                 now: Optional[float] = None) -> Dict[int, Dict[str, Any]]:
+    """Per-replica membership snapshot, fail-soft.
+
+    Returns ``{replica_id: {"age": seconds, "payload": dict|None,
+    "draining": bool}}``. Ages follow the cluster-lease rules (clock
+    skew clamps to 0; a stat race skips the file rather than inventing
+    an age); an unparseable payload degrades to ``None`` — the mtime
+    still proves liveness. A drain tombstone marks the replica
+    draining whether or not its lease is healthy.
+    """
+    out: Dict[int, Dict[str, Any]] = {}
+    now = time.time() if now is None else now
+    try:
+        names = os.listdir(fleet_dir)
+    except OSError:
+        names = []
+    for name in names:
+        if not name.startswith(LEASE_PREFIX):
+            continue
+        if name.endswith(LEASE_SUFFIX):
+            raw = name[len(LEASE_PREFIX):-len(LEASE_SUFFIX)]
+            if not raw.isdigit():
+                continue
+            path = os.path.join(fleet_dir, name)
+            try:
+                mtime = os.stat(path).st_mtime
+            except OSError:
+                continue
+            payload: Optional[Dict[str, Any]] = None
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                if isinstance(doc, dict):
+                    payload = doc
+            except (OSError, ValueError):
+                payload = None
+            out.setdefault(int(raw), {})
+            out[int(raw)].update({
+                "age": max(now - mtime, 0.0), "payload": payload})
+        elif name.endswith(DRAIN_SUFFIX):
+            raw = name[len(LEASE_PREFIX):-len(DRAIN_SUFFIX)]
+            if raw.isdigit():
+                out.setdefault(int(raw), {})["draining"] = True
+    for rec in out.values():
+        rec.setdefault("age", math.inf)
+        rec.setdefault("payload", None)
+        rec.setdefault("draining", False)
+    return out
+
+
+def classify(age: float, stalled_after_s: float, dead_after_s: float) -> str:
+    """Lease age -> live/stalled/dead; the ClusterMonitor boundary rules
+    (inclusive on the healthy side so an exactly-on-time lease never
+    flaps; a missing lease arrives as ``inf`` = dead)."""
+    if age <= stalled_after_s:
+        return LIVE
+    if age <= dead_after_s:
+        return STALLED
+    return DEAD
+
+
+class FleetRouter:
+    """Membership + ring + bounded-load pick, with in-flight accounting.
+
+    ``refresh()`` re-reads the lease dir and rebuilds the ring from
+    live, non-draining replicas (cheap: a handful of small files — the
+    caller decides the cadence). ``route(key)`` picks a replica and
+    counts it in flight; the caller MUST pair it with ``complete()``
+    when the response lands (or the request errors), or the load
+    accounting — and with it the spill behavior — drifts.
+
+    ``registry`` is duck-typed on the telemetry MetricsRegistry
+    (counter/gauge get-or-create); None runs unobserved.
+    """
+
+    def __init__(self, fleet_dir: str, *, vnodes: int = 64,
+                 load_factor: float = 1.25,
+                 stalled_after_s: float = 1.5,
+                 dead_after_s: float = 3.0,
+                 registry: Optional[Any] = None):
+        if load_factor < 1.0:
+            raise ValueError(
+                f"load_factor must be >= 1.0, got {load_factor}")
+        if dead_after_s < stalled_after_s:
+            raise ValueError(
+                f"dead_after_s {dead_after_s} < stalled_after_s "
+                f"{stalled_after_s}: a dead replica must first be stalled")
+        self.fleet_dir = fleet_dir
+        self.vnodes = int(vnodes)
+        self.load_factor = float(load_factor)
+        self.stalled_after_s = float(stalled_after_s)
+        self.dead_after_s = float(dead_after_s)
+        self.registry = registry
+        self.ring = HashRing([], vnodes=self.vnodes)
+        self.members: Dict[int, Dict[str, Any]] = {}
+        self._in_flight: Dict[int, int] = {}
+        self._last_pid: Dict[int, Any] = {}
+        self._lock = threading.Lock()
+        if registry is not None:
+            for name in (REQUESTS_COUNTER, SPILLS_COUNTER,
+                         NO_REPLICA_COUNTER):
+                registry.counter(name)
+
+    # -- membership -------------------------------------------------------
+    def refresh(self, now: Optional[float] = None
+                ) -> Dict[int, Dict[str, Any]]:
+        members = read_members(self.fleet_dir, now=now)
+        for rec in members.values():
+            rec["state"] = classify(rec["age"], self.stalled_after_s,
+                                    self.dead_after_s)
+        routable = sorted(r for r, rec in members.items()
+                          if rec["state"] == LIVE and not rec["draining"])
+        with self._lock:
+            self.members = members
+            if routable != self.ring.members:
+                self.ring = HashRing(routable, vnodes=self.vnodes)
+            for r in list(self._in_flight):
+                # A dead/vanished replica's outstanding requests will
+                # never complete(); forget them so its load cannot
+                # poison the bounded-load average forever. A replica
+                # that died and was RESTARTED before any refresh saw it
+                # dead shows up the same way through its changed lease
+                # pid — the new process cannot be holding our old
+                # requests.
+                rec = members.get(r)
+                pid = ((rec or {}).get("payload") or {}).get("pid")
+                if (rec is None or rec.get("state") == DEAD
+                        or (pid is not None
+                            and self._last_pid.get(r) is not None
+                            and pid != self._last_pid[r])):
+                    del self._in_flight[r]
+            for r, rec in members.items():
+                pid = (rec.get("payload") or {}).get("pid")
+                if pid is not None:
+                    self._last_pid[r] = pid
+        if self.registry is not None:
+            self.registry.gauge(LIVE_GAUGE).set(len(routable))
+            self.registry.gauge(DRAINING_GAUGE).set(
+                sum(1 for rec in members.values() if rec["draining"]))
+        return members
+
+    @property
+    def routable(self) -> List[int]:
+        return list(self.ring.members)
+
+    def in_flight(self, replica_id: int) -> int:
+        with self._lock:
+            return self._in_flight.get(int(replica_id), 0)
+
+    # -- routing ----------------------------------------------------------
+    def route(self, key: str) -> Optional[int]:
+        """Pick the replica for ``key``: the ring primary unless it is
+        past its bounded-load capacity, else the next ring position
+        (counted as a spill), else — everyone saturated — the
+        least-loaded routable replica (affinity yields to liveness).
+        None (counted) when the ring is empty."""
+        reg = self.registry
+        with self._lock:
+            cands = self.ring.candidates(key)
+            if not cands:
+                if reg is not None:
+                    reg.counter(NO_REPLICA_COUNTER).inc()
+                return None
+            total = sum(self._in_flight.get(r, 0) for r in cands)
+            cap = math.ceil(self.load_factor * (total + 1) / len(cands))
+            chosen = None
+            for i, r in enumerate(cands):
+                if self._in_flight.get(r, 0) < cap:
+                    chosen = r
+                    spilled = i > 0
+                    break
+            if chosen is None:
+                chosen = min(cands,
+                             key=lambda r: (self._in_flight.get(r, 0), r))
+                spilled = chosen != cands[0]
+            self._in_flight[chosen] = self._in_flight.get(chosen, 0) + 1
+        if reg is not None:
+            reg.counter(REQUESTS_COUNTER).inc()
+            if spilled:
+                reg.counter(SPILLS_COUNTER).inc()
+        return chosen
+
+    def complete(self, replica_id: int) -> None:
+        with self._lock:
+            n = self._in_flight.get(int(replica_id), 0)
+            if n <= 1:
+                self._in_flight.pop(int(replica_id), None)
+            else:
+                self._in_flight[int(replica_id)] = n - 1
+
+
+# ---------------------------------------------------------------------------
+# wire framing (router process <-> replica process)
+# ---------------------------------------------------------------------------
+# Length-prefixed pickle over a localhost socket: 8-byte magic + u32
+# length + payload. Pickle is acceptable here because both ends are OUR
+# processes on one box (the fleet_bench / replica contract), and it
+# round-trips numpy arrays without this module importing numpy. The
+# magic catches a desynced or foreign stream before pickle ever sees it.
+
+WIRE_MAGIC = b"MAMLFLT1"
+_LEN = struct.Struct("!I")
+MAX_FRAME_BYTES = 1 << 28  # 256 MiB: no sane request is bigger
+
+
+def send_msg(sock, obj: Any) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(WIRE_MAGIC + _LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def recv_msg(sock) -> Any:
+    head = _recv_exact(sock, len(WIRE_MAGIC) + _LEN.size)
+    if head[:len(WIRE_MAGIC)] != WIRE_MAGIC:
+        raise ConnectionError(f"bad frame magic {head[:8]!r}")
+    (length,) = _LEN.unpack(head[len(WIRE_MAGIC):])
+    if length > MAX_FRAME_BYTES:
+        raise ConnectionError(f"frame of {length} bytes exceeds cap")
+    return pickle.loads(_recv_exact(sock, length))

@@ -27,8 +27,11 @@ from typing import Any, Dict, List, Optional, Union
 # v10: + "elastic" (elastic pod, resilience/elastic.py: reshard events,
 # current/lost roster, degraded-epoch count, re-expansions — counters
 # reset-aware across the restart-in-place segments the subsystem
-# creates by design)
-SCHEMA = "maml_tpu_telemetry_report_v10"
+# creates by design); v11: + "fleet" (serving fleet, serve/fleet/:
+# replicas live/draining, shared-L2 hits/misses/errors, rolling swaps
+# and halts, router spills — counters reset-aware across replica
+# restarts, gauges last-wins)
+SCHEMA = "maml_tpu_telemetry_report_v11"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -536,6 +539,69 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "lost_hosts": el_lost,
         }
 
+    # Fleet section (serve/fleet/, schema v11): fleet/* metrics ride
+    # registry "metrics" rows from replicas (the L2 tier's counters),
+    # the router/controller process (membership gauges, rolling-swap
+    # counters), or both — counters accumulate reset-aware (a replica
+    # restart resets ITS l2 counters to 0 mid-log, and the fleet
+    # section exists precisely to span replica lifetimes), gauges take
+    # the most recent signal in log order. Unlike the single-process
+    # sections, one fleet log legitimately INTERLEAVES rows from
+    # several replicas (each ReplicaServer flush carries its `replica`
+    # id), so the reset tracking is keyed per (replica, metric) — two
+    # replicas' counters must not read each other's values as resets.
+    # The controller's fleet-wide aggregates publish under fleet/agg_*
+    # (distinct names) so a combined log never counts a hit twice.
+    # Runs without the fleet layer summarize to "unavailable".
+    _FLEET_COUNTERS = {
+        "l2_hits": "fleet/l2_hits",
+        "l2_misses": "fleet/l2_misses",
+        "l2_errors": "fleet/l2_errors",
+        "l2_publishes": "fleet/l2_publishes",
+        "rolling_swaps": "fleet/rolling_swaps",
+        "rolling_swap_halts": "fleet/rolling_swap_halts",
+        "router_spills": "fleet/router_spills",
+    }
+    fl_totals: Dict[str, float] = {}
+    fl_prev: Dict[str, float] = {}
+    fl_seen = False
+    fl_live: Metric = UNAVAILABLE
+    fl_draining: Metric = UNAVAILABLE
+    for e in events:
+        if e.get("event") != "metrics":
+            continue
+        m = e.get("metrics") or {}
+        if not any(k.startswith("fleet/") for k in m):
+            continue
+        fl_seen = True
+        source = str(e.get("replica", ""))
+        for key in _FLEET_COUNTERS.values():
+            if m.get(key) is not None:
+                _accumulate_counter(fl_totals, fl_prev,
+                                    f"{source}:{key}", float(m[key]))
+        if m.get("fleet/replicas_live") is not None:
+            fl_live = int(m["fleet/replicas_live"])
+        if m.get("fleet/replicas_draining") is not None:
+            fl_draining = int(m["fleet/replicas_draining"])
+    fleet_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if fl_seen:
+        def _fl_total(key: str) -> float:
+            # Totals are per (replica, metric); the section reports the
+            # fleet-wide sum over sources.
+            return sum(v for k, v in fl_totals.items()
+                       if k.split(":", 1)[1] == key)
+
+        hits = _fl_total("fleet/l2_hits")
+        misses = _fl_total("fleet/l2_misses")
+        fleet_sec = {
+            "replicas_live": fl_live,
+            "replicas_draining": fl_draining,
+            **{label: int(_fl_total(key))
+               for label, key in _FLEET_COUNTERS.items()},
+            "l2_hit_frac": (round(hits / (hits + misses), 4)
+                            if hits + misses > 0 else UNAVAILABLE),
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -573,6 +639,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "cluster": cluster_sec,
         "warm_start": warm_start_sec,
         "elastic": elastic_sec,
+        "fleet": fleet_sec,
     }
 
 
@@ -608,6 +675,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("cluster", summary["cluster"]),
         ("warm start", summary["warm_start"]),
         ("elastic", summary["elastic"]),
+        ("fleet", summary["fleet"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

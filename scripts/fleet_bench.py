@@ -1,0 +1,893 @@
+"""Fleet benchmark: N replica subprocesses + jax-free router on one box.
+
+The proof harness for the serving fleet (docs/SERVING.md § Fleet): real
+OS processes, real sockets, the real router/controller/L2 — no mocks.
+The driver process stays **jax-free** (router + controller loaded by
+file path, the ckpt_admin.py discipline; the load generator is shared
+with scripts/serve_bench.py); everything that needs jax runs in child
+processes (``--mode prepare`` / ``--mode publish-v2`` and the replica
+workers themselves).
+
+Legs:
+
+1. **single** — ONE replica, no L2, driven through the same router and
+   sockets: the pre-fleet architecture (PR 2's engine) under this
+   workload, the honest baseline.
+2. **fleet** — N replicas (default 3) with consistent-hash routing and
+   the shared L2 tier, same workload, same tenant population.
+   Mid-load, a perturbed checkpoint is published as a new version and
+   the controller runs a ROLLING hot-swap through it — replicas swap
+   one at a time behind the router, so the leg proves zero dropped
+   requests through the swap.
+3. **migration** — after the rollout: serve one tenant on its primary
+   replica A, tombstone-drain A, route the tenant again (it lands on
+   the next ring position B) and assert the response came from the
+   **L2 tier with zero adapt dispatches on B** — the cross-replica
+   "adapt once, predict many" guarantee.
+
+What makes the fleet faster *on one core*: the workload has more
+tenants than one replica's L1 (``--tenants`` > ``--l1-capacity``), so
+the single engine thrashes its LRU and re-adapts repeat tenants, while
+consistent hashing partitions the tenant space so each replica's share
+FITS — the fleet scales the cached working set, not raw FLOPs, which
+is exactly the router's design claim (and the only scaling axis a
+1-core box can demonstrate honestly; on real parallel hardware the
+compute axis multiplies on top).
+
+Artifact contract (bench.py discipline): the LAST stdout JSON line is
+``{"metric": "fleet_bench", ...}`` with per-replica and fleet-aggregate
+QPS/p50/p95/hit fractions, rolling-swap counts and the migration
+verdict. On a box that cannot bind localhost sockets the artifact says
+``"status": "skipped"`` (exit 0) — the chaos_pod.py rule.
+
+Usage:
+    python scripts/fleet_bench.py --quick            # 2-replica CI smoke
+    python scripts/fleet_bench.py                    # full 3-replica proof
+    python scripts/fleet_bench.py --replicas 4 --requests 600 --out /tmp/fb
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import math
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPTS = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _SCRIPTS)
+sys.path.insert(0, _REPO)
+
+from serve_bench import synthetic_arrays, tenant_pool  # noqa: E402
+
+
+def _load_module(name: str, relpath: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_router_mod = _load_module(
+    "_fleet_bench_router_impl",
+    os.path.join("howtotrainyourmamlpytorch_tpu", "serve", "fleet",
+                 "router.py"))
+_controller_mod = _load_module(
+    "_fleet_bench_controller_impl",
+    os.path.join("howtotrainyourmamlpytorch_tpu", "serve", "fleet",
+                 "controller.py"))
+
+def bench_bucket(quick: bool):
+    """(support, query) bucket: the full profile serves 3-way 5-shot
+    (15 support rows — the MAML++ flagship shot count) with a small
+    query set, which is what prices adaptation honestly ABOVE the
+    per-request fixed costs (K inner fwd+bwd passes over 15 rows vs
+    one forward over 2); --quick shrinks to a 1-shot toy."""
+    return (3, 4) if quick else (15, 2)
+
+
+class _MiniMetrics:
+    """Duck-typed stand-in for the telemetry MetricsRegistry (whose
+    import chain pulls jax — this driver must not): counters and
+    gauges only, snapshot()-able into the artifact."""
+
+    class _C:
+        def __init__(self):
+            self.value = 0.0
+
+        def inc(self, amount: float = 1.0):
+            self.value += amount
+
+    class _G:
+        def __init__(self):
+            self.value = None
+
+        def set(self, v):
+            self.value = float(v)
+
+    def __init__(self):
+        self._m: Dict[str, Any] = {}
+
+    def counter(self, name):
+        return self._m.setdefault(name, self._C())
+
+    def gauge(self, name):
+        return self._m.setdefault(name, self._G())
+
+    def snapshot(self):
+        return {k: v.value for k, v in sorted(self._m.items())}
+
+
+def _can_bind_localhost() -> bool:
+    try:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+        return True
+    except OSError:
+        return False
+
+
+def fleet_cfg_dict(out_dir: str, *, quick: bool, l1_capacity: int,
+                   l2_dir: str) -> dict:
+    """The serving workload every process in the bench shares.
+
+    The full profile runs a REALISTICALLY-priced adaptation (20x20
+    images, 16 filters, 3 stages, the MAML++ 5-step evaluation
+    protocol): the fleet's claim is that routing affinity + the L2
+    tier remove adapt WORK, so the adapt must dominate per-request
+    cost the way it does in production — a toy adapt would measure
+    socket overhead instead of the architecture. --quick shrinks
+    everything (tiny model, 2 steps) because the CI smoke asserts
+    plumbing (zero drops, migration), not throughput."""
+    return dict(
+        experiment_name="fleet_bench", experiment_root=out_dir,
+        dataset_name="synthetic_fleet",
+        image_height=(12 if quick else 24),
+        image_width=(12 if quick else 24), image_channels=1,
+        num_classes_per_set=3,
+        num_samples_per_class=(1 if quick else 5),
+        num_target_samples=2, batch_size=4,
+        cnn_num_filters=(4 if quick else 32),
+        num_stages=(2 if quick else 4),
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=(2 if quick else 5),
+        second_order=False, use_multi_step_loss_optimization=False,
+        compute_dtype="float32", mesh_shape=[1, 1],
+        serve_buckets=[list(bench_bucket(quick))], serve_batch_tasks=4,
+        serve_cache_capacity=int(l1_capacity),
+        serve_default_deadline_ms=0.0,
+        serve_max_queue_depth=256,
+        serve_registry_poll_s=0.0,
+        # Canary gates on NOISE probes are luck, not signal, and this
+        # bench's v2 is v1 with a 1e-3 weight perturbation: (a) the
+        # latency gate compares candidate vs live adapt wall time —
+        # scheduling noise when N replicas share one oversubscribed
+        # box; (b) with 2 probes x 2 queries the live engine "beats
+        # chance" on noise pixels often enough to arm the accuracy
+        # gate, turning every swap into a coin flip. Widen both so the
+        # gate that decides this bench's rollout is the one that can
+        # actually fire on bad bytes: finiteness.
+        serve_canary_latency_factor=20.0,
+        serve_canary_acc_drop=1.0,
+        serve_l2_dir=l2_dir,
+        # Fleet knobs — the driver reads THESE (one source of truth
+        # for replicas and router): tight lease cadence for fast
+        # membership, a generous dead threshold (a swap canary on an
+        # oversubscribed box can starve even the side-thread
+        # heartbeat), high vnodes for smooth tenant shares, and a
+        # permissive load factor so affinity — the thing this bench
+        # measures — yields to spill only under real imbalance.
+        fleet_lease_interval_s=0.25,
+        fleet_replica_stalled_s=0.75,
+        fleet_replica_dead_s=5.0,
+        fleet_vnodes=128,
+        fleet_load_factor=2.5,
+        aot_store_dir=os.path.join(out_dir, "aot_store"),
+        watchdog_serve_timeout_s=600.0)
+
+
+# ---------------------------------------------------------------------------
+# jax-side child modes (the driver process never imports jax)
+# ---------------------------------------------------------------------------
+
+def _mode_prepare(cfg_path: str, ckpt_dir: str) -> int:
+    """Save + publish the v1 checkpoint and prewarm the shared AOT
+    store (one warmed engine) so every replica boots warm instead of
+    paying its own compile — the PR 9 warm-start story doing real work."""
+    import jax
+    from howtotrainyourmamlpytorch_tpu.ckpt.registry import ModelRegistry
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.serve import ServingEngine
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        CheckpointManager)
+
+    cfg = MAMLConfig.from_json_file(cfg_path)
+    model_init, _ = make_model(cfg)
+    state = init_train_state(cfg, model_init, jax.random.PRNGKey(cfg.seed))
+    manager = CheckpointManager(ckpt_dir, max_to_keep=4)
+    manager.save(state, epoch=0, current_iter=1, val_acc=0.5)
+    registry = ModelRegistry(ckpt_dir)
+    rec = registry.publish(tag="0", epoch=0, iteration=1, val_acc=0.5,
+                           fingerprint=manager.fingerprint(0))
+    engine = ServingEngine.from_checkpoint(cfg, ckpt_dir)
+    try:
+        engine.warmup()  # populates the AOT store for the whole fleet
+    finally:
+        engine.close()
+    print(json.dumps({"prepared": True, "version": rec["version"]}),
+          flush=True)
+    return 0
+
+
+def _mode_publish_v2(cfg_path: str, ckpt_dir: str) -> int:
+    """Publish a REAL new version (perturbed weights — different bytes,
+    different fingerprint, still finite so the canary passes): the
+    rolling-swap target."""
+    import jax
+    from howtotrainyourmamlpytorch_tpu.ckpt.registry import ModelRegistry
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
+        CheckpointManager)
+
+    cfg = MAMLConfig.from_json_file(cfg_path)
+    model_init, _ = make_model(cfg)
+    template = init_train_state(cfg, model_init,
+                                jax.random.PRNGKey(cfg.seed))
+    manager = CheckpointManager(ckpt_dir, max_to_keep=4)
+    state, _meta = manager.load(template, 0)
+    state = state.replace(params=jax.tree.map(
+        lambda x: x * (1.0 + 1e-3), state.params))
+    manager.save(state, epoch=1, current_iter=2, val_acc=0.6)
+    registry = ModelRegistry(ckpt_dir)
+    rec = registry.publish(tag="1", epoch=1, iteration=2, val_acc=0.6,
+                           fingerprint=manager.fingerprint(1))
+    print(json.dumps({"published": True, "version": rec["version"]}),
+          flush=True)
+    return 0
+
+
+def _run_child(mode: str, cfg_path: str, ckpt_dir: str, out: str,
+               wait: bool = True):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    log = open(os.path.join(out, f"{mode}.log"), "w")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--mode", mode,
+         "--config-path", cfg_path, "--ckpt-dir", ckpt_dir, "--out", out],
+        cwd=_REPO, env=env, stdout=log, stderr=subprocess.STDOUT)
+    if not wait:
+        return proc
+    rc = proc.wait()
+    log.close()
+    if rc != 0:
+        with open(log.name) as f:
+            raise RuntimeError(f"child --mode {mode} failed rc={rc}:\n"
+                               + f.read()[-2000:])
+    return proc
+
+
+# ---------------------------------------------------------------------------
+# replica management (driver side)
+# ---------------------------------------------------------------------------
+
+class ReplicaConn:
+    """One persistent full-duplex connection to a replica: a sender
+    (the driver loop) and a reader thread that dispatches response
+    frames to the bench's completion callback."""
+
+    def __init__(self, rid: int, port: int, on_response):
+        self.rid = rid
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=60)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._on_response = on_response
+        self._send_lock = threading.Lock()
+        self._stats: Optional[dict] = None
+        self._stats_evt = threading.Event()
+        self._stopped_evt = threading.Event()
+        threading.Thread(target=self._reader, daemon=True).start()
+
+    def _reader(self) -> None:
+        try:
+            while True:
+                msg = _router_mod.recv_msg(self.sock)
+                op = msg.get("op")
+                if op == "response":
+                    self._on_response(self.rid, msg)
+                elif op == "stats":
+                    self._stats = msg
+                    self._stats_evt.set()
+                elif op == "stopped":
+                    self._stopped_evt.set()
+                    return
+        except (ConnectionError, OSError, EOFError):
+            self._stopped_evt.set()
+
+    def send(self, msg: dict) -> None:
+        with self._send_lock:
+            _router_mod.send_msg(self.sock, msg)
+
+    def stats(self, timeout: float = 30.0) -> dict:
+        self._stats_evt.clear()
+        self.send({"op": "stats"})
+        if not self._stats_evt.wait(timeout):
+            raise TimeoutError(f"replica {self.rid} stats timed out")
+        return self._stats or {}
+
+    def stop(self, timeout: float = 30.0) -> None:
+        try:
+            self.send({"op": "stop"})
+            self._stopped_evt.wait(timeout)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def start_replicas(out: str, cfg_path: str, ckpt_dir: str,
+                   fleet_dir: str, ids: List[int]) -> Dict[int, Any]:
+    procs = {}
+    for rid in ids:
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        log = open(os.path.join(out, f"replica_{rid}.log"), "w")
+        procs[rid] = (subprocess.Popen(
+            [sys.executable, "-m",
+             "howtotrainyourmamlpytorch_tpu.serve.fleet.replica",
+             "--config", cfg_path, "--replica-id", str(rid),
+             "--fleet-dir", fleet_dir, "--checkpoint", ckpt_dir,
+             "--events", os.path.join(out, f"events_replica_{rid}.jsonl")],
+            cwd=_REPO, env=env, stdout=log, stderr=subprocess.STDOUT),
+            log)
+    return procs
+
+
+def wait_for_replicas(fleet_dir: str, ids: List[int], procs,
+                      timeout_s: float) -> Dict[int, int]:
+    """Block until every replica's lease payload carries its port."""
+    deadline = time.monotonic() + timeout_s
+    ports: Dict[int, int] = {}
+    while time.monotonic() < deadline:
+        members = _router_mod.read_members(fleet_dir)
+        for rid in ids:
+            payload = (members.get(rid) or {}).get("payload") or {}
+            if payload.get("port"):
+                ports[rid] = int(payload["port"])
+        if len(ports) == len(ids):
+            return ports
+        for rid, (proc, _log) in procs.items():
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"replica {rid} exited rc={proc.returncode} before "
+                    f"announcing (see replica_{rid}.log)")
+        time.sleep(0.1)
+    raise TimeoutError(f"replicas {sorted(set(ids) - set(ports))} never "
+                       f"announced within {timeout_s:.0f}s")
+
+
+def stop_replicas(conns: Dict[int, ReplicaConn], procs) -> None:
+    for conn in conns.values():
+        conn.stop()
+    for rid, (proc, log) in procs.items():
+        try:
+            # A replica that never got a stop frame (no conn — startup
+            # failed) won't exit on its own: terminate it directly.
+            proc.wait(timeout=30 if rid in conns else 0.1)
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        log.close()
+    for conn in conns.values():
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# load generation + the drive loop
+# ---------------------------------------------------------------------------
+
+def build_schedule(num_requests: int, num_tenants: int, seed: int,
+                   image_shape, bucket):
+    """Mixed-tenant request schedule over a fixed tenant population
+    (serve_bench's shared generators): every request is some tenant's
+    fixed support set + fresh queries — repeat tenants ARE the
+    workload, exactly the traffic the router's affinity exists for."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    pool = tenant_pool(image_shape, 3, True, rng, [bucket], num_tenants)
+    schedule = []
+    for i in range(num_requests):
+        t = int(rng.randint(num_tenants))
+        sx, sy, q_rows = pool[t]
+        _, _, qx = synthetic_arrays(image_shape, 3, True, rng,
+                                    (1, q_rows))
+        schedule.append({"cid": i, "tenant": t, "sx": sx, "sy": sy,
+                         "qx": qx,
+                         "key": _router_mod.routing_key(sx, sy)})
+    return pool, schedule
+
+
+def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
+              *, max_outstanding: int, controller=None,
+              swap_trigger=None, max_retries: int = 20,
+              stall_timeout_s: float = 300.0) -> dict:
+    """Push the whole schedule through the fleet as fast as the window
+    allows (backlog/throughput mode — the serve_bench rate=0 rule),
+    pumping membership refresh, rollout ticks and the optional mid-load
+    swap trigger from the same loop a real frontend would run."""
+    lock = threading.Lock()
+    cond = threading.Condition(lock)
+    results: Dict[int, dict] = {}
+    rid_of: Dict[int, int] = {}
+    send_ts: Dict[int, float] = {}
+    retry_q: deque = deque()
+    retry_count: Dict[int, int] = {}
+    state = {"outstanding": 0, "retries": 0}
+
+    def on_response(rid: int, msg: dict) -> None:
+        cid = msg.get("id")
+        with cond:
+            router.complete(rid_of.get(cid, rid))
+            err = msg.get("error")
+            if err and str(err).startswith("rejected") \
+                    and retry_count.get(cid, 0) < max_retries:
+                retry_count[cid] = retry_count.get(cid, 0) + 1
+                state["retries"] += 1
+                retry_q.append(cid)
+            else:
+                msg["latency_s_e2e"] = time.monotonic() - send_ts[cid]
+                msg["rid"] = rid
+                results[cid] = msg
+            state["outstanding"] -= 1
+            cond.notify()
+
+    for conn in conns.values():
+        conn._on_response = on_response
+
+    by_cid = {item["cid"]: item for item in schedule}
+    pending = deque(item["cid"] for item in schedule)
+    swap_fired = False
+    dead_conns: set = set()
+    t0 = time.monotonic()
+    last_progress = time.monotonic()
+    last_refresh = 0.0
+    completed_prev = 0
+    while len(results) < len(schedule):
+        now = time.monotonic()
+        if now - last_refresh > 0.05:
+            router.refresh()
+            if controller is not None:
+                controller.tick()
+            last_refresh = now
+            # Dead-socket recovery (the failure-table contract): a
+            # replica whose connection died mid-flight never answers
+            # its outstanding requests — requeue them through the
+            # router (which has dropped the dead replica from the
+            # ring) instead of stalling the window shut.
+            for rid, conn in conns.items():
+                if rid in dead_conns or not conn._stopped_evt.is_set():
+                    continue
+                dead_conns.add(rid)
+                with cond:
+                    for cid, r in list(rid_of.items()):
+                        if (r == rid and cid not in results
+                                and cid not in retry_q
+                                and cid not in pending):
+                            retry_count[cid] = retry_count.get(cid,
+                                                               0) + 1
+                            state["retries"] += 1
+                            retry_q.append(cid)
+                            state["outstanding"] -= 1
+                            router.complete(rid)
+                    cond.notify()
+        if (swap_trigger is not None and not swap_fired
+                and len(results) >= swap_trigger["at_completed"]):
+            swap_trigger["fire"]()
+            swap_fired = True
+        sent_any = False
+        with cond:
+            while (retry_q or pending) \
+                    and state["outstanding"] < max_outstanding:
+                cid = retry_q.popleft() if retry_q else pending.popleft()
+                item = by_cid[cid]
+                rid = router.route(item["key"])
+                if rid is None or rid not in conns:
+                    if rid is not None:
+                        router.complete(rid)
+                    (retry_q if retry_count.get(cid) else pending
+                     ).appendleft(cid)
+                    break
+                rid_of[cid] = rid
+                send_ts.setdefault(cid, time.monotonic())
+                state["outstanding"] += 1
+                sent_any = True
+                conn = conns[rid]
+                try:
+                    conn.send({"op": "serve", "id": cid,
+                               "support_x": item["sx"],
+                               "support_y": item["sy"],
+                               "query_x": item["qx"]})
+                except OSError:
+                    # Replica vanished mid-send (SIGKILL class): undo
+                    # the accounting and retry elsewhere after refresh.
+                    state["outstanding"] -= 1
+                    router.complete(rid)
+                    retry_count[cid] = retry_count.get(cid, 0) + 1
+                    retry_q.append(cid)
+                    break
+            completed = len(results)
+            if completed > completed_prev:
+                last_progress = time.monotonic()
+                completed_prev = completed
+            if not sent_any:
+                cond.wait(timeout=0.02)
+        if time.monotonic() - last_progress > stall_timeout_s:
+            raise TimeoutError(
+                f"fleet made no progress for {stall_timeout_s:.0f}s "
+                f"({len(results)}/{len(schedule)} done)")
+    wall = time.monotonic() - t0
+    ok = [r for r in results.values() if not r.get("error")]
+    lat_ms = sorted(r["latency_s_e2e"] * 1e3 for r in ok)
+
+    def pct(q):
+        # Nearest-rank, the repo's one pinned quantile definition
+        # (utils/tracing.py § nearest_rank, inlined: jax-free driver).
+        if not lat_ms:
+            return None
+        rank = max(1, math.ceil(q * len(lat_ms)))
+        return round(lat_ms[rank - 1], 3)
+
+    tiers = [r.get("cache_tier") for r in ok]
+    return {
+        "wall_seconds": round(wall, 3),
+        "qps": round(len(ok) / wall, 3) if wall > 0 else None,
+        "responses_ok": len(ok),
+        "dropped": len(schedule) - len(ok),
+        "rejected_retries": state["retries"],
+        "p50_ms": pct(0.50), "p95_ms": pct(0.95),
+        "l1_hit_frac": (round(tiers.count("l1") / len(ok), 4)
+                        if ok else None),
+        "l2_hit_frac": (round(tiers.count("l2") / len(ok), 4)
+                        if ok else None),
+        "adapt_frac": (round(tiers.count(None) / len(ok), 4)
+                       if ok else None),
+        "per_replica_responses": {
+            str(rid): sum(1 for r in ok if r.get("rid") == rid)
+            for rid in sorted(conns)},
+    }
+
+
+# ---------------------------------------------------------------------------
+# the migration leg
+# ---------------------------------------------------------------------------
+
+def migration_check(router, controller, conns: Dict[int, ReplicaConn],
+                    pool, seed: int, image_shape) -> dict:
+    """Prove the L2 tier across a drain: serve one tenant on its ring
+    primary A, drain A (lease tombstone), serve the SAME tenant again —
+    it must land on a different replica AND come back from the l2 tier
+    with zero new adapt dispatches on the target."""
+    import numpy as np
+    rng = np.random.RandomState(seed + 999)
+    router.refresh()
+    sx, sy, q_rows = pool[0]
+    key = _router_mod.routing_key(sx, sy)
+    primary = router.ring.primary(key)
+    if primary is None or primary not in conns:
+        return {"ok": False, "reason": "no primary for tenant"}
+
+    done = threading.Event()
+    box: Dict[str, Any] = {}
+
+    def on_response(rid, msg):
+        router.complete(rid)
+        box["resp"] = msg
+        box["rid"] = rid
+        done.set()
+
+    def ask(rid: int, cid: int) -> dict:
+        _, _, qx = synthetic_arrays(image_shape, 3, True, rng,
+                                    (1, q_rows))
+        for conn in conns.values():
+            conn._on_response = on_response
+        done.clear()
+        conns[rid].send({"op": "serve", "id": cid, "support_x": sx,
+                         "support_y": sy, "query_x": qx})
+        if not done.wait(120):
+            raise TimeoutError("migration request timed out")
+        return dict(box["resp"], rid=box["rid"])
+
+    # Warm the tenant on its primary (adapts or hits there; publishes
+    # the adaptation to L2 either way — a fresh adapt publishes, a hit
+    # means an earlier adapt already did).
+    first = ask(primary, 10_000_000)
+    controller.drain(primary, reason="migration_check")
+    router.refresh()
+    target = router.ring.primary(key)
+    if target is None or target == primary:
+        controller.undrain(primary)
+        return {"ok": False, "reason": f"drain did not move the tenant "
+                                       f"(target={target})"}
+    before = conns[target].stats()["stats"]["adapt_invocations"]
+    second = ask(target, 10_000_001)
+    after = conns[target].stats()["stats"]["adapt_invocations"]
+    controller.undrain(primary)
+    router.refresh()
+    return {
+        "ok": bool(second.get("cache_tier") == "l2"
+                   and after == before and not second.get("error")),
+        "tenant_key": key[:16],
+        "from_replica": primary, "to_replica": target,
+        "first_tier": first.get("cache_tier"),
+        "second_tier": second.get("cache_tier"),
+        "target_adapt_delta": int(after - before),
+    }
+
+
+# ---------------------------------------------------------------------------
+# main
+# ---------------------------------------------------------------------------
+
+def run_leg(out, cfg_path, ckpt_dir, fleet_dir, ids, schedule, registry,
+            *, image_shape,
+            swap_spec=None, pool=None, migration=False,
+            startup_timeout_s=420.0):
+    """Boot a replica set, drive the schedule, optionally swap/migrate,
+    tear down. Returns (leg stats, per-replica stats, extras). The
+    router's ring/threshold knobs come from the SAME config json the
+    replicas run (the fleet_* knobs; defaults mirror
+    config.effective_fleet_* — re-derived here because this driver is
+    jax-free and cannot build a MAMLConfig)."""
+    with open(cfg_path) as f:
+        cfg_doc = json.load(f)
+    interval = float(cfg_doc.get("fleet_lease_interval_s") or 0.5)
+    stalled = float(cfg_doc.get("fleet_replica_stalled_s") or 0.0) \
+        or 3.0 * interval
+    dead = max(float(cfg_doc.get("fleet_replica_dead_s") or 0.0)
+               or 6.0 * interval, stalled)
+    os.makedirs(fleet_dir, exist_ok=True)
+    procs = start_replicas(out, cfg_path, ckpt_dir, fleet_dir, ids)
+    extras: Dict[str, Any] = {}
+    conns: Dict[int, ReplicaConn] = {}
+    try:
+        ports = wait_for_replicas(fleet_dir, ids, procs,
+                                  startup_timeout_s)
+        for rid, port in ports.items():
+            conns[rid] = ReplicaConn(rid, port, lambda *_: None)
+        router = _router_mod.FleetRouter(
+            fleet_dir, vnodes=int(cfg_doc.get("fleet_vnodes") or 64),
+            load_factor=float(cfg_doc.get("fleet_load_factor") or 1.25),
+            stalled_after_s=stalled, dead_after_s=dead,
+            registry=registry)
+        controller = _controller_mod.FleetController(
+            fleet_dir, router.refresh, registry=registry)
+        router.refresh()
+
+        swap_trigger = None
+        if swap_spec is not None:
+            child_box: Dict[str, Any] = {}
+
+            def fire():
+                # Publish v2 OFF the driver's critical path (a jax
+                # child takes seconds to boot); the rollout starts as
+                # soon as the publish lands, while load keeps flowing.
+                def _worker():
+                    _run_child("publish-v2", cfg_path, ckpt_dir, out)
+                    with open(os.path.join(out, "publish-v2.log")) as f:
+                        last = [ln for ln in f.read().splitlines()
+                                if ln.strip()][-1]
+                    version = int(json.loads(last)["version"])
+                    controller.start_rollout(version)
+                    child_box["version"] = version
+                t = threading.Thread(target=_worker, daemon=True)
+                child_box["thread"] = t
+                t.start()
+            swap_trigger = {"at_completed": swap_spec["at_completed"],
+                            "fire": fire}
+        stats = drive_leg(router, conns, schedule,
+                          max_outstanding=swap_spec["max_outstanding"]
+                          if swap_spec else 4 * len(ids),
+                          controller=controller,
+                          swap_trigger=swap_trigger)
+        if swap_spec is not None:
+            # The publish child may still be landing when the load
+            # drains (mid-load means it STARTED under load): wait for
+            # it, then tick the rollout to completion.
+            worker = child_box.get("thread")
+            if worker is not None:
+                worker.join(timeout=180)
+            deadline = time.monotonic() + 180
+            doc = controller.read_rollout()
+            while doc["state"] == _controller_mod.ROLLING \
+                    and time.monotonic() < deadline:
+                router.refresh()
+                doc = controller.tick()
+                time.sleep(0.1)
+            extras["rollout"] = {k: doc.get(k) for k in
+                                 ("state", "version", "index", "rejected",
+                                  "halt_reason", "halt_detail",
+                                  "halt_replica")}
+            extras["swap_version"] = child_box.get("version")
+        if migration and pool is not None:
+            extras["migration"] = migration_check(
+                router, controller, conns, pool, seed=0,
+                image_shape=image_shape)
+        controller.publish_signals()
+        per_replica = {}
+        for rid, conn in conns.items():
+            try:
+                per_replica[str(rid)] = conn.stats()
+            except Exception as e:  # noqa: BLE001
+                per_replica[str(rid)] = {"error": str(e)}
+        extras["advice"] = _controller_mod.advise(
+            controller.publish_signals(), live=len(router.routable))
+        return stats, per_replica, extras
+    finally:
+        stop_replicas(conns, procs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="multi-replica serving fleet benchmark")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=400)
+    ap.add_argument("--tenants", type=int, default=24)
+    ap.add_argument("--l1-capacity", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="2-replica CI smoke: no single leg, no "
+                         "hot-swap leg, small load")
+    ap.add_argument("--skip-single", action="store_true")
+    ap.add_argument("--no-swap", action="store_true")
+    # jax-side child plumbing (internal)
+    ap.add_argument("--mode", default="bench",
+                    choices=["bench", "prepare", "publish-v2"])
+    ap.add_argument("--config-path", default=None)
+    ap.add_argument("--ckpt-dir", default=None)
+    args = ap.parse_args(argv)
+
+    if args.mode == "prepare":
+        return _mode_prepare(args.config_path, args.ckpt_dir)
+    if args.mode == "publish-v2":
+        return _mode_publish_v2(args.config_path, args.ckpt_dir)
+
+    if args.quick:
+        args.replicas = min(args.replicas, 2)
+        args.requests = min(args.requests, 36)
+        args.tenants = min(args.tenants, 8)
+        args.skip_single = True
+        args.no_swap = True
+
+    artifact: Dict[str, Any] = {
+        "metric": "fleet_bench", "value": None, "unit": "requests/s",
+        "status": "failed", "replicas": args.replicas,
+        "requests": args.requests, "tenants": args.tenants,
+        "l1_capacity": args.l1_capacity, "quick": bool(args.quick),
+    }
+    if not _can_bind_localhost():
+        # No localhost sockets, no fleet: record the skip honestly
+        # (the chaos_pod.py rule) instead of failing the harness.
+        artifact.update({"status": "skipped",
+                         "skip_reason": "cannot bind localhost sockets"})
+        print(json.dumps(artifact), flush=True)
+        return 0
+
+    out = args.out or tempfile.mkdtemp(prefix="fleet_bench_")
+    made_tmp = args.out is None
+    os.makedirs(out, exist_ok=True)
+    ckpt_dir = os.path.join(out, "saved_models")
+    l2_dir = os.path.join(out, "l2")
+    cfg_fleet = os.path.join(out, "cfg_fleet.json")
+    cfg_single = os.path.join(out, "cfg_single.json")
+    with open(cfg_fleet, "w") as f:
+        json.dump(fleet_cfg_dict(out, quick=args.quick,
+                                 l1_capacity=args.l1_capacity,
+                                 l2_dir=l2_dir), f)
+    with open(cfg_single, "w") as f:
+        json.dump(fleet_cfg_dict(out, quick=args.quick,
+                                 l1_capacity=args.l1_capacity,
+                                 l2_dir=""), f)
+
+    registry = _MiniMetrics()
+    try:
+        t_prep = time.monotonic()
+        _run_child("prepare", cfg_fleet, ckpt_dir, out)
+        artifact["prepare_seconds"] = round(time.monotonic() - t_prep, 1)
+        cfg_doc = fleet_cfg_dict(out, quick=args.quick,
+                                 l1_capacity=args.l1_capacity,
+                                 l2_dir=l2_dir)
+        image_shape = (cfg_doc["image_height"], cfg_doc["image_width"],
+                       cfg_doc["image_channels"])
+        pool, schedule = build_schedule(args.requests, args.tenants,
+                                        args.seed, image_shape,
+                                        bench_bucket(args.quick))
+
+        single = None
+        if not args.skip_single:
+            single, _, _ = run_leg(
+                out, cfg_single, ckpt_dir,
+                os.path.join(out, "fleet_single"), [0], schedule,
+                _MiniMetrics(), image_shape=image_shape)
+
+        ids = list(range(args.replicas))
+        swap_spec = None
+        if not args.no_swap:
+            # Fire early: the publish child needs seconds to boot jax,
+            # and the rolling swap must run UNDER load to prove the
+            # zero-drop claim.
+            swap_spec = {"at_completed": max(args.requests // 6, 1),
+                         "max_outstanding": 4 * len(ids)}
+        fleet, per_replica, extras = run_leg(
+            out, cfg_fleet, ckpt_dir, os.path.join(out, "fleet"),
+            ids, schedule, registry, image_shape=image_shape,
+            swap_spec=swap_spec, pool=pool, migration=True)
+
+        reg_snap = registry.snapshot()
+        speedup = (round(fleet["qps"] / single["qps"], 2)
+                   if single and single.get("qps") else None)
+        migration = extras.get("migration") or {}
+        rollout = extras.get("rollout") or {}
+        zero_dropped = (fleet["dropped"] == 0
+                        and (single is None or single["dropped"] == 0))
+        ok = bool(fleet["responses_ok"] == args.requests
+                  and zero_dropped
+                  and migration.get("ok", args.quick)
+                  and (args.no_swap or rollout.get("state") == "done"))
+        artifact.update({
+            "status": "ok" if ok else "failed",
+            "value": fleet["qps"],
+            "single": single, "fleet": fleet,
+            "single_qps": single["qps"] if single else None,
+            "fleet_qps": fleet["qps"],
+            "fleet_speedup_vs_single": speedup,
+            "fleet_l2_hit_frac": fleet["l2_hit_frac"],
+            "fleet_rolling_swaps": int(
+                reg_snap.get(_controller_mod.SWAPS_COUNTER, 0)),
+            "fleet_rolling_swap_halts": int(
+                reg_snap.get(_controller_mod.HALTS_COUNTER, 0)),
+            "fleet_router_spills": int(
+                reg_snap.get(_router_mod.SPILLS_COUNTER, 0)),
+            "rollout": rollout or None,
+            "migration": migration or None,
+            "zero_dropped": zero_dropped,
+            "per_replica": per_replica,
+            "autoscale_advice": extras.get("advice"),
+            "fleet_metrics": reg_snap,
+            "out_dir": None if made_tmp else out,
+        })
+        print(json.dumps(artifact), flush=True)
+        if made_tmp:
+            shutil.rmtree(out, ignore_errors=True)
+        return 0 if ok else 1
+    except Exception as e:  # noqa: BLE001 — the artifact IS the report
+        artifact.update({"status": "failed",
+                         "error": f"{type(e).__name__}: {e}",
+                         "out_dir": out})
+        print(json.dumps(artifact), flush=True)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -72,20 +72,45 @@ def build_config(args):
                        serve_max_queue_depth=args.queue_depth)
 
 
-def synthetic_request(cfg, bucket, rng, fill, arrival):
-    """One synthetic request at ``fill <= bucket`` occupancy with wire
-    dtype matching the config (uint8 by default, like real traffic)."""
-    from howtotrainyourmamlpytorch_tpu.serve import FewShotRequest
+def synthetic_arrays(image_shape, num_classes, uint8_wire, rng, fill):
+    """Raw (support_x, support_y, query_x) arrays for one synthetic
+    task at ``fill`` occupancy — plain args and numpy only, so the
+    jax-free fleet router process (scripts/fleet_bench.py) can share
+    THIS generator instead of forking it."""
     s, q = fill
-    h, w, c = cfg.image_shape
-    n = cfg.num_classes_per_set
-    if cfg.transfer_images_uint8:
+    h, w, c = image_shape
+    if uint8_wire:
         sx = rng.randint(0, 256, (s, h, w, c)).astype(np.uint8)
         qx = rng.randint(0, 256, (q, h, w, c)).astype(np.uint8)
     else:
         sx = rng.randn(s, h, w, c).astype(np.float32)
         qx = rng.randn(q, h, w, c).astype(np.float32)
-    sy = (np.arange(s) % n).astype(np.int32)
+    sy = (np.arange(s) % num_classes).astype(np.int32)
+    return sx, sy, qx
+
+
+def tenant_pool(image_shape, num_classes, uint8_wire, rng, buckets,
+                num_tenants):
+    """Fixed support sets, one per tenant — the "adapt once, predict
+    many" population both serving benches draw repeats from. Each
+    tenant keeps its support set forever; only queries are fresh."""
+    pool = []
+    for t in range(num_tenants):
+        bucket = buckets[t % len(buckets)]
+        fill = (max(1, bucket[0] - (t % 2)), max(1, bucket[1] - (t % 3)))
+        sx, sy, _ = synthetic_arrays(image_shape, num_classes,
+                                     uint8_wire, rng, fill)
+        pool.append((sx, sy, fill[1]))
+    return pool
+
+
+def synthetic_request(cfg, bucket, rng, fill, arrival):
+    """One synthetic request at ``fill <= bucket`` occupancy with wire
+    dtype matching the config (uint8 by default, like real traffic)."""
+    from howtotrainyourmamlpytorch_tpu.serve import FewShotRequest
+    sx, sy, qx = synthetic_arrays(cfg.image_shape,
+                                  cfg.num_classes_per_set,
+                                  cfg.transfer_images_uint8, rng, fill)
     req = FewShotRequest(support_x=sx, support_y=sy, query_x=qx)
     req.arrival_time = arrival  # open-loop: scheduled arrival, not ctor
     return req
@@ -230,6 +255,16 @@ def main() -> int:
             - compiles_after_warmup,
         "offered_rate": args.rate or None,
         "workload": cfg.experiment_name,
+        # Fleet keys (scripts/fleet_bench.py fills them): null here so
+        # single-engine and fleet captures stay schema-stable — one
+        # consumer reads both artifacts uniformly, the bench.py rule.
+        "fleet_replicas": None,
+        "fleet_qps": None,
+        "fleet_speedup_vs_single": None,
+        "fleet_l2_hit_frac": None,
+        "fleet_rolling_swaps": None,
+        "fleet_rolling_swap_halts": None,
+        "fleet_router_spills": None,
     }
     if args.events:
         jsonl = JsonlLogger(args.events)

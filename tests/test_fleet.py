@@ -1,0 +1,713 @@
+"""Serving-fleet tests (ISSUE 13): router ring, bounded load, shared
+L2 tier, rolling-swap controller, engine wiring, subprocess smoke.
+
+Tier-1 keeps to pure/host-side units plus ONE tiny-compile engine
+fixture (L2 probe/publish through a real ServingEngine) and ONE
+2-replica subprocess smoke through the real ``fleet_bench.py``
+entrypoint (budgeted ~15s wall; the N=3 load + rolling hot-swap proof
+rides the ``slow`` marker — tier-1 sits at ~660s of the 870s driver
+budget and must not grow past it).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.serve.fleet import (
+    FleetController, FleetRouter, HashRing, L2AdaptedParamsCache,
+    ReplicaLease, advise, read_members, routing_key)
+from howtotrainyourmamlpytorch_tpu.serve.fleet import controller as fc
+from howtotrainyourmamlpytorch_tpu.serve.fleet import l2cache
+from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+from helpers import _can_bind_localhost
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLEET_BENCH = os.path.join(REPO, "scripts", "fleet_bench.py")
+
+
+def _keys(n=400):
+    rng = np.random.RandomState(0)
+    out = []
+    for i in range(n):
+        sx = rng.randint(0, 256, (3, 4, 4, 1)).astype(np.uint8)
+        sy = (np.arange(3) % 3).astype(np.int32)
+        out.append(routing_key(sx, sy))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring
+# ---------------------------------------------------------------------------
+
+def test_ring_routing_is_deterministic_and_covers_members():
+    ring = HashRing([0, 1, 2], vnodes=64)
+    keys = _keys(300)
+    owners = [ring.primary(k) for k in keys]
+    assert owners == [ring.primary(k) for k in keys]  # deterministic
+    # Every member owns a nontrivial share (vnodes spread the ring).
+    for m in (0, 1, 2):
+        assert owners.count(m) > len(keys) * 0.15
+    # candidates() lists each member exactly once, primary first.
+    for k in keys[:20]:
+        c = ring.candidates(k)
+        assert sorted(c) == [0, 1, 2] and c[0] == ring.primary(k)
+
+
+def test_ring_membership_churn_moves_bounded_key_fraction():
+    """THE consistent-hashing property: removing (draining) one of N
+    replicas re-routes only that replica's keys (~1/N); the survivors'
+    keys keep their owner — the L1 working sets the router exists to
+    preserve. Adding it back restores the original assignment
+    exactly."""
+    keys = _keys(400)
+    full = HashRing([0, 1, 2, 3], vnodes=64)
+    drained = HashRing([0, 1, 2], vnodes=64)
+    before = {k: full.primary(k) for k in keys}
+    after = {k: drained.primary(k) for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    lost_share = sum(1 for k in keys if before[k] == 3)
+    # ONLY the drained replica's keys moved...
+    assert moved == lost_share
+    # ...and that share is ~1/4 of the space (generous tolerance: 400
+    # keys over 64 vnodes is a small sample).
+    assert 0.10 <= moved / len(keys) <= 0.45
+    # Survivors' keys did not reshuffle among themselves.
+    for k in keys:
+        if before[k] != 3:
+            assert after[k] == before[k]
+    # Rejoin: bitwise the original assignment.
+    rejoined = HashRing([0, 1, 2, 3], vnodes=64)
+    assert {k: rejoined.primary(k) for k in keys} == before
+
+
+# ---------------------------------------------------------------------------
+# membership + bounded-load routing
+# ---------------------------------------------------------------------------
+
+def _announce(fleet_dir, rid, port=9000, **extra):
+    lease = ReplicaLease(str(fleet_dir), rid, interval_s=0.0)
+    assert lease.touch({"port": port + rid, **extra}, force=True)
+    return lease
+
+
+def test_membership_from_leases_and_tombstones(tmp_path):
+    for rid in (0, 1, 2):
+        _announce(tmp_path, rid)
+    # Replica 2 is draining: lease alive, tombstone present.
+    with open(os.path.join(str(tmp_path), "replica_2.drain"), "w") as f:
+        f.write("{}")
+    # Replica 1's lease is ancient (dead).
+    old = time.time() - 3600
+    os.utime(os.path.join(str(tmp_path), "replica_1.lease"), (old, old))
+    reg = MetricsRegistry()
+    router = FleetRouter(str(tmp_path), stalled_after_s=1.0,
+                         dead_after_s=5.0, registry=reg)
+    members = router.refresh()
+    assert members[0]["state"] == "live" and not members[0]["draining"]
+    assert members[1]["state"] == "dead"
+    assert members[2]["state"] == "live" and members[2]["draining"]
+    # Only replica 0 is routable: live AND not draining.
+    assert router.routable == [0]
+    assert reg.gauge("fleet/replicas_live").value == 1
+    assert reg.gauge("fleet/replicas_draining").value == 1
+    # Payloads survive the round trip (the port the router dials).
+    assert members[0]["payload"]["port"] == 9000
+
+
+def test_torn_lease_payload_degrades_to_age_only(tmp_path):
+    _announce(tmp_path, 0)
+    path = os.path.join(str(tmp_path), "replica_0.lease")
+    with open(path, "w") as f:
+        f.write('{"port": 90')  # torn JSON
+    members = read_members(str(tmp_path))
+    # Still a member (mtime is fresh) — payload just absent.
+    assert members[0]["payload"] is None
+    assert members[0]["age"] < 60
+
+
+def test_bounded_load_spills_hot_key_and_complete_releases(tmp_path):
+    for rid in (0, 1, 2):
+        _announce(tmp_path, rid)
+    reg = MetricsRegistry()
+    router = FleetRouter(str(tmp_path), load_factor=1.25,
+                         stalled_after_s=60.0, dead_after_s=120.0,
+                         registry=reg)
+    router.refresh()
+    key = _keys(1)[0]
+    primary = router.ring.primary(key)
+    # One hot tenant: repeated routes without completions must NOT all
+    # land on the primary — bounded load caps it and spills to the
+    # next ring position.
+    picks = [router.route(key) for _ in range(12)]
+    assert picks[0] == primary
+    assert len(set(picks)) >= 2
+    assert reg.counter("fleet/router_spills").value > 0
+    assert max(router.in_flight(r) for r in (0, 1, 2)) < 12
+    # Completions release capacity: the key goes back to its primary.
+    for r in picks:
+        router.complete(r)
+    assert router.route(key) == primary
+    router.complete(primary)
+
+
+def test_route_with_no_live_replica_counts_and_returns_none(tmp_path):
+    reg = MetricsRegistry()
+    router = FleetRouter(str(tmp_path), registry=reg)
+    router.refresh()
+    assert router.route("deadbeef") is None
+    assert reg.counter("fleet/router_no_replica").value == 1
+
+
+# ---------------------------------------------------------------------------
+# L2 adapted-params tier
+# ---------------------------------------------------------------------------
+
+def _tree():
+    return ({"conv0": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                       "b": np.zeros(4, np.float32)},
+             "head": [np.float32(1.5), np.ones((2, 2), np.float16)]},
+            {"bn": {"mean": np.linspace(0, 1, 5).astype(np.float64),
+                    "tuple": (np.int32(7),)}})
+
+
+def test_l2_round_trip_preserves_trees_and_dtypes(tmp_path):
+    reg = MetricsRegistry()
+    l2 = L2AdaptedParamsCache(str(tmp_path), registry=reg)
+    fast, bn = _tree()
+    assert l2.put("a" * 64, fast, bn)
+    entry = l2.get("a" * 64)
+    assert entry is not None
+    got_fast, got_bn = entry["fast"], entry["bn_state"]
+    np.testing.assert_array_equal(got_fast["conv0"]["w"],
+                                  fast["conv0"]["w"])
+    assert got_fast["conv0"]["w"].dtype == np.float32
+    assert got_fast["head"][1].dtype == np.float16
+    assert got_bn["bn"]["mean"].dtype == np.float64
+    assert isinstance(got_bn["bn"]["tuple"], tuple)
+    assert (l2.hits, l2.misses, l2.errors) == (1, 0, 0)
+    assert reg.counter(l2cache.PUBLISHES).value == 1
+    # A plain absent key is a counted MISS, not an error.
+    assert l2.get("b" * 64) is None
+    assert (l2.misses, l2.errors) == (1, 0)
+
+
+@pytest.mark.parametrize("damage", ["truncate", "bitflip", "magic"])
+def test_l2_damage_is_counted_fail_soft_miss(tmp_path, damage):
+    """The PR 3 cache_errors discipline, tier 2: truncation, a flipped
+    payload bit, or a foreign file all read as a counted miss — never
+    a wrong answer, never an exception — and the damaged file is
+    quarantined so repeats don't re-pay the verify-and-fail."""
+    reg = MetricsRegistry()
+    l2 = L2AdaptedParamsCache(str(tmp_path), registry=reg)
+    fast, bn = _tree()
+    key = "c" * 64
+    assert l2.put(key, fast, bn)
+    path = l2.path(key)
+    blob = open(path, "rb").read()
+    if damage == "truncate":
+        open(path, "wb").write(blob[:len(blob) // 2])
+    elif damage == "bitflip":
+        flipped = bytearray(blob)
+        flipped[len(flipped) - 8] ^= 0x10  # payload byte, not header
+        open(path, "wb").write(bytes(flipped))
+    else:
+        open(path, "wb").write(b"NOTL2AAA" + blob[8:])
+    assert l2.get(key) is None
+    assert l2.errors == 1 and l2.misses == 1
+    assert reg.counter(l2cache.ERRORS).value == 1
+    assert not os.path.exists(path)  # quarantined
+    # The tier keeps working after damage.
+    assert l2.put(key, fast, bn) and l2.get(key) is not None
+
+
+def test_l2_gc_by_recency_and_stale_tmp_sweep(tmp_path):
+    l2 = L2AdaptedParamsCache(str(tmp_path), max_entries=100)
+    fast, bn = _tree()
+    keys = [f"{i:064d}" for i in range(5)]
+    now = time.time()
+    for i, k in enumerate(keys):
+        assert l2.put(k, fast, bn)
+        # Distinct mtimes (filesystem mtime granularity beats a sleep).
+        os.utime(l2.path(k), (now + i, now + i))
+    assert l2.gc(max_entries=3) == 2
+    survivors = {k for k, _ in l2.entries()}
+    assert survivors == set(keys[2:])  # oldest-recency entries died
+    assert l2.evictions == 2
+    # A GET refreshes recency (mtime bump), so a later GC keeps the
+    # recently-USED entry over a recently-WRITTEN-but-idle one.
+    assert l2.get(keys[2]) is not None
+    os.utime(l2.path(keys[2]), (now + 10, now + 10))
+    assert l2.gc(max_entries=2) == 1
+    assert keys[2] in {k for k, _ in l2.entries()}
+    assert keys[3] not in {k for k, _ in l2.entries()}
+    # Stale tmp sweep: old tmps die, fresh ones (a publish in flight
+    # on another replica) survive.
+    stale = os.path.join(str(tmp_path), "x.l2.tmp.999")
+    fresh = os.path.join(str(tmp_path), "y.l2.tmp.998")
+    open(stale, "wb").write(b"x")
+    open(fresh, "wb").write(b"y")
+    os.utime(stale, (now - 7200, now - 7200))
+    assert l2.sweep() == 1
+    assert not os.path.exists(stale) and os.path.exists(fresh)
+
+
+# ---------------------------------------------------------------------------
+# rolling-swap controller
+# ---------------------------------------------------------------------------
+
+class _FakeFleet:
+    """Membership snapshot the controller reads; tests mutate payloads
+    to play the replica side of the protocol."""
+
+    def __init__(self, rids):
+        self.members = {r: {"state": "live", "age": 0.0, "draining": False,
+                            "payload": {"version": 1, "stats": {}}}
+                        for r in rids}
+
+    def __call__(self):
+        return {r: dict(rec) for r, rec in self.members.items()}
+
+
+def test_rolling_swap_happy_path(tmp_path):
+    reg = MetricsRegistry()
+    fleet = _FakeFleet([0, 1, 2])
+    ctl = FleetController(str(tmp_path), fleet, registry=reg)
+    doc = ctl.start_rollout(2)
+    assert doc["state"] == fc.ROLLING and doc["replicas"] == [0, 1, 2]
+    # Replica 0 is tombstoned; nobody else is.
+    assert os.path.exists(ctl._drain_path(0))
+    assert not os.path.exists(ctl._drain_path(1))
+    # Not acked yet -> still draining, still tombstoned.
+    assert ctl.tick()["index"] == 0
+    # Replica 0 acks by reporting the target version in its lease.
+    fleet.members[0]["payload"] = {"version": 2}
+    doc = ctl.tick()
+    assert doc["index"] == 1
+    assert not os.path.exists(ctl._drain_path(0))  # rejoined
+    assert os.path.exists(ctl._drain_path(1))      # next in line
+    fleet.members[1]["payload"] = {"version": 2}
+    fleet.members[2]["payload"] = {"version": 2}
+    assert ctl.tick()["index"] == 2
+    doc = ctl.tick()
+    assert doc["state"] == fc.DONE
+    assert not any(os.path.exists(ctl._drain_path(r)) for r in (0, 1, 2))
+    assert reg.counter(fc.SWAPS_COUNTER).value == 1
+    assert reg.counter(fc.SWAP_STEPS_COUNTER).value == 3
+    assert reg.counter(fc.HALTS_COUNTER).value == 0
+
+
+def test_rolling_swap_halts_on_canary_fail_and_pins_fleet_wide(tmp_path):
+    """THE safety property: one replica's canary rejection stops the
+    rollout for the WHOLE fleet — the version is pinned in the rollout
+    record (replicas poll it and refuse locally), the tombstone is
+    lifted so the replica rejoins on its live version, and a restarted
+    rollout of the same version is refused outright."""
+    reg = MetricsRegistry()
+    fleet = _FakeFleet([0, 1])
+    ctl = FleetController(str(tmp_path), fleet, registry=reg)
+    ctl.start_rollout(2)
+    fleet.members[0]["payload"] = {"version": 1, "swap_failed": 2}
+    doc = ctl.tick()
+    assert doc["state"] == fc.HALTED
+    assert doc["halt_replica"] == 0 and 2 in doc["rejected"]
+    assert not os.path.exists(ctl._drain_path(0))  # rejoined, un-swapped
+    assert not os.path.exists(ctl._drain_path(1))  # never touched
+    assert reg.counter(fc.HALTS_COUNTER).value == 1
+    assert reg.counter(fc.SWAPS_COUNTER).value == 0
+    # The pin is durable: the same version never rolls again.
+    assert ctl.start_rollout(2)["state"] == fc.HALTED
+    # A NEW version starts a fresh rollout, pin list intact.
+    doc = ctl.start_rollout(3)
+    assert doc["state"] == fc.ROLLING and doc["rejected"] == [2]
+
+
+def test_rolling_swap_halts_when_replica_dies_mid_swap(tmp_path):
+    fleet = _FakeFleet([0, 1])
+    ctl = FleetController(str(tmp_path), fleet)
+    ctl.start_rollout(2)
+    fleet.members[0]["state"] = "dead"
+    doc = ctl.tick()
+    assert doc["state"] == fc.HALTED
+    assert doc["halt_reason"] == "replica died mid-swap"
+
+
+def test_rolling_swap_stall_halts_without_pinning(tmp_path):
+    """A LIVE replica that can never decide (target retired from the
+    registry mid-rollout) must not hold the fleet at N-1 forever: the
+    stall backstop halts — WITHOUT pinning the version (a stall is not
+    a canary verdict), so the same rollout can be retried."""
+    fleet = _FakeFleet([0, 1])
+    ctl = FleetController(str(tmp_path), fleet, step_stall_timeout_s=30)
+    ctl.start_rollout(2)
+    # Backdate the rollout record: 40s of no decision.
+    doc = ctl.read_rollout()
+    doc["updated_ts"] = time.time() - 40.0
+    fc._atomic_write_json(ctl.rollout_path, doc)
+    doc = ctl.tick()
+    assert doc["state"] == fc.HALTED
+    assert doc["halt_reason"] == "rollout step stalled"
+    assert 2 not in doc["rejected"]                 # not pinned
+    assert not os.path.exists(ctl._drain_path(0))  # rejoined
+    # Retry is allowed (unlike a canary-fail pin).
+    assert ctl.start_rollout(2)["state"] == fc.ROLLING
+
+
+def test_rolling_swap_tick_heals_missing_tombstone(tmp_path):
+    """Crash-recovery contract: the rollout record is the truth; a
+    missing drain tombstone (controller died between the record write
+    and the drain, or stray cleanup) is re-written by tick()."""
+    fleet = _FakeFleet([0, 1])
+    ctl = FleetController(str(tmp_path), fleet)
+    ctl.start_rollout(2)
+    os.remove(ctl._drain_path(0))
+    ctl.tick()
+    assert os.path.exists(ctl._drain_path(0))
+
+
+def test_router_forgets_in_flight_across_replica_restart(tmp_path):
+    """A replica SIGKILLed with requests in flight and restarted
+    BEFORE any refresh observed it dead must not keep its phantom
+    in-flight counts (the restart shows up as a changed lease pid) —
+    they would skew the bounded-load cap forever."""
+    leases = {rid: _announce(tmp_path, rid) for rid in (0, 1)}
+    router = FleetRouter(str(tmp_path), stalled_after_s=60.0,
+                         dead_after_s=120.0)
+    router.refresh()
+    key = _keys(1)[0]
+    rid = router.route(key)
+    assert router.in_flight(rid) == 1
+    # "Restart": same replica id announces with a different pid.
+    path = os.path.join(str(tmp_path), f"replica_{rid}.lease")
+    doc = json.load(open(path))
+    doc["pid"] = doc["pid"] + 1
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    router.refresh()
+    assert router.in_flight(rid) == 0
+    assert leases  # keep lease objects alive (no tmp cleanup races)
+
+
+def test_avoid_fleet_rejected_rolls_back_at_startup(tmp_path):
+    """A replica that BOOTS on a fleet-rejected version (restart after
+    a halted rollout: LATEST is the banned checkpoint) must pin the
+    rejected list and roll back to the newest non-rejected live
+    version — without a canary (it is the previously-serving
+    known-good)."""
+    from howtotrainyourmamlpytorch_tpu.ckpt.registry import ModelRegistry
+    from howtotrainyourmamlpytorch_tpu.serve.fleet.replica import (
+        avoid_fleet_rejected)
+
+    reg_dir = str(tmp_path / "ckpt")
+    registry = ModelRegistry(reg_dir)
+    registry.publish(tag="0", epoch=0, val_acc=0.5, fingerprint=111)
+    registry.publish(tag="1", epoch=1, val_acc=0.6, fingerprint=222)
+    fleet_dir = str(tmp_path / "fleet")
+    os.makedirs(fleet_dir)
+    with open(os.path.join(fleet_dir, "ROLLOUT.json"), "w") as f:
+        json.dump({"state": "halted", "version": 2, "rejected": [2]}, f)
+
+    class _StubEngine:
+        def __init__(self):
+            self._model_version = 2      # booted on the banned bytes
+            self._registry_dir = reg_dir
+            self.pinned = set()
+            self.adopted = None
+
+        def pin_rejected(self, v):
+            self.pinned.add(v)
+
+        def load_registry_version(self, rec):
+            return {"loaded": rec["tag"]}
+
+        def adopt_version(self, rec, state):
+            self.adopted = (rec["version"], state)
+            self._model_version = rec["version"]
+
+    eng = _StubEngine()
+    assert avoid_fleet_rejected(eng, fleet_dir) == 1
+    assert eng.pinned == {2}
+    assert eng.adopted == (1, {"loaded": "0"})
+    # Booted on a GOOD version: pins only, no rollback.
+    eng2 = _StubEngine()
+    eng2._model_version = 1
+    assert avoid_fleet_rejected(eng2, fleet_dir) is None
+    assert eng2.adopted is None and eng2.pinned == {2}
+
+
+def test_controller_signals_and_advise(tmp_path):
+    reg = MetricsRegistry()
+    fleet = _FakeFleet([0, 1])
+    fleet.members[0]["payload"] = {"stats": {
+        "queue_depth": 70, "p95_ms": 250.0, "cache_hit_frac": 0.9,
+        "l2_hits": 5, "l2_misses": 2, "l2_errors": 0, "responses": 10}}
+    fleet.members[1]["payload"] = {"stats": {
+        "queue_depth": 10, "p95_ms": 900.0, "cache_hit_frac": 0.4,
+        "l2_hits": 1, "l2_misses": 1, "l2_errors": 1, "responses": 4}}
+    ctl = FleetController(str(tmp_path), fleet, registry=reg)
+    sig = ctl.publish_signals()
+    assert sig["queue_depth_total"] == 80
+    assert sig["p95_ms_max"] == 900.0
+    assert sig["cache_hit_frac_min"] == 0.4
+    # Aggregates publish under DISTINCT agg_* names so a log carrying
+    # both replica flushes and controller flushes never double-counts.
+    assert reg.counter("fleet/agg_l2_hits").value == 6
+    assert reg.counter("fleet/agg_l2_errors").value == 1
+    # Replica 0 restarts (its counters reset): only growth contributes.
+    fleet.members[0]["payload"]["stats"].update(l2_hits=2)
+    ctl.publish_signals()
+    assert reg.counter("fleet/agg_l2_hits").value == 8  # + reset seg 2
+    # 40 queued per live replica -> scale up; idle fleet -> scale down.
+    assert advise(sig, live=2) == "scale_up"
+    assert advise({"queue_depth_total": 0, "p95_ms_max": 50.0},
+                  live=2) == "scale_down"
+    assert advise({"queue_depth_total": 0, "p95_ms_max": 50.0},
+                  live=1) == "hold"  # never below the floor
+
+
+def test_fleet_config_knobs_validate_and_derive():
+    """The fleet_* knobs' contract: validation rejects nonsense, and
+    the effective_* thresholds derive from the lease cadence with the
+    cluster rules (3x/6x; dead never below stalled) — the same
+    derivation the jax-free bench driver mirrors."""
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    cfg = MAMLConfig(dataset_name="fleet_cfg",
+                     fleet_lease_interval_s=0.5)
+    assert cfg.effective_fleet_stalled_s == pytest.approx(1.5)
+    assert cfg.effective_fleet_dead_s == pytest.approx(3.0)
+    explicit = cfg.replace(fleet_replica_stalled_s=4.0,
+                           fleet_replica_dead_s=2.0)
+    assert explicit.effective_fleet_dead_s == 4.0  # never below stalled
+    for bad in (dict(fleet_load_factor=0.9), dict(fleet_vnodes=0),
+                dict(serve_l2_max_entries=0),
+                dict(fleet_lease_interval_s=0.0),
+                dict(fleet_replica_dead_s=-1.0)):
+        with pytest.raises(ValueError):
+            MAMLConfig(dataset_name="fleet_cfg", **bad)
+
+
+# ---------------------------------------------------------------------------
+# engine wiring: L2 probe on L1 miss, publish on adapt
+# ---------------------------------------------------------------------------
+
+def _tiny_cfg(tmp_path, **kw):
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    kw.setdefault("serve_buckets", ((3, 4),))
+    kw.setdefault("serve_batch_tasks", 2)
+    return MAMLConfig(
+        dataset_name="synthetic_fleet_engine", image_height=10,
+        image_width=10, image_channels=1, num_classes_per_set=3,
+        num_samples_per_class=1, num_target_samples=2, batch_size=2,
+        cnn_num_filters=4, num_stages=2,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2, second_order=False,
+        use_multi_step_loss_optimization=False,
+        serve_default_deadline_ms=0.0, serve_cache_capacity=8,
+        serve_l2_dir=os.path.join(str(tmp_path), "l2"), **kw)
+
+
+def _req(s=3, q=2, seed=0):
+    from howtotrainyourmamlpytorch_tpu.serve import FewShotRequest
+    rng = np.random.RandomState(seed)
+    return FewShotRequest(
+        support_x=rng.randint(0, 256, (s, 10, 10, 1)).astype(np.uint8),
+        support_y=(np.arange(s) % 3).astype(np.int32),
+        query_x=rng.randint(0, 256, (q, 10, 10, 1)).astype(np.uint8))
+
+
+@pytest.fixture(scope="module")
+def l2_engine(tmp_path_factory):
+    import jax
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.serve import ServingEngine
+    tmp = tmp_path_factory.mktemp("fleet_engine")
+    cfg = _tiny_cfg(tmp)
+    init, _ = make_model(cfg)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    eng = ServingEngine(cfg, state, devices=jax.devices()[:1])
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+def test_engine_l2_probe_publish_and_tiers(l2_engine):
+    """The cross-replica guarantee, single-process form: an adapt
+    publishes to L2; with the L1 entry gone (a restart, an eviction, a
+    DIFFERENT replica), the repeat is an L2 hit — cache_tier says so,
+    and the adapt executable is NOT dispatched."""
+    eng = l2_engine
+    r1 = _req(seed=50)
+    eng.submit(r1)
+    (resp1,) = eng.drain()
+    assert resp1.cache_tier is None and not resp1.cache_hit
+    # Publishes ride the background writer thread (off the response
+    # path); flush gives the test visibility.
+    assert eng.l2_flush()
+    assert eng.l2.publishes >= 1  # the adapt published fleet-wide
+    # L1 hit: tier says l1.
+    eng.submit(_req(seed=50))
+    (resp2,) = eng.drain()
+    assert resp2.cache_tier == "l1" and resp2.cache_hit
+    # Simulate "another replica": clear the L1; the L2 absorbs the
+    # repeat without an adapt dispatch.
+    eng.cache.clear()
+    adapt_before = eng.adapt_invocations
+    eng.submit(_req(seed=50))
+    (resp3,) = eng.drain()
+    assert resp3.cache_tier == "l2" and resp3.cache_hit
+    assert eng.adapt_invocations == adapt_before
+    assert resp3.predictions.shape == resp1.predictions.shape
+    # The L2 hit back-filled the L1: the next repeat never leaves the
+    # process.
+    eng.submit(_req(seed=50))
+    (resp4,) = eng.drain()
+    assert resp4.cache_tier == "l1"
+
+
+def test_engine_l2_damage_degrades_to_adapt(l2_engine):
+    """A damaged L2 entry must degrade the request to the adapt path
+    (counted), never to a wrong answer or a crash."""
+    eng = l2_engine
+    r = _req(seed=60)
+    eng.submit(r)
+    (first,) = eng.drain()
+    assert first.cache_tier is None
+    assert eng.l2_flush()  # async publish must land before we damage it
+    eng.cache.clear()
+    # Corrupt every L2 entry on disk.
+    for key, _ in eng.l2.entries():
+        with open(eng.l2.path(key), "r+b") as f:
+            f.seek(20)
+            f.write(b"\xff\xff\xff\xff")
+    errors_before = eng.l2.errors
+    adapt_before = eng.adapt_invocations
+    eng.submit(_req(seed=60))
+    (resp,) = eng.drain()
+    assert resp.error is None
+    assert resp.cache_tier is None           # re-adapted
+    assert eng.adapt_invocations == adapt_before + 1
+    assert eng.l2.errors > errors_before     # counted fail-soft
+
+
+def test_l1_cache_bytes_gauge_and_eviction_counter(l2_engine):
+    """Satellite: the L1 tracks approximate resident bytes and the
+    engine mirrors them (serve/cache_bytes) next to the eviction
+    counter — the autoscale signal pair."""
+    eng = l2_engine
+    assert len(eng.cache) > 0
+    assert eng.cache.approx_bytes > 0
+    eng._mirror_cache_counters()
+    assert eng.registry.gauge("serve/cache_bytes").value == \
+        eng.cache.approx_bytes
+    assert eng.registry.counter("serve/cache_evictions").value >= 0
+
+
+def test_lru_approx_bytes_tracks_put_evict_clear():
+    from howtotrainyourmamlpytorch_tpu.serve.cache import (
+        AdaptedParamsLRU, entry_nbytes)
+    lru = AdaptedParamsLRU(capacity=2)
+    a = {"w": np.zeros((4, 4), np.float32)}          # 64 bytes
+    b = [np.zeros(8, np.float64), (np.zeros(2, np.int32),)]  # 72 bytes
+    assert entry_nbytes(a) == 64 and entry_nbytes(b) == 72
+    lru.put("a", a)
+    lru.put("b", b)
+    assert lru.approx_bytes == 136
+    lru.put("c", a)  # evicts "a"
+    assert lru.approx_bytes == 136 - 64 + 64
+    assert lru.evictions == 1
+    lru.clear()
+    assert lru.approx_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# subprocess smoke + slow proof (the real fleet_bench.py entrypoint)
+# ---------------------------------------------------------------------------
+
+needs_sockets = pytest.mark.skipif(
+    not _can_bind_localhost(),
+    reason="fleet replicas serve over localhost sockets, which this "
+           "sandbox cannot bind (the fleet_bench skip-artifact path "
+           "covers the CLI side)")
+
+
+def _run_fleet_bench(args, timeout):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, FLEET_BENCH] + args, cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, f"no artifact line\n{proc.stdout}\n{proc.stderr}"
+    return proc.returncode, json.loads(lines[-1])
+
+
+@needs_sockets
+def test_fleet_bench_quick_smoke_two_replicas(tmp_path):
+    """Tier-1 acceptance smoke: 2 real replica subprocesses + the
+    jax-free router through the REAL fleet_bench.py entrypoint — zero
+    dropped requests, the L2 migration verdict, and the artifact
+    schema the BENCH rounds consume."""
+    rc, art = _run_fleet_bench(
+        ["--quick", "--out", str(tmp_path / "fb")], timeout=300)
+    assert art["metric"] == "fleet_bench"
+    assert art["status"] == "ok", art
+    assert rc == 0
+    assert art["replicas"] == 2
+    assert art["zero_dropped"] is True
+    assert art["fleet"]["responses_ok"] == art["requests"] > 0
+    assert art["fleet"]["dropped"] == 0
+    # The migration leg proved the shared tier: tenant re-served from
+    # L2 on the OTHER replica with zero adapt dispatches there.
+    assert art["migration"]["ok"] is True
+    assert art["migration"]["second_tier"] == "l2"
+    assert art["migration"]["target_adapt_delta"] == 0
+    assert art["migration"]["from_replica"] != art["migration"][
+        "to_replica"]
+    # Schema stability with serve_bench's single-engine artifact.
+    for key in ("fleet_qps", "fleet_l2_hit_frac", "fleet_rolling_swaps",
+                "fleet_rolling_swap_halts", "fleet_router_spills"):
+        assert key in art
+
+
+@pytest.mark.slow
+@needs_sockets
+def test_fleet_bench_full_proof_three_replicas(tmp_path):
+    """The ISSUE 13 acceptance leg (slow: ~6 min on this box): 3
+    replicas sustain >= 3x single-engine QPS with ZERO dropped
+    requests through a mid-load rolling hot-swap, and the drained
+    tenant is an L2 hit on its new replica — all asserted from the
+    artifact."""
+    rc, art = _run_fleet_bench(
+        ["--out", str(tmp_path / "fb"), "--requests", "300"],
+        timeout=560)
+    assert art["status"] == "ok", art
+    assert rc == 0
+    assert art["zero_dropped"] is True
+    assert art["fleet"]["dropped"] == 0 and art["single"]["dropped"] == 0
+    assert art["fleet_speedup_vs_single"] >= 3.0
+    assert art["rollout"]["state"] == "done"
+    assert art["fleet_rolling_swaps"] == 1
+    assert art["fleet_rolling_swap_halts"] == 0
+    assert art["migration"]["ok"] is True
+
+
+def test_serve_bench_exposes_fleet_keys_as_null():
+    """Satellite: the single-engine artifact carries every fleet_* key
+    (null) so BENCH comparisons stay schema-stable across PRs. Pinned
+    at the source level (running serve_bench is compile-heavy; the
+    keys live in one dict literal)."""
+    import ast
+    src = open(os.path.join(REPO, "scripts", "serve_bench.py")).read()
+    tree = ast.parse(src)
+    keys = {getattr(k, "value", None)
+            for node in ast.walk(tree) if isinstance(node, ast.Dict)
+            for k in node.keys}
+    for key in ("fleet_replicas", "fleet_qps", "fleet_speedup_vs_single",
+                "fleet_l2_hit_frac", "fleet_rolling_swaps",
+                "fleet_rolling_swap_halts", "fleet_router_spills"):
+        assert key in keys, f"serve_bench artifact lost {key}"

@@ -30,7 +30,7 @@ SCHEMA_KEYS = {
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
     "watchdog", "health", "checkpoint", "cluster", "warm_start",
-    "elastic",
+    "elastic", "fleet",
 }
 
 
@@ -210,6 +210,7 @@ def test_summarize_events_fixture(tmp_path):
     assert s["checkpoint"] == UNAVAILABLE
     assert s["cluster"] == UNAVAILABLE
     assert s["warm_start"] == UNAVAILABLE
+    assert s["fleet"] == UNAVAILABLE
     # The table renders every row without raising.
     table = format_table(s)
     assert "feed stall fraction" in table and "0.1" in table
@@ -509,6 +510,71 @@ def test_summarize_events_elastic_section():
 def test_elastic_section_unavailable_without_subsystem():
     s = summarize_events([{"event": "train_epoch", "epoch": 0}])
     assert s["elastic"] == UNAVAILABLE
+
+
+def test_summarize_events_fleet_section():
+    """v11: fleet counters accumulate reset-aware across REPLICA
+    restarts (a replica's flushed l2 counters drop to 0 when its
+    process restarts — its contribution must still count) AND per
+    source: one fleet log interleaves several replicas' flush rows
+    (each carries its `replica` id), so replica 1's smaller counters
+    must not read as a reset of replica 0's stream. Gauges take the
+    last signal in log order; the controller's fleet/agg_* aggregates
+    are distinct names, never double-counted into l2_*."""
+    events = [
+        # Replica 0's first life: flushes its l2 counters.
+        {"event": "metrics", "replica": 0,
+         "metrics": {"fleet/l2_hits": 10.0, "fleet/l2_misses": 4.0,
+                     "fleet/l2_errors": 1.0,
+                     "fleet/l2_publishes": 4.0}},
+        # Replica 1 interleaves with SMALLER values: per-source
+        # tracking must add them, not treat them as replica 0
+        # resetting.
+        {"event": "metrics", "replica": 1,
+         "metrics": {"fleet/l2_hits": 3.0, "fleet/l2_misses": 2.0,
+                     "fleet/l2_errors": 0.0,
+                     "fleet/l2_publishes": 2.0}},
+        # The controller process (no replica id): membership gauges,
+        # one rolling swap that halted on a canary fail, and its
+        # fleet-wide aggregates under the distinct agg_* names (must
+        # NOT double into the l2_* sums).
+        {"event": "metrics",
+         "metrics": {"fleet/replicas_live": 3.0,
+                     "fleet/replicas_draining": 1.0,
+                     "fleet/rolling_swaps": 1.0,
+                     "fleet/rolling_swap_halts": 1.0,
+                     "fleet/router_spills": 7.0,
+                     "fleet/agg_l2_hits": 13.0}},
+        # Replica 0 restarted: counters reset below its own previous
+        # value — the reset rule contributes the new segment whole.
+        {"event": "metrics", "replica": 0,
+         "metrics": {"fleet/l2_hits": 5.0, "fleet/l2_misses": 1.0,
+                     "fleet/l2_errors": 0.0,
+                     "fleet/l2_publishes": 1.0}},
+        # Final controller flush: membership gauges last-wins.
+        {"event": "metrics",
+         "metrics": {"fleet/replicas_live": 2.0,
+                     "fleet/replicas_draining": 0.0}},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    fl = s["fleet"]
+    assert fl["l2_hits"] == 18        # r0: 10 + 5 (restart); r1: 3
+    assert fl["l2_misses"] == 7
+    assert fl["l2_errors"] == 1
+    assert fl["l2_publishes"] == 7
+    assert fl["l2_hit_frac"] == pytest.approx(0.72)
+    assert fl["rolling_swaps"] == 1
+    assert fl["rolling_swap_halts"] == 1
+    assert fl["router_spills"] == 7
+    assert fl["replicas_live"] == 2   # last signal wins
+    assert fl["replicas_draining"] == 0
+    assert "fleet" in format_table(s)
+
+
+def test_fleet_section_unavailable_without_subsystem():
+    s = summarize_events([{"event": "train_epoch", "epoch": 0}])
+    assert s["fleet"] == UNAVAILABLE
 
 
 def test_health_section_nonfinite_grad_norm_visible():
