@@ -77,14 +77,15 @@ from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
 # Documented single-A100 reference-throughput estimate (see module docstring).
 BASELINE_TASKS_PER_SEC = 8.0
 
-# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets), for
-# the MFU estimate. Matched by substring of jax.Device.device_kind.
-_PEAK_BF16_FLOPS = (
-    ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v5p", 459e12), ("v5", 459e12),
-    ("v6", 918e12), ("trillium", 918e12),
-    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-)
+# The per-device-kind peak-FLOPs + HBM-bandwidth table lives in
+# telemetry/profiler.py (DEVICE_PEAKS) — ONE table for bench MFU, the
+# cost cards' roofline verdicts and scripts/perf_report.py. The
+# MAML_PEAK_FLOPS / MAML_HBM_GBPS env overrides win over it (the r4
+# lesson: a "TPU v5 lite" device string sustaining v5p-class matmul
+# rates makes the table a default, not an oracle), and the artifact's
+# `peak_flops_source` key records which one produced the MFU —
+# "table" / "override" / "unknown" — so a quietly-wrong MFU against a
+# guessed peak can no longer pass silently.
 
 
 # Backend bring-up (outage retry, hang watchdog, compile cache) lives in
@@ -99,14 +100,8 @@ from howtotrainyourmamlpytorch_tpu.utils.backend import (  # noqa: E402,F401
     maybe_enable_compilation_cache, timed_compile, wait_for_backend)
 from howtotrainyourmamlpytorch_tpu.utils.hlo_flops import (  # noqa: E402
     executable_flops)
-
-
-def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for sub, peak in _PEAK_BF16_FLOPS:
-        if sub in kind:
-            return peak
-    return 0.0
+from howtotrainyourmamlpytorch_tpu.telemetry import (  # noqa: E402
+    profiler as profiler_mod)
 
 
 def _compiled_flops(compiled) -> float:
@@ -509,6 +504,16 @@ def main() -> int:
         # null where executable serialization is unavailable.
         "time_to_first_step_cold_s": time_to_first_step_cold_s,
         "time_to_first_step_warm_s": time_to_first_step_warm_s,
+        # Perf-lab keys (telemetry/profiler.py, docs/PERF.md § Where
+        # the time goes): one jax.profiler-captured window over the
+        # headline executable, parsed into the wall-time split and the
+        # top device-time executable's roofline verdict. Null at first
+        # print (the leg runs after the headline, kill-resilience);
+        # the enriched lines carry them measured.
+        "mfu_compute_frac": None,
+        "dispatch_gap_frac": None,
+        "top_executable": None,
+        "top_executable_bound": None,
     }
     if cfg.health_metrics_every_n_steps > 0:
         # The headline executable ALREADY computes the diagnostics
@@ -543,7 +548,10 @@ def main() -> int:
     # The count is per-device, covering batch_size/n_dev tasks.
     fl = executable_flops(compiled)
     flops = fl["flops"]
-    peak = _peak_flops(devices[0])
+    peaks = profiler_mod.resolve_peaks(
+        getattr(devices[0], "device_kind", ""))
+    peak = peaks["peak_flops"]
+    out["peak_flops_source"] = peaks["source"]
     if flops > 0:
         local_tasks = max(cfg.batch_size // n_dev, 1)
         out["flops_per_task"] = round(flops / local_tasks)
@@ -584,6 +592,57 @@ def main() -> int:
     # headline. The enriched line printed afterwards is a strict
     # superset; the LAST JSON line on stdout is authoritative.
     print(json.dumps({**out, "workload": cfg.experiment_name}), flush=True)
+    # Perf-lab leg (telemetry/profiler.py, docs/PERF.md § Where the
+    # time goes): capture ONE profiled window of a few headline-
+    # executable steps and split its wall time into device compute vs
+    # dispatch gap, then attach the executable's roofline verdict from
+    # its cost card. No extra compile (the headline executable is
+    # reused on a fresh state — the timed loop donated the benched
+    # one), so this runs immediately after the headline print.
+    # mfu_compute_frac is the fraction of window wall-clock ANY device
+    # spent executing — the occupancy ceiling on MFU: mfu can never
+    # exceed mfu_compute_frac x (achieved-FLOPs/s / peak at full
+    # occupancy), so a low value says "dispatch/idle", a high value
+    # says "the kernels themselves are slow". Fail-soft: a backend
+    # that cannot trace leaves the keys null.
+    try:
+        card = profiler_mod.cost_card_from_compiled(
+            "bench_train", compiled,
+            device_kind=getattr(devices[0], "device_kind", ""),
+            peaks=peaks)
+        region_indexes = {}
+        try:
+            module, index = profiler_mod.region_index_from_hlo(
+                compiled.as_text())
+            if module:
+                region_indexes[module] = index
+        except Exception:  # noqa: BLE001
+            pass
+        st_prof = jax.device_put(
+            init_train_state(cfg, init, jax.random.PRNGKey(0)),
+            replicated_sharding(mesh))
+
+        def _profiled_steps(state=st_prof, n=3):
+            for _ in range(n):
+                state, m = compiled(state, batch_ep, epoch)
+            float(jax.device_get(m.loss))
+
+        summary = profiler_mod.capture_window(_profiled_steps,
+                                              region_indexes)
+        profiler_mod.attach_roofline(
+            summary, {"bench_train": card}, steps=3)
+        out["mfu_compute_frac"] = round(
+            summary["device_compute_frac"], 4)
+        out["dispatch_gap_frac"] = round(
+            summary["dispatch_gap_frac"], 4)
+        out["top_executable"] = summary.get("top_executable")
+        out["top_executable_bound"] = card.get("bound", "unknown")
+    except Exception as e:  # noqa: BLE001 — observability keys; the
+        # headline (already printed) must survive, but the miss stays
+        # visible in the artifact.
+        out["perf_profile_error"] = f"{type(e).__name__}: {e}"
+    out["workload"] = cfg.experiment_name
+    print(json.dumps(out), flush=True)
     # Warm-start leg (parallel/aot.py, docs/PERF.md § Cold start & warm
     # restarts): time-to-first-step cold vs warm through a REAL AOT
     # store round trip. The store holds the UNDONATED twin of the train

@@ -240,6 +240,18 @@ class MAMLConfig:
     use_tensorboard: bool = False
     profile_epoch: int = 0                 # epoch whose first steps to trace
     profile_num_steps: int = 5             # steps to trace at that epoch
+    # Perf lab (telemetry/profiler.py, docs/PERF.md § Where the time
+    # goes): sample device-time attribution at most every N train
+    # iterations — one dispatch-sync window wrapped in jax.profiler
+    # trace capture, parsed into per-executable / per-named-region
+    # device time and published as perf/* gauges + one perf_profile
+    # events.jsonl row. 0 = off (the default): NOTHING is installed
+    # and the run is bitwise identical (weights and cache-warm compile
+    # counts) to a build without the subsystem — the
+    # health_metrics_every_n_steps zero-cost discipline. >0 adds one
+    # extra device sync per sampled window (the capture must bracket
+    # real execution), which is the knob's only cost.
+    profile_every_n_steps: int = 0
 
     # ---- serving (serve/ subsystem, docs/SERVING.md) -------------------
     serve_batch_tasks: int = 8             # tasks per compiled adapt/predict
@@ -600,6 +612,9 @@ class MAMLConfig:
         if self.health_metrics_every_n_steps < 0:
             raise ValueError(
                 "health_metrics_every_n_steps must be >= 0 (0 = off)")
+        if self.profile_every_n_steps < 0:
+            raise ValueError(
+                "profile_every_n_steps must be >= 0 (0 = off)")
         if (self.health_grad_norm_warn_factor != 0.0
                 and self.health_grad_norm_warn_factor <= 1.0):
             raise ValueError(

@@ -56,6 +56,7 @@ from howtotrainyourmamlpytorch_tpu.resilience.flightrec import (
 from howtotrainyourmamlpytorch_tpu.telemetry import (
     FeedStallMeter, MetricsRegistry, device_memory_stats, emit_heartbeat)
 from howtotrainyourmamlpytorch_tpu.telemetry import health as health_mod
+from howtotrainyourmamlpytorch_tpu.telemetry import profiler as profiler_mod
 from howtotrainyourmamlpytorch_tpu.telemetry import trace as trace_mod
 from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
 from howtotrainyourmamlpytorch_tpu.utils.storage import (
@@ -246,6 +247,11 @@ class ExperimentBuilder:
         # rewind feature.
         self._health_every = cfg.health_metrics_every_n_steps
         self._last_health_iter: Optional[int] = None
+        # Perf lab (telemetry/profiler.py): the device-time sampler is
+        # constructed in run_experiment iff profile_every_n_steps > 0 —
+        # the structural zero-cost pin is this staying None (one None
+        # check per train iteration, the health/watchdog discipline).
+        self._perf: Optional[profiler_mod.PerfSampler] = None
         self._norm_guard = (DivergenceGuard(
                                 patience=1,
                                 grad_norm_factor=(
@@ -581,14 +587,44 @@ class ExperimentBuilder:
                 # under the separate watchdog_compile_timeout_s budget —
                 # a 30-min cold compile must not trip the step deadline.
                 watchdog.stamp("step", detail=self.current_iter)
-                if phase_key not in self._stamped_compiles:
-                    self._stamped_compiles.add(phase_key)
-                    with watchdog.phase("compile", detail=str(phase_key)):
-                        self.state, metrics = step_fn(self.state, batch,
-                                                      jnp.float32(epoch))
-                else:
-                    self.state, metrics = step_fn(self.state, batch,
-                                                  jnp.float32(epoch))
+                first_call = phase_key not in self._stamped_compiles
+                # Perf sampler (telemetry/profiler.py): on its cadence
+                # wrap ONE step's dispatch in a jax.profiler capture —
+                # skipped on a phase's first call (that window would
+                # measure the compile, not the steady state). Off
+                # (self._perf None, the default) this is one None
+                # check; the window's only cost is its own sync.
+                sampling = (self._perf is not None and not first_call
+                            and self._perf.due(self.current_iter)
+                            and self._perf.start_window(
+                                self.current_iter))
+                try:
+                    if first_call:
+                        self._stamped_compiles.add(phase_key)
+                        with watchdog.phase("compile",
+                                            detail=str(phase_key)):
+                            self.state, metrics = step_fn(
+                                self.state, batch, jnp.float32(epoch))
+                    else:
+                        self.state, metrics = step_fn(
+                            self.state, batch, jnp.float32(epoch))
+                except BaseException:
+                    # A dispatch error / KeyboardInterrupt during a
+                    # sampled window must not leave the process-wide
+                    # profiler trace running (every later capture —
+                    # and the legacy profile_dir trace — would fail
+                    # "already started").
+                    if sampling:
+                        self._perf.abort_window()
+                    raise
+                if sampling:
+                    # The sync happens INSIDE end_window — on the full
+                    # new state, not just the loss scalar, so the
+                    # captured trace covers the WHOLE step (Adam's
+                    # update tail included), not only up to the loss.
+                    self._perf.end_window((self.state, metrics.loss),
+                                          iteration=self.current_iter,
+                                          epoch=epoch)
                 if not self._first_dispatch_done:
                     # Session's first train dispatch is now in flight
                     # (the JIT path's first call blocked on its compile
@@ -1005,9 +1041,11 @@ class ExperimentBuilder:
             # report's watchdog section) must show "0 trips", not omit
             # the counter.
             self.registry.counter(watchdog.TRIPS_COUNTER)
+        prev_profile = None
         try:
             self._run_started_at = time.time()
             self._adopt_aot_plan()
+            prev_profile = self._init_perf_lab()
             result = self._run_experiment()
             if (self._flightrec is not None and isinstance(result, dict)
                     and "preempted_at_iter" in result):
@@ -1055,6 +1093,15 @@ class ExperimentBuilder:
                 cluster.install(prev_cluster)
                 self._cluster = None
                 self._elastic = None
+            # Refresh logs/PROFILE.json with any cards the warmup
+            # thread added (deferred phase compiles land there), then
+            # restore the crash-bundle registration (a sweep driver's
+            # next builder must not inherit this run's profile path).
+            if self._perf is not None or self._aot_store is not None:
+                self._write_profile_json()
+            if getattr(self, "_profile_registered", False):
+                flightrec.register_profile(prev_profile)
+                self._profile_registered = False
             if wd_enabled:
                 watchdog.install_beacon(prev_beacon)
                 flightrec.install(prev_recorder)
@@ -1122,6 +1169,79 @@ class ExperimentBuilder:
                      else "")
                   + f" (store {self._aot_stats['store_dir']})",
                   flush=True)
+
+    def _init_perf_lab(self) -> Optional[str]:
+        """Perf lab (telemetry/profiler.py, docs/PERF.md § Where the
+        time goes): construct the device-time sampler iff
+        ``profile_every_n_steps > 0`` (cost cards from the AOT store's
+        PROFILE.json feed its roofline attribution; adopted compiled
+        executables register their HLO for named-region mapping), and
+        write the run's ``logs/PROFILE.json`` whenever there are cards
+        to persist (armed store) or a sampler to serve. Returns the
+        previous crash-bundle profile registration for the caller's
+        finally to restore."""
+        cfg = self.cfg
+        if cfg.profile_every_n_steps > 0:
+            cards: Dict[str, Any] = {}
+            if self._aot_store is not None:
+                doc = profiler_mod.load_profile(
+                    self._aot_store.profile_path())
+                if doc:
+                    cards = dict(doc["cards"])
+            self._perf = profiler_mod.PerfSampler(
+                cfg.profile_every_n_steps, registry=self.registry,
+                jsonl=self.jsonl, cards=cards)
+            for fn in (list(self.plan.train_steps.values())
+                       + [self.plan.eval_step]):
+                compiled = getattr(fn, "compiled", None)
+                if compiled is not None:
+                    self._perf.register_compiled(compiled)
+        if self._perf is None and self._aot_store is None:
+            return None
+        return self._write_profile_json(register=True)
+
+    def _write_profile_json(self, register: bool = False
+                            ) -> Optional[str]:
+        """Persist the run's cost-card database as
+        ``logs/PROFILE.json`` (merging the AOT store's cards — the
+        store is the database prewarm populates; the logs copy is what
+        scripts/perf_report.py and crash bundles read). Main-process
+        only, best-effort; returns the previous flightrec registration
+        when ``register``."""
+        prev: Optional[str] = None
+        if not self.is_main_process:
+            return prev
+        try:
+            path = os.path.join(self.paths["logs"],
+                                profiler_mod.PROFILE_FILE)
+            cards: List[Dict[str, Any]] = []
+            kind = ""
+            try:
+                devs = jax.devices()
+                kind = devs[0].device_kind if devs else ""
+            except Exception:  # noqa: BLE001
+                pass
+            fingerprint = None
+            if self._aot_store is not None:
+                doc = profiler_mod.load_profile(
+                    self._aot_store.profile_path())
+                if doc:
+                    cards = list(doc["cards"].values())
+                    kind = doc.get("device_kind") or kind
+                fingerprint = self._aot_store.fingerprint
+            profiler_mod.merge_profile(path, cards, device_kind=kind,
+                                       fingerprint=fingerprint)
+            if register:
+                prev = flightrec.register_profile(path)
+                self._profile_registered = True
+            if self._perf is not None:
+                for card in cards:
+                    self._perf.register_card(card["name"], card)
+        except Exception as e:  # noqa: BLE001 — observability only
+            logging.getLogger(__name__).warning(
+                "PROFILE.json write failed (%s: %s)",
+                type(e).__name__, e)
+        return prev
 
     def _note_first_dispatch(self) -> None:
         """One row per session, right after the first train step call

@@ -150,6 +150,11 @@ _RUNTIME_ONLY_KEYS = frozenset({
     "fleet_load_factor", "health_grad_norm_warn_factor",
     "dispatch_sync_every", "live_progress", "use_tensorboard",
     "profile_dir", "profile_epoch", "profile_num_steps",
+    # The perf sampler is pure host-side observation on a cadence: the
+    # compiled programs are identical with it on or off (pinned
+    # bitwise in tests/test_perf_profiler.py), so a profiled run must
+    # hit the same store a production run populated.
+    "profile_every_n_steps",
     "compilation_cache_dir", "aot_store_dir", "prefetch_batches",
     "cache_eval_episodes", "precompile_phases", "ignored_keys",
 })
@@ -611,6 +616,46 @@ class AOTStore:
         finally:
             self._count(LOAD_SECONDS, time.perf_counter() - t0)
 
+    def record_cost_card(self, name: str, compiled,
+                         only_if_missing: bool = False) -> bool:
+        """Merge executable ``name``'s roofline cost card into this
+        fingerprint dir's PROFILE.json (telemetry/profiler.py) — the
+        store doubles as a cost database every compile-and-populate
+        (and the prewarm pipeline) feeds, so the perf CLI can rank
+        executables a login node never ran. Writer-only, best-effort:
+        a card is observability, never worth failing a save over.
+        ``only_if_missing`` skips the (HLO-parsing) card build when the
+        store already has one for this name — the warm-restart hit
+        path must not re-parse a multi-MB HLO per session."""
+        if not (self.writable and self._writer_requested):
+            return False
+        try:
+            from howtotrainyourmamlpytorch_tpu.telemetry import (
+                profiler as _profiler)
+            path = os.path.join(self.dir, _profiler.PROFILE_FILE)
+            if only_if_missing:
+                doc = _profiler.load_profile(path)
+                if doc is not None and name in doc["cards"]:
+                    return True
+            devices = jax.devices()
+            kind = devices[0].device_kind if devices else ""
+            card = _profiler.cost_card_from_compiled(
+                name, compiled, fingerprint=self.fingerprint[:16],
+                device_kind=kind)
+            _profiler.merge_profile(path, [card], device_kind=kind,
+                                    fingerprint=self.fingerprint)
+            return True
+        except Exception as e:  # noqa: BLE001 — observability only
+            log.debug("cost card for %r not recorded (%s: %s)", name,
+                      type(e).__name__, e)
+            return False
+
+    def profile_path(self) -> str:
+        """This fingerprint dir's PROFILE.json path (may not exist)."""
+        from howtotrainyourmamlpytorch_tpu.telemetry import (
+            profiler as _profiler)
+        return os.path.join(self.dir, _profiler.PROFILE_FILE)
+
     def save(self, name: str, compiled) -> bool:
         """Serialize ``compiled`` under ``name`` with manifest-framed
         atomic commit. Returns False (counted) on any failure —
@@ -672,6 +717,12 @@ class GuardedExec:
         self._name = name
         self._registry = registry
 
+    @property
+    def compiled(self):
+        """The wrapped compiled executable (None after demotion) — the
+        perf sampler reads its HLO for named-region attribution."""
+        return self._compiled
+
     def __call__(self, *args):
         if self._compiled is None:
             return self._jit(*args)
@@ -716,6 +767,12 @@ def load_or_compile(store: Optional[AOTStore], name: str, jit_fn,
         return fallback, False
     loaded = store.load(name, count=count_load)
     if loaded is not None:
+        # Hit path: the card was normally recorded when the executable
+        # was populated; a store predating the cost database back-fills
+        # from the deserialized executable (only_if_missing skips the
+        # HLO re-parse on every warm restart; deserialized executables
+        # that refuse as_text degrade silently inside).
+        store.record_cost_card(name, loaded, only_if_missing=True)
         return GuardedExec(loaded, fallback, name, registry), True
     if not compile_on_miss:
         # Deferred-adoption mode (experiment.py's phase-warmup thread):
@@ -733,6 +790,11 @@ def load_or_compile(store: Optional[AOTStore], name: str, jit_fn,
     store._count(COMPILE_SECONDS, time.perf_counter() - t0)
     if save:
         store.save(name, compiled)
+        # Every compile-and-populate also records the executable's
+        # roofline cost card — a cold run (and the prewarm CLI, which
+        # rides this same primitive) builds the cost database the perf
+        # report reads.
+        store.record_cost_card(name, compiled)
     return GuardedExec(compiled, fallback, name, registry), False
 
 

@@ -31,7 +31,6 @@ scaling axes that exist are tasks (sharded here) and inner-loop depth
 
 from __future__ import annotations
 
-import functools
 import logging
 import warnings
 from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
@@ -144,6 +143,21 @@ class MeshPlan(NamedTuple):
     aot_train_steps: Dict[Tuple[bool, bool], Callable]
 
 
+def _named_phase_fn(train_step, so: bool, msl: bool):
+    """A phase executable closure with a REAL ``__name__`` — a bare
+    ``functools.partial`` lowers every phase to the same anonymous
+    ``HloModule jit__unnamed_function_``, which makes profiler traces
+    (telemetry/profiler.py groups device time by ``hlo_module``)
+    unattributable. The name matches the AOT store slot
+    (``aot.train_exec_name``) so trace modules map onto cost cards.
+    Metadata only: the traced computation is byte-identical."""
+    def f(state, batch, epoch):
+        return train_step(state, batch, epoch, second_order=so,
+                          use_msl=msl)
+    f.__name__ = f"train_so{int(so)}_msl{int(msl)}"
+    return f
+
+
 def make_sharded_steps(cfg: MAMLConfig, apply_fn,
                        mesh: Mesh) -> MeshPlan:
     """Build the sharded train/eval executables as ``jit(shard_map(step))``
@@ -221,7 +235,7 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
     for so in (False, True):
         for msl in (False, True):
             smapped = _shard_map(
-                functools.partial(train_step, second_order=so, use_msl=msl),
+                _named_phase_fn(train_step, so, msl),
                 mesh=mesh,
                 in_specs=(P(), batch_spec, P()),
                 out_specs=(P(), P()),

@@ -36,6 +36,7 @@ STACKS_FILE = "stacks.txt"
 FLIGHT_FILE = "flight.jsonl"
 CRASH_FILE = "crash.json"
 TRACE_FILE = "trace.json"
+PROFILE_FILE = "PROFILE.json"
 
 
 class FlightRecorder:
@@ -87,6 +88,19 @@ class FlightRecorder:
 
 
 _recorder: Optional[FlightRecorder] = None
+_profile_path: Optional[str] = None
+
+
+def register_profile(path: Optional[str]) -> Optional[str]:
+    """Remember the newest PROFILE.json (telemetry/profiler.py cost
+    cards) so crash bundles can carry it; returns the previous
+    registration (scoped lifetimes restore it — the install pattern).
+    A watchdog trip during the MFU campaign then ships the perf
+    context that explains what was running slow alongside the stacks."""
+    global _profile_path
+    prev = _profile_path
+    _profile_path = path
+    return prev
 
 
 def install(recorder: Optional[FlightRecorder]) -> Optional[FlightRecorder]:
@@ -157,6 +171,17 @@ def write_crash_bundle(bundle_dir: str, *, reason: str,
             _trace.write_trace(os.path.join(bundle_dir, TRACE_FILE),
                                flight=rec.events(),
                                process_index=process_index)
+        except Exception:
+            pass
+    if _profile_path is not None:
+        # The newest PROFILE.json (perf-lab cost cards) rides the
+        # bundle best-effort: a watchdog trip mid-MFU-campaign should
+        # carry the roofline context of what was running slow.
+        try:
+            if os.path.isfile(_profile_path):
+                import shutil
+                shutil.copyfile(_profile_path,
+                                os.path.join(bundle_dir, PROFILE_FILE))
         except Exception:
             pass
     crash: Dict[str, Any] = {"reason": reason, "ts": time.time(),

@@ -879,6 +879,34 @@ class ServingEngine:
                           process_index=jax.process_index())
         return path
 
+    def write_profile_json(self, path: Optional[str] = None
+                           ) -> Optional[str]:
+        """Persist this serving process's roofline cost cards
+        (telemetry/profiler.py) as PROFILE.json — the serve
+        adapt/predict bucket cards land in the AOT store's database as
+        each bucket is adopted (parallel/aot.py § record_cost_card);
+        this copies them next to the serve logs for
+        scripts/perf_report.py. Returns the written path, or None when
+        the store is off (the plain jit path exposes no compiled
+        executables to card) or holds no cards yet. Default path:
+        ``<experiment_root>/<name>/logs/PROFILE.json``."""
+        if self._aot_store is None:
+            return None
+        from howtotrainyourmamlpytorch_tpu.telemetry import (
+            profiler as profiler_mod)
+        doc = profiler_mod.load_profile(self._aot_store.profile_path())
+        if doc is None or not doc["cards"]:
+            return None
+        if path is None:
+            path = os.path.join(self.cfg.experiment_root,
+                                self.cfg.experiment_name, "logs",
+                                profiler_mod.PROFILE_FILE)
+        profiler_mod.merge_profile(
+            path, list(doc["cards"].values()),
+            device_kind=doc.get("device_kind", ""),
+            fingerprint=self._aot_store.fingerprint)
+        return path
+
     def _mirror_cache_counters(self) -> None:
         """LRU counts -> monotonic registry counters (delta-mirrored:
         the cache keeps plain ints so it stays registry-agnostic)."""

@@ -1,0 +1,789 @@
+"""Perf lab: device-time attribution, roofline cost cards, MFU breakdown.
+
+BENCH_r05 reports 46.2 meta-tasks/s/chip at MFU ~0.039 — and nothing
+in-tree could say where the other ~96% of the chip goes. The total
+FLOPs of the step are known (``utils/hlo_flops.py`` trip expansion),
+but not how device time divides between the second-order K-step inner
+scan, the outer gradients, Adam, and host dispatch gaps. This module is
+the instrument the MFU campaign (ROADMAP item 1) reads before and
+after every optimization:
+
+* **Cost cards** — one card per compiled executable: trip-expanded
+  hardware FLOPs (the ``hlo_flops`` algorithm, the ONE flops algorithm
+  in the repo), bytes accessed from XLA's ``cost_analysis``, compiled
+  memory stats, arithmetic intensity, and a compute-vs-memory-bound
+  verdict against a per-device-kind peak-FLOPs + HBM-bandwidth table
+  (:data:`DEVICE_PEAKS`). Cards persist as ``PROFILE.json`` — in the
+  run's ``logs/`` and alongside each executable in its AOT fingerprint
+  dir (``parallel/aot.py`` records a card whenever it compiles or
+  adopts, so the store doubles as a cost database the prewarm pipeline
+  populates).
+* **Sampled device-time attribution** — ``profile_every_n_steps``
+  (config) wraps one dispatch-sync window in ``jax.profiler`` trace
+  capture on its cadence; the resulting ``*.trace.json.gz`` is parsed
+  into per-executable and per-named-region device time (the
+  ``jax.named_scope`` labels from meta/inner.py, meta/outer.py,
+  ops/episode.py reach the HLO ``op_name`` metadata, which maps each
+  profiled HLO op back to its region). Each sample publishes ``perf/*``
+  gauges and one ``perf_profile`` events.jsonl row: the window's wall
+  time split into device-compute, device-idle and host dispatch gap,
+  plus achieved FLOP/s per executable against its roofline ceiling.
+  0 (the default) installs NOTHING — the ``health_metrics_every_n_steps``
+  zero-cost discipline, pinned bitwise (weights + cache-warm compile
+  counts) by tests/test_perf_profiler.py.
+* **Reporting** — ``scripts/perf_report.py`` (jax-free, file-path
+  imports) renders the ranked where-does-the-time-go table from
+  PROFILE.json + events.jsonl; telemetry report schema v12 adds the
+  "perf" section; the Chrome-trace exporter gains a profiler-sample
+  lane; bench.py's artifact carries ``mfu_compute_frac`` /
+  ``dispatch_gap_frac`` / ``top_executable`` / ``top_executable_bound``.
+
+Import discipline: stdlib-only at import time (the telemetry/report.py
+rule) so the CLI can load this module by file path on a login node —
+``jax`` and ``utils/hlo_flops`` (numpy) are imported lazily inside the
+functions that touch compiled executables or the live profiler.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import tempfile
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+PROFILE_SCHEMA = "maml_perf_profile_v1"
+PROFILE_FILE = "PROFILE.json"
+PERF_EVENT = "perf_profile"
+# Host TraceAnnotation bracketing the sampled window: its span gives
+# the window's [start, end] in the TRACE's own clock (which is neither
+# unix time nor CLOCK_MONOTONIC), so device op spans can be clipped to
+# the window — without it, async ops from the PREVIOUS step still in
+# flight when the capture begins would attribute into this window
+# (observed live: device_compute > wall on the first sample).
+WINDOW_MARKER = "maml_perf_window"
+
+# Metric names (the registry naming convention: perf/<name>).
+SAMPLES_COUNTER = "perf/samples"
+SAMPLE_SECONDS_COUNTER = "perf/sample_seconds"
+ERRORS_COUNTER = "perf/errors"
+COMPUTE_FRAC_GAUGE = "perf/device_compute_frac"
+IDLE_FRAC_GAUGE = "perf/device_idle_frac"
+GAP_FRAC_GAUGE = "perf/dispatch_gap_frac"
+
+# Env overrides for chips the table doesn't know (or operators who have
+# MEASURED their chip — docs/PERF.md § MFU, corrected by measurement
+# records a v5e-labelled part sustaining v5p-class matmul rates, so the
+# table number is a default, not an oracle). Values: FLOP/s and GB/s.
+PEAK_FLOPS_ENV = "MAML_PEAK_FLOPS"
+HBM_GBPS_ENV = "MAML_HBM_GBPS"
+
+# Peak dense bf16 FLOP/s and HBM bandwidth (bytes/s) per chip by device
+# kind substring (public spec sheets). Matched against
+# jax.Device.device_kind, first hit wins — same order bench.py has
+# always used ("v5 lite" before the bare "v5" so v5e doesn't read as
+# v5p).
+DEVICE_PEAKS: Tuple[Tuple[str, float, float], ...] = (
+    ("v5 lite", 197e12, 819e9), ("v5e", 197e12, 819e9),
+    ("v5p", 459e12, 2765e9), ("v5", 459e12, 2765e9),
+    ("v6", 918e12, 1640e9), ("trillium", 918e12, 1640e9),
+    ("v4", 275e12, 1228e9), ("v3", 123e12, 900e9),
+    ("v2", 45e12, 700e9),
+)
+
+# named_scope labels compiled into the step graphs (PR 1/PR 6); an HLO
+# op whose op_name path contains one of these attributes its device
+# time to that region. Order matters only for ops nested under several
+# labels — the LAST (innermost) match wins in region_index_from_hlo.
+KNOWN_REGIONS: Tuple[str, ...] = (
+    "episode_normalize", "inner_support_forward", "inner_support_grad",
+    "inner_lslr_update", "inner_msl_target_forward",
+    "final_target_forward", "task_adapt", "meta_update",
+    "serve_adapt", "serve_predict",
+)
+OTHER_REGION = "other"           # indexed module, op under no known label
+UNATTRIBUTED = "unattributed"    # module with no registered HLO index
+
+_warned_kinds: set = set()
+
+
+def resolve_peaks(device_kind: str,
+                  env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Peak FLOP/s + HBM bytes/s for a device kind.
+
+    Returns ``{"peak_flops", "hbm_bytes_per_s", "source"}`` where
+    ``source`` is ``"override"`` (either env var set — the operator's
+    measured number wins over the table), ``"table"`` (device-kind
+    substring match) or ``"unknown"`` (neither; both peaks 0.0 and
+    every roofline verdict degrades to "unknown"). An unmatched kind
+    warns ONCE per process — a quietly-wrong MFU against a guessed
+    peak is exactly what the ``peak_flops_source`` key exists to
+    prevent."""
+    env = os.environ if env is None else env
+    kind = (device_kind or "").lower()
+    peak = bw = 0.0
+    source = "unknown"
+    for sub, p, b in DEVICE_PEAKS:
+        if sub in kind:
+            peak, bw, source = p, b, "table"
+            break
+    override = False
+    raw = env.get(PEAK_FLOPS_ENV)
+    if raw:
+        try:
+            peak = float(raw)
+            override = True
+        except ValueError:
+            warnings.warn(f"{PEAK_FLOPS_ENV}={raw!r} is not a number; "
+                          f"ignoring the override")
+    raw = env.get(HBM_GBPS_ENV)
+    if raw:
+        try:
+            bw = float(raw) * 1e9
+            override = True
+        except ValueError:
+            warnings.warn(f"{HBM_GBPS_ENV}={raw!r} is not a number; "
+                          f"ignoring the override")
+    if override:
+        source = "override"
+    elif source == "unknown" and kind not in _warned_kinds:
+        _warned_kinds.add(kind)
+        warnings.warn(
+            f"device kind {device_kind!r} matches no entry in the peak "
+            f"FLOPs/bandwidth table; MFU and roofline verdicts are "
+            f"unavailable (set {PEAK_FLOPS_ENV} / {HBM_GBPS_ENV} to "
+            f"supply measured peaks)")
+    return {"peak_flops": peak, "hbm_bytes_per_s": bw, "source": source}
+
+
+def roofline_verdict(flops: float, bytes_accessed: float,
+                     peak_flops: float,
+                     hbm_bytes_per_s: float) -> Dict[str, Any]:
+    """Classify one executable against the device roofline.
+
+    Arithmetic intensity AI = flops / bytes; the ridge point is
+    peak_flops / bandwidth. AI >= ridge → the MXU ceiling binds
+    ("compute"); below it the HBM ceiling binds ("memory"). The
+    achievable ceiling is ``min(peak, AI * bandwidth)`` FLOP/s. With
+    either peak unknown (0) — or no measured bytes — the verdict is
+    "unknown", never a guess."""
+    ai = (flops / bytes_accessed) if bytes_accessed > 0 else None
+    if peak_flops <= 0 or hbm_bytes_per_s <= 0 or ai is None or flops <= 0:
+        return {"bound": "unknown", "arithmetic_intensity": ai,
+                "ridge_flops_per_byte": None,
+                "ceiling_flops_per_s": None}
+    ridge = peak_flops / hbm_bytes_per_s
+    return {
+        "bound": "compute" if ai >= ridge else "memory",
+        "arithmetic_intensity": ai,
+        "ridge_flops_per_byte": ridge,
+        "ceiling_flops_per_s": min(peak_flops, ai * hbm_bytes_per_s),
+    }
+
+
+def build_cost_card(name: str, *,
+                    flops_info: Dict[str, Any],
+                    bytes_accessed: float,
+                    memory: Optional[Dict[str, int]],
+                    fingerprint: Optional[str],
+                    device_kind: str,
+                    peaks: Dict[str, Any]) -> Dict[str, Any]:
+    """Assemble one cost card (pure — every measured input is passed
+    in). ``flops_info`` is ``utils.hlo_flops.executable_flops`` output;
+    ``memory`` is the compiled-memory-stats dict (or None when the
+    backend exposes none)."""
+    flops = float(flops_info.get("flops") or 0.0)
+    verdict = roofline_verdict(flops, bytes_accessed,
+                               peaks["peak_flops"],
+                               peaks["hbm_bytes_per_s"])
+    card = {
+        "name": name,
+        "fingerprint": fingerprint,
+        "device_kind": device_kind,
+        "flops": flops,
+        "flops_source": flops_info.get("source", "unavailable"),
+        "bytes_accessed": float(bytes_accessed),
+        "memory": memory,
+        **verdict,
+    }
+    if "parse_error" in flops_info:
+        card["flops_parse_error"] = flops_info["parse_error"]
+    if flops_info.get("trip_counts"):
+        card["trip_counts"] = flops_info["trip_counts"]
+    return card
+
+
+def _compiled_memory(compiled) -> Optional[Dict[str, int]]:
+    """Compiled memory stats as a plain dict (peak = argument + output
+    + temp: the executable's device working set; generated code rides
+    along when reported). None when the backend exposes nothing."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        out = {}
+        for field in ("generated_code_size_in_bytes",
+                      "argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes"):
+            v = getattr(ma, field, None)
+            if v is not None:
+                out[field] = int(v)
+        if not out:
+            return None
+        out["peak_bytes"] = (out.get("argument_size_in_bytes", 0)
+                             + out.get("output_size_in_bytes", 0)
+                             + out.get("temp_size_in_bytes", 0))
+        return out
+    except Exception:  # noqa: BLE001 — observability never raises
+        return None
+
+
+def _bytes_accessed(compiled) -> float:
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("bytes accessed", 0.0))
+    except Exception:  # noqa: BLE001
+        return 0.0
+
+
+def cost_card_from_compiled(name: str, compiled, *,
+                            fingerprint: Optional[str] = None,
+                            device_kind: Optional[str] = None,
+                            peaks: Optional[Dict[str, Any]] = None
+                            ) -> Dict[str, Any]:
+    """Cost card of a live compiled executable. Every probe is
+    fail-soft: a backend without cost analysis / HLO text yields a card
+    with zeros and ``flops_source="unavailable"`` rather than an
+    exception — the card records what could be measured, honestly."""
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001
+            device_kind = ""
+    if peaks is None:
+        peaks = resolve_peaks(device_kind)
+    try:
+        from howtotrainyourmamlpytorch_tpu.utils.hlo_flops import (
+            executable_flops)
+        flops_info = executable_flops(compiled)
+    except Exception as e:  # noqa: BLE001
+        flops_info = {"flops": 0.0, "source": "unavailable",
+                      "parse_error": f"{type(e).__name__}: {e}"}
+    return build_cost_card(
+        name,
+        flops_info=flops_info,
+        bytes_accessed=_bytes_accessed(compiled),
+        memory=_compiled_memory(compiled),
+        fingerprint=fingerprint,
+        device_kind=device_kind,
+        peaks=peaks)
+
+
+# ---------------------------------------------------------------------------
+# PROFILE.json — the persisted cost database.
+
+def load_profile(path: str) -> Optional[Dict[str, Any]]:
+    """Parse a PROFILE.json; None when missing/unreadable/foreign-schema
+    (fail-soft — a report must work without one)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or doc.get("schema") != PROFILE_SCHEMA:
+        return None
+    if not isinstance(doc.get("cards"), dict):
+        doc["cards"] = {}
+    return doc
+
+
+def merge_profile(path: str, cards: List[Dict[str, Any]], *,
+                  device_kind: str = "",
+                  peaks: Optional[Dict[str, Any]] = None,
+                  fingerprint: Optional[str] = None) -> Dict[str, Any]:
+    """Read-merge-write PROFILE.json atomically: cards are keyed by
+    name, newest wins; existing cards for other executables survive
+    (several writers legally share one file — trainer, warmup thread,
+    prewarmer — the AOT-manifest multi-writer idiom, with the same
+    residual last-rewrite-wins race costing one card, never a torn
+    file)."""
+    peaks = peaks if peaks is not None else resolve_peaks(device_kind)
+    doc = load_profile(path) or {
+        "schema": PROFILE_SCHEMA, "cards": {}}
+    doc.update(device_kind=device_kind or doc.get("device_kind", ""),
+               peak_flops=peaks["peak_flops"],
+               hbm_bytes_per_s=peaks["hbm_bytes_per_s"],
+               peak_flops_source=peaks["source"],
+               written_ts=time.time())
+    if fingerprint is not None:
+        doc["fingerprint"] = fingerprint
+    for card in cards:
+        doc["cards"][card["name"]] = card
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# Trace parsing — jax.profiler output -> device-time attribution.
+
+_HLO_MODULE_RE = re.compile(r"^HloModule\s+([\w.\-]+)")
+_OP_NAME_RE = re.compile(
+    r"%?([\w.\-]+)\s+=\s+.*op_name=\"([^\"]*)\"")
+
+
+def region_index_from_hlo(hlo_text: str,
+                          regions: Tuple[str, ...] = KNOWN_REGIONS
+                          ) -> Tuple[str, Dict[str, str]]:
+    """(module_name, {instruction_name: region}) from optimized HLO.
+
+    The ``op_name`` metadata carries the full named_scope path (e.g.
+    ``jit(step)/jit(main)/inner_support_grad/dot_general``); each
+    instruction maps to the INNERMOST known region label on its path
+    (fusions inherit their root op's metadata — close enough for
+    attribution at region granularity). Instructions under no known
+    label map to :data:`OTHER_REGION`."""
+    m = _HLO_MODULE_RE.search(hlo_text)
+    module = m.group(1) if m else ""
+    index: Dict[str, str] = {}
+    for line in hlo_text.splitlines():
+        if "op_name=" not in line:
+            continue
+        om = _OP_NAME_RE.search(line.strip())
+        if not om:
+            continue
+        instr, path = om.group(1), om.group(2)
+        region = OTHER_REGION
+        best = -1
+        for r in regions:
+            pos = path.rfind(r)
+            if pos > best:
+                best, region = pos, r
+        index[instr] = region
+    return module, index
+
+
+def find_trace_file(trace_dir: str) -> Optional[str]:
+    """Newest ``*.trace.json.gz`` (or ``.trace.json``) under
+    ``trace_dir`` — jax.profiler writes
+    ``plugins/profile/<run>/<host>.trace.json.gz``."""
+    candidates = (glob.glob(os.path.join(trace_dir, "**",
+                                         "*.trace.json.gz"),
+                            recursive=True)
+                  + glob.glob(os.path.join(trace_dir, "**",
+                                           "*.trace.json"),
+                              recursive=True))
+    if not candidates:
+        return None
+    return max(candidates, key=os.path.getmtime)
+
+
+def read_trace_events(path: str) -> List[Dict[str, Any]]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    return events if isinstance(events, list) else []
+
+
+def _merged_length_us(intervals: List[Tuple[float, float]]) -> float:
+    """Total length of the union of [start, end) microsecond intervals."""
+    if not intervals:
+        return 0.0
+    intervals.sort()
+    total = 0.0
+    cur_s, cur_e = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    return total + (cur_e - cur_s)
+
+
+def summarize_trace_events(
+        events: List[Dict[str, Any]], wall_seconds: float,
+        region_indexes: Optional[Dict[str, Dict[str, str]]] = None
+) -> Dict[str, Any]:
+    """Device-time attribution of one captured window.
+
+    Device execution spans are the ``ph == "X"`` rows whose ``args``
+    carry ``hlo_module``/``hlo_op`` (the XLA executor's per-op spans —
+    present on both the TFRT CPU thunk executor and TPU device lanes).
+    The window's wall clock (host-measured around the capture) splits
+    three ways:
+
+    * ``device_compute_seconds`` — union of device op spans (any device
+      executing counts once; per-executable sums may exceed the union
+      when devices overlap, documented);
+    * ``device_idle_seconds`` — gaps BETWEEN device ops inside the
+      [first op start, last op end] envelope: the device waiting on
+      dependencies/dispatch mid-step;
+    * ``host_gap_seconds`` — wall time outside the envelope: host
+      dispatch before the first kernel + fetch after the last. This is
+      the "dispatch gap" an async pipeline should hide.
+
+    Per-executable seconds group by ``hlo_module``; per-region seconds
+    map each op through ``region_indexes[module]`` (built by
+    :func:`region_index_from_hlo`); modules without an index attribute
+    to :data:`UNATTRIBUTED`."""
+    region_indexes = region_indexes or {}
+    # Window clip bounds from the host marker span(s): ops of a
+    # PREVIOUS step still executing asynchronously when the capture
+    # started are in the trace but outside the marker — they must not
+    # attribute into this window. Traces without the marker (older
+    # captures, exotic backends) stay unclipped.
+    lo = hi = None
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") == WINDOW_MARKER:
+            ts = float(e.get("ts") or 0.0)
+            dur = float(e.get("dur") or 0.0)
+            lo = ts if lo is None else min(lo, ts)
+            hi = ts + dur if hi is None else max(hi, ts + dur)
+    intervals: List[Tuple[float, float]] = []
+    per_exec: Dict[str, float] = {}
+    per_region: Dict[str, float] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        module = args.get("hlo_module")
+        if not module:
+            continue
+        ts = float(e.get("ts") or 0.0)
+        dur = float(e.get("dur") or 0.0)
+        if dur <= 0:
+            continue
+        start, end = ts, ts + dur
+        if lo is not None:
+            start, end = max(start, lo), min(end, hi)
+            if end <= start:
+                continue
+        dur = end - start
+        intervals.append((start, end))
+        per_exec[module] = per_exec.get(module, 0.0) + dur
+        idx = region_indexes.get(module)
+        if idx is None:
+            region = UNATTRIBUTED
+        else:
+            op = args.get("hlo_op") or e.get("name") or ""
+            region = idx.get(str(op), OTHER_REGION)
+        per_region[region] = per_region.get(region, 0.0) + dur
+    if intervals:
+        first = min(s for s, _ in intervals)
+        last = max(e_ for _, e_ in intervals)
+        busy_us = _merged_length_us(intervals)
+        envelope_us = last - first
+    else:
+        busy_us = envelope_us = 0.0
+    wall = max(float(wall_seconds), 0.0)
+    busy = busy_us / 1e6
+    envelope = envelope_us / 1e6
+    idle = max(envelope - busy, 0.0)
+    gap = max(wall - envelope, 0.0)
+    out = {
+        "wall_seconds": wall,
+        "device_compute_seconds": busy,
+        "device_idle_seconds": idle,
+        "host_gap_seconds": gap,
+        "device_compute_frac": (busy / wall) if wall > 0 else 0.0,
+        "device_idle_frac": (idle / wall) if wall > 0 else 0.0,
+        "dispatch_gap_frac": (gap / wall) if wall > 0 else 0.0,
+        "per_executable_seconds": {
+            k: v / 1e6 for k, v in sorted(
+                per_exec.items(), key=lambda kv: -kv[1])},
+        "per_region_seconds": {
+            k: v / 1e6 for k, v in sorted(
+                per_region.items(), key=lambda kv: -kv[1])},
+        "device_spans": len(intervals),
+    }
+    out["top_executable"] = (next(iter(out["per_executable_seconds"]))
+                            if out["per_executable_seconds"] else None)
+    return out
+
+
+def attach_roofline(summary: Dict[str, Any],
+                    cards: Dict[str, Dict[str, Any]],
+                    steps: int = 1) -> Dict[str, Any]:
+    """Extend a window summary with achieved-FLOP/s-vs-ceiling per
+    executable: card FLOPs are per execution, so ``steps`` executions
+    over the module's measured device seconds give the achieved rate.
+    Modules without a card (or without measured time) are skipped —
+    absence is honest, a guessed rate is not."""
+    achieved: Dict[str, Dict[str, Any]] = {}
+    for module, secs in summary.get("per_executable_seconds", {}).items():
+        card = cards.get(module) or _match_card(module, cards)
+        if card is None or secs <= 0 or not card.get("flops"):
+            continue
+        rate = card["flops"] * steps / secs
+        entry = {"achieved_flops_per_s": rate,
+                 "bound": card.get("bound", "unknown")}
+        ceiling = card.get("ceiling_flops_per_s")
+        if ceiling:
+            entry["ceiling_flops_per_s"] = ceiling
+            entry["frac_of_ceiling"] = rate / ceiling
+        achieved[module] = entry
+    summary["roofline"] = achieved
+    return summary
+
+
+def _match_card(module: str,
+                cards: Dict[str, Dict[str, Any]]
+                ) -> Optional[Dict[str, Any]]:
+    """Fuzzy module→card match: trace modules are named after the
+    jitted python function (``jit_train_step``); store cards after the
+    executable slot (``train_so1_msl0``). A unique substring hit in
+    either direction matches; ambiguity matches nothing."""
+    norm = module.lower()
+    if norm.startswith("jit_"):
+        norm = norm[len("jit_"):]
+    hits = [c for n, c in cards.items()
+            if n.lower() in module.lower() or norm in n.lower()]
+    return hits[0] if len(hits) == 1 else None
+
+
+# ---------------------------------------------------------------------------
+# Live capture.
+
+def capture_window(run: Callable[[], Any],
+                   region_indexes: Optional[Dict[str, Dict[str, str]]]
+                   = None) -> Dict[str, Any]:
+    """Wrap one callable in a jax.profiler trace capture and attribute
+    it: ``run()`` must dispatch AND synchronize its own work (fetch a
+    scalar / block_until_ready) so the wall clock brackets real device
+    execution. Returns :func:`summarize_trace_events` output. Raises on
+    capture failure — callers decide their fail-soft story."""
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="maml_perf_")
+    try:
+        jax.profiler.start_trace(tmp)
+        # t0 AFTER start_trace and wall BEFORE stop_trace: the first
+        # capture in a process pays seconds of profiler-infra init and
+        # stop_trace serializes the trace — neither is part of the
+        # window being attributed. The TraceAnnotation brackets the
+        # window in the trace's own clock (WINDOW_MARKER rationale).
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.TraceAnnotation(WINDOW_MARKER):
+                run()
+            wall = time.perf_counter() - t0
+        finally:
+            jax.profiler.stop_trace()
+        path = find_trace_file(tmp)
+        if path is None:
+            raise RuntimeError("profiler wrote no trace file")
+        return summarize_trace_events(read_trace_events(path), wall,
+                                      region_indexes)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+class PerfSampler:
+    """The experiment loop's sampling half: cadence bookkeeping, trace
+    capture around one dispatch-sync window, and publication (``perf/*``
+    gauges + one ``perf_profile`` events.jsonl row + a flight-ring
+    record).
+
+    Constructed iff ``profile_every_n_steps > 0`` — the structural
+    zero-cost pin is the experiment loop holding ``None`` otherwise.
+    Every capture failure is counted (``perf/errors``) and warned once;
+    profiling must never kill (or slow, beyond its own window) a run.
+
+    Honesty note: the sampled window executes UNDER tracing, so its
+    absolute times carry the tracer's own overhead — substantial on
+    the CPU backend (a per-op host callback on thousands of thunks),
+    negligible on TPU where device lanes are hardware-timed. The
+    profiler-infra init (first capture, seconds) and the stop_trace
+    serialization are excluded from the reported wall; the SPLIT
+    (compute vs idle vs gap) is the signal, sampled absolute times are
+    upper bounds.
+    """
+
+    def __init__(self, every_n: int, registry=None, jsonl=None,
+                 cards: Optional[Dict[str, Dict[str, Any]]] = None):
+        if every_n < 1:
+            raise ValueError(f"every_n must be >= 1, got {every_n}")
+        self.every_n = int(every_n)
+        self.registry = registry
+        self.jsonl = jsonl
+        self.cards = cards if cards is not None else {}
+        self.region_indexes: Dict[str, Dict[str, str]] = {}
+        self._last_iter: Optional[int] = None
+        # (tmpdir, t0, open TraceAnnotation) while a capture is live.
+        self._window: Optional[Tuple[str, float, Any]] = None
+        self._warned = False
+        if registry is not None:
+            # Eager registration (the resilience-counter rule): a
+            # profiling-armed run reports "0 samples", not no section.
+            registry.counter(SAMPLES_COUNTER)
+            registry.counter(SAMPLE_SECONDS_COUNTER)
+
+    # -- cadence -----------------------------------------------------------
+    def due(self, iteration: int) -> bool:
+        return (self._last_iter is None
+                or iteration - self._last_iter >= self.every_n)
+
+    # -- region attribution ------------------------------------------------
+    def register_compiled(self, compiled) -> None:
+        """Index a compiled executable's HLO so its profiled ops
+        attribute to named regions. Fail-soft (deserialized AOT
+        executables may refuse ``as_text``)."""
+        try:
+            module, index = region_index_from_hlo(compiled.as_text())
+            if module:
+                self.region_indexes[module] = index
+        except Exception:  # noqa: BLE001
+            pass
+
+    def register_card(self, name: str, card: Dict[str, Any]) -> None:
+        self.cards[name] = card
+
+    # -- capture -----------------------------------------------------------
+    def start_window(self, iteration: int) -> bool:
+        """Begin trace capture; True iff armed. Never raises. The
+        cadence slot is consumed by the ATTEMPT (``iteration`` recorded
+        up front): a backend that cannot trace must fail once per
+        cadence period, not once per train step — the never-slow-a-run
+        contract."""
+        import jax
+
+        self._last_iter = iteration
+        tmp = tempfile.mkdtemp(prefix="maml_perf_")
+        try:
+            jax.profiler.start_trace(tmp)
+            annot = jax.profiler.TraceAnnotation(WINDOW_MARKER)
+            annot.__enter__()
+        except Exception as e:  # noqa: BLE001
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+            shutil.rmtree(tmp, ignore_errors=True)
+            self._count_error(e)
+            return False
+        # Clock starts AFTER start_trace returns: the first capture in
+        # a process pays seconds of profiler-infra init, which is not
+        # part of the step window being attributed. The annotation
+        # brackets the window in the trace's own clock so device spans
+        # of a previous in-flight step can be clipped out
+        # (WINDOW_MARKER rationale).
+        self._window = (tmp, time.perf_counter(), annot)
+        return True
+
+    def abort_window(self) -> None:
+        """Tear down a live capture WITHOUT publishing — the escape
+        hatch for an exception between start_window and end_window (a
+        dispatch error, KeyboardInterrupt, preemption unwind). Leaving
+        the process-wide jax profiler trace active would buffer events
+        for the rest of the run and fail every later start_trace.
+        Never raises."""
+        if self._window is None:
+            return
+        tmp, _, annot = self._window
+        self._window = None
+        try:
+            annot.__exit__(None, None, None)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    def end_window(self, sync, iteration: int,
+                   epoch: Optional[int] = None) -> Optional[Dict[str, Any]]:
+        """Close the window: ``sync`` (arrays or a callable) is forced
+        to completion INSIDE the window so the trace covers real device
+        execution, then the capture is parsed and published. Returns
+        the summary row (None on failure, counted)."""
+        if self._window is None:
+            return None
+        import jax
+
+        tmp, t0, annot = self._window
+        self._window = None
+        self._last_iter = iteration
+        try:
+            wall = None
+            try:
+                try:
+                    if callable(sync):
+                        sync()
+                    else:
+                        jax.block_until_ready(sync)
+                finally:
+                    annot.__exit__(None, None, None)
+                # Wall is read BEFORE stop_trace (which serializes the
+                # trace to disk — not part of the attributed window).
+                wall = time.perf_counter() - t0
+            finally:
+                jax.profiler.stop_trace()
+            if wall is None:
+                raise RuntimeError("window sync failed")
+            path = find_trace_file(tmp)
+            if path is None:
+                raise RuntimeError("profiler wrote no trace file")
+            summary = summarize_trace_events(
+                read_trace_events(path), wall, self.region_indexes)
+            attach_roofline(summary, self.cards, steps=1)
+        except Exception as e:  # noqa: BLE001
+            self._count_error(e)
+            return None
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+        self._publish(summary, iteration, epoch)
+        return summary
+
+    # -- publication -------------------------------------------------------
+    def _publish(self, summary: Dict[str, Any], iteration: int,
+                 epoch: Optional[int]) -> None:
+        reg = self.registry
+        if reg is not None:
+            reg.counter(SAMPLES_COUNTER).inc()
+            reg.counter(SAMPLE_SECONDS_COUNTER).inc(
+                summary["wall_seconds"])
+            reg.gauge(COMPUTE_FRAC_GAUGE).set(
+                summary["device_compute_frac"])
+            reg.gauge(IDLE_FRAC_GAUGE).set(summary["device_idle_frac"])
+            reg.gauge(GAP_FRAC_GAUGE).set(summary["dispatch_gap_frac"])
+        if self.jsonl is not None:
+            self.jsonl.log(PERF_EVENT, iter=iteration, epoch=epoch,
+                           **summary)
+        try:
+            from howtotrainyourmamlpytorch_tpu.resilience import flightrec
+            flightrec.record(
+                PERF_EVENT, iter=iteration,
+                wall_seconds=round(summary["wall_seconds"], 6),
+                device_compute_frac=round(
+                    summary["device_compute_frac"], 4),
+                top_executable=summary.get("top_executable"))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _count_error(self, e: BaseException) -> None:
+        if self.registry is not None:
+            try:
+                self.registry.counter(ERRORS_COUNTER).inc()
+            except Exception:  # noqa: BLE001
+                pass
+        if not self._warned:
+            self._warned = True
+            warnings.warn(
+                f"perf profiling sample failed ({type(e).__name__}: "
+                f"{e}); further failures are counted silently "
+                f"(perf/errors)")

@@ -30,8 +30,11 @@ from typing import Any, Dict, List, Optional, Union
 # creates by design); v11: + "fleet" (serving fleet, serve/fleet/:
 # replicas live/draining, shared-L2 hits/misses/errors, rolling swaps
 # and halts, router spills — counters reset-aware across replica
-# restarts, gauges last-wins)
-SCHEMA = "maml_tpu_telemetry_report_v11"
+# restarts, gauges last-wins); v12: + "perf" (perf lab,
+# telemetry/profiler.py: sampled device-time attribution — sample
+# counters reset-aware across process lifetimes, window-split fractions
+# and the top device-time executable last-signal in log order)
+SCHEMA = "maml_tpu_telemetry_report_v12"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -602,6 +605,58 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                             if hits + misses > 0 else UNAVAILABLE),
         }
 
+    # Perf section (telemetry/profiler.py, schema v12): each
+    # "perf_profile" row is one sampled dispatch-sync window — the
+    # window-split fractions and top device-time executable take the
+    # most recent signal in log order (the current shape of the step);
+    # sample counts accumulate reset-aware from the perf/samples
+    # counter on registry "metrics" rows (one log spans preempt/restart
+    # segments) cross-checked against the explicit rows. Runs without
+    # profile_every_n_steps summarize to "unavailable".
+    pf_totals: Dict[str, float] = {}
+    pf_prev: Dict[str, float] = {}
+    pf_rows = 0
+    pf_seen = False
+    pf_compute: Metric = UNAVAILABLE
+    pf_gap: Metric = UNAVAILABLE
+    pf_top: Metric = UNAVAILABLE
+    pf_top_seconds: Metric = UNAVAILABLE
+    for e in events:
+        if e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if not any(k.startswith("perf/") for k in m):
+                continue
+            pf_seen = True
+            for key in ("perf/samples", "perf/sample_seconds"):
+                if m.get(key) is not None:
+                    _accumulate_counter(pf_totals, pf_prev, key,
+                                        float(m[key]))
+        elif e.get("event") == "perf_profile":
+            pf_seen = True
+            pf_rows += 1
+            if isinstance(e.get("device_compute_frac"), (int, float)):
+                pf_compute = round(float(e["device_compute_frac"]), 4)
+            if isinstance(e.get("dispatch_gap_frac"), (int, float)):
+                pf_gap = round(float(e["dispatch_gap_frac"]), 4)
+            if e.get("top_executable") is not None:
+                pf_top = str(e["top_executable"])
+                secs = (e.get("per_executable_seconds") or {}).get(
+                    e["top_executable"])
+                if isinstance(secs, (int, float)):
+                    pf_top_seconds = round(float(secs), 6)
+    perf_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if pf_seen:
+        perf_sec = {
+            "samples": max(int(pf_totals.get("perf/samples", 0)),
+                           pf_rows),
+            "sample_seconds": round(
+                pf_totals.get("perf/sample_seconds", 0.0), 3),
+            "device_compute_frac": pf_compute,
+            "dispatch_gap_frac": pf_gap,
+            "top_executable": pf_top,
+            "top_executable_seconds": pf_top_seconds,
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -640,6 +695,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "warm_start": warm_start_sec,
         "elastic": elastic_sec,
         "fleet": fleet_sec,
+        "perf": perf_sec,
     }
 
 
@@ -676,6 +732,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("warm start", summary["warm_start"]),
         ("elastic", summary["elastic"]),
         ("fleet", summary["fleet"]),
+        ("perf", summary["perf"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

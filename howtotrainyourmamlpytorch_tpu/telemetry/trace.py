@@ -59,6 +59,7 @@ PHASE_TIDS: Dict[str, int] = {
 HEARTBEAT_TID = 8   # per-host heartbeat markers
 MARKER_TID = 9      # instant markers (checkpoints, trips, faults, ...)
 _UNKNOWN_TID = 10   # future phase names degrade here, never crash
+PROFILE_TID = 11    # perf-lab sampled windows (telemetry/profiler.py)
 
 # events.jsonl rows rendered as instant markers on the marker lane.
 _INSTANT_EVENTS = (
@@ -164,6 +165,25 @@ def spans_from_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
                     "ts": _us(ts), "pid": host, "tid": HEARTBEAT_TID,
                     "s": "t", "args": args,
                 })
+        elif (event == "perf_profile"
+                and isinstance(row.get("wall_seconds"), (int, float))
+                and row["wall_seconds"] > 0):
+            # Perf-lab sample windows get their own lane: each span is
+            # one profiled dispatch-sync window, ending at the row's
+            # timestamp (the row is logged as the window closes), with
+            # the attribution fractions riding as args — scrubbing the
+            # timeline shows WHEN the device-time picture was measured.
+            dur = float(row["wall_seconds"])
+            out.append({
+                "name": "perf_sample", "cat": "perf", "ph": "X",
+                "ts": _us(ts - dur), "dur": max(_us(dur), 1),
+                "pid": int(row.get("process_index") or 0),
+                "tid": PROFILE_TID,
+                "args": _args(row, skip=("ts", "event",
+                                         "per_executable_seconds",
+                                         "per_region_seconds",
+                                         "roofline")),
+            })
         elif event in _INSTANT_EVENTS:
             out.append({
                 "name": str(event), "cat": "event", "ph": "i",
@@ -186,7 +206,13 @@ def build_trace(events: Optional[List[Dict[str, Any]]] = None,
         trace_events += spans_from_flight(flight, process_index)
     if events:
         trace_events += spans_from_events(events)
-    trace_events.sort(key=lambda e: (e["ts"], e["pid"], e["tid"]))
+    # Stable sort on (ts, pid) ONLY: each source emits its spans in
+    # chronological order, and two spans recorded within the same
+    # microsecond must keep that order — tie-breaking on tid reordered
+    # same-µs phase transitions (feed→step flips on a fast box, seen
+    # as a tier-1 flake). Per-track monotonicity (what validate_trace
+    # pins) holds under any ts-sorted order.
+    trace_events.sort(key=lambda e: (e["ts"], e["pid"]))
     return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
 
 

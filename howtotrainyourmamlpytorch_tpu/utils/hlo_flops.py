@@ -404,6 +404,25 @@ def xla_flat_flops(compiled) -> float:
         return 0.0
 
 
+def combine_flops_estimates(parsed_exp: float, parsed_flat: float,
+                            xla_flat: float) -> "tuple[float, str]":
+    """THE calibration rule (module docstring §2), shared by
+    :func:`executable_flops` and scripts/perf_ceiling.py so the two
+    tools cannot drift: the trip-expanded parsed count scaled by XLA's
+    flat/parsed ratio when all three ingredients exist; the honest
+    degradations (each named in the returned source) otherwise."""
+    if parsed_exp > 0 and parsed_flat > 0 and xla_flat > 0:
+        return (parsed_exp * (xla_flat / parsed_flat),
+                "hlo_trip_expanded_xla_calibrated")
+    if parsed_exp > 0:
+        return parsed_exp, "hlo_trip_expanded_convdot_only"
+    if xla_flat > 0:
+        # Known under-count when the program contains counted loops —
+        # better than nothing, and the source key says so.
+        return xla_flat, "xla_cost_analysis_flat"
+    return 0.0, "unavailable"
+
+
 def executable_flops(compiled) -> dict:
     """Scan-trip-expanded hardware FLOPs of one execution of `compiled`.
 
@@ -425,20 +444,8 @@ def executable_flops(compiled) -> dict:
         # count re-introduces the ~12x under-count this module exists to
         # fix, so the error rides the result for the artifact to show.
         parse_error = f"{type(e).__name__}: {e}"
-    if parsed_exp > 0 and parsed_flat > 0 and xla_flat > 0:
-        flops = parsed_exp * (xla_flat / parsed_flat)
-        source = "hlo_trip_expanded_xla_calibrated"
-    elif parsed_exp > 0:
-        flops = parsed_exp
-        source = "hlo_trip_expanded_convdot_only"
-    elif xla_flat > 0:
-        # Known under-count when the program contains counted loops —
-        # better than nothing, and the source key says so.
-        flops = xla_flat
-        source = "xla_cost_analysis_flat"
-    else:
-        flops = 0.0
-        source = "unavailable"
+    flops, source = combine_flops_estimates(parsed_exp, parsed_flat,
+                                            xla_flat)
     out = {"flops": flops, "source": source,
            "xla_flat_flops": xla_flat,
            "parsed_flat_flops": parsed_flat,

@@ -1,5 +1,16 @@
 """Component-level timing: where does a train step's time go?
 
+.. deprecated::
+    Superseded by the perf lab (telemetry/profiler.py +
+    ``scripts/perf_report.py``, docs/PERF.md § Where the time goes):
+    sampled profiler windows attribute REAL device time per executable
+    and per named region, and PROFILE.json cost cards carry the one
+    trip-expanded flops algorithm (utils/hlo_flops.py) with roofline
+    verdicts — this script's hand-built component timings remain only
+    as a quick interactive sanity probe. Pass ``--profile-json`` to
+    print the cost-card table from a run's PROFILE.json next to the
+    timings instead of deriving any cost numbers privately.
+
 Times (a) plain model forward, (b) forward+backward wrt fast weights,
 (c) one full inner step chain without outer grad, (d) full train step —
 on the flagship bench shapes. Used to target kernel-level optimization.
@@ -40,6 +51,29 @@ def timeit(fn, *args, n=10):
 
 
 def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile-json", default=None, metavar="PATH",
+                    help="print the cost cards from a run's "
+                         "PROFILE.json (telemetry/profiler.py) before "
+                         "the component timings — the consolidated "
+                         "flops source (scripts/perf_report.py renders "
+                         "the full ranked report)")
+    args = ap.parse_args()
+    if args.profile_json:
+        from howtotrainyourmamlpytorch_tpu.telemetry import (
+            profiler as profiler_mod)
+        doc = profiler_mod.load_profile(args.profile_json)
+        if doc is None:
+            print(json.dumps({"error": f"unreadable PROFILE.json at "
+                                       f"{args.profile_json!r}"}))
+        else:
+            for name, card in sorted(doc["cards"].items()):
+                print(json.dumps({
+                    "cost_card": name, "bound": card.get("bound"),
+                    "gflops": round((card.get("flops") or 0) / 1e9, 3),
+                    "gbytes": round((card.get("bytes_accessed") or 0)
+                                    / 1e9, 3)}), flush=True)
     cfg = flagship_config(16, 1)
     init, apply = make_model(cfg)
     params, bn_state = init(jax.random.PRNGKey(0))

@@ -1,5 +1,18 @@
 """Quantitative speed-of-light bound for the flagship train step.
 
+.. deprecated::
+    The flops half of this model is consolidated into the perf lab:
+    PROFILE.json cost cards (telemetry/profiler.py) carry the ONE
+    trip-expanded flops algorithm (utils/hlo_flops.py — this script's
+    global compute term now reads
+    ``hlo_flops.combine_flops_estimates``, the same combiner behind
+    bench.py's flops/mfu keys and every cost card), and
+    ``scripts/perf_report.py`` renders measured device time against the
+    same cards. Pass ``--profile-json PATH --card NAME`` to take the
+    compute term from a recorded cost card instead of re-deriving it
+    here. The serial/bandwidth chain model (kernel floor, tile-padded
+    traffic) remains unique to this script.
+
 VERDICT r2 weak #1 asked for a *number* behind the "latency-bound chain
 of small ops" ceiling story: sum the serial chain into a "max achievable
 ~= X tasks/s, we are at Y% of it" figure. This script builds that model
@@ -328,6 +341,15 @@ def main() -> int:
                          "recorded rate by hand)")
     ap.add_argument("--dump", default=None, metavar="PATH",
                     help="write the optimized HLO text to PATH")
+    ap.add_argument("--profile-json", default=None, metavar="PATH",
+                    help="take the global compute term from a recorded "
+                         "PROFILE.json cost card (telemetry/profiler.py"
+                         ") instead of re-deriving it from this "
+                         "compile's HLO — with --card naming the "
+                         "executable (default: the steady-state train "
+                         "slot)")
+    ap.add_argument("--card", default=None, metavar="NAME",
+                    help="cost-card name inside --profile-json")
     ap.add_argument("--cal", default=None,
                     metavar="FLOOR_US,BW_GBPS,MM_TFLOPS",
                     help="reuse recorded calibration constants instead "
@@ -396,20 +418,34 @@ def main() -> int:
     # estimator provenance is emitted in the summary JSON so a degraded
     # count can never pass silently.
     from howtotrainyourmamlpytorch_tpu.utils.hlo_flops import (
-        xla_flat_flops)
-    parsed_exp = model.total(expand_trips=True)
-    parsed_flat = model.total(expand_trips=False)
-    xla_flat = xla_flat_flops(compiled)
-    if xla_flat > 0 and parsed_flat > 0 and parsed_exp > 0:
-        xla_flops = parsed_exp * xla_flat / parsed_flat
-        flops_source = "hlo_trip_expanded_xla_calibrated"
-    elif parsed_exp > 0:
-        xla_flops = parsed_exp
-        flops_source = "hlo_trip_expanded_convdot_only"
-    else:
-        xla_flops = xla_flat
-        flops_source = ("xla_cost_analysis_flat" if xla_flat > 0
-                        else "unavailable")
+        combine_flops_estimates, xla_flat_flops)
+    xla_flops = 0.0
+    flops_source = "unavailable"
+    if args.profile_json:
+        # Consolidated path: the recorded cost card IS the compute
+        # term — one flops algorithm (hlo_flops via the card), no
+        # private re-derivation. Falls through to the live computation
+        # when the card is missing (recorded in flops_source).
+        from howtotrainyourmamlpytorch_tpu.telemetry import (
+            profiler as profiler_mod)
+        doc = profiler_mod.load_profile(args.profile_json)
+        bench_key = (cfg.use_second_order(bench_epoch),
+                     cfg.use_msl(bench_epoch))
+        card_name = args.card or (
+            f"train_so{int(bench_key[0])}_msl{int(bench_key[1])}")
+        card = (doc or {"cards": {}})["cards"].get(card_name)
+        if card and card.get("flops"):
+            xla_flops = float(card["flops"])
+            flops_source = f"cost_card:{card_name}"
+        else:
+            print(json.dumps({"warning": f"no cost card {card_name!r} "
+                              f"in {args.profile_json!r}; deriving "
+                              f"from this compile's HLO"}), flush=True)
+    if not xla_flops:
+        xla_flops, flops_source = combine_flops_estimates(
+            model.total(expand_trips=True),
+            model.total(expand_trips=False),
+            xla_flat_flops(compiled))
     if xla_flops:
         model.flop_bound_s = max(model.flop_bound_s,
                                  xla_flops / (cal["matmul_tflops"] * 1e12))

@@ -74,6 +74,8 @@ SHARD_SIZE_OVERRIDES = {
     #                                        slow 3-replica swap proof
     "tests/test_pod_e2e.py": 120_000,      # multi-process chaos runs
     "tests/test_multiprocess_distributed.py": 90_000,
+    "tests/test_perf_profiler.py": 60_000,  # tiny profiled runs + the
+    #                                         perf_report CLI subprocess
 }
 
 

@@ -72,6 +72,19 @@ def test_bench_quick_emits_full_capture_contract():
     assert last["time_to_first_step_warm_s"] > 0
     assert (last["time_to_first_step_warm_s"]
             < last["time_to_first_step_cold_s"])
+    # Perf-lab keys (ISSUE 14): peak_flops_source is known at headline
+    # time ("unknown" on CPU — honest, not a guessed peak); the
+    # profiled-window keys are null at first print and measured on the
+    # enriched/LAST lines (fail-soft non-null: a CPU backend traces).
+    assert first["peak_flops_source"] in ("table", "override", "unknown")
+    assert first["mfu_compute_frac"] is None
+    assert first["dispatch_gap_frac"] is None
+    assert "perf_profile_error" not in last, last
+    assert 0 < last["mfu_compute_frac"] <= 1
+    assert 0 < last["dispatch_gap_frac"] <= 1
+    assert isinstance(last["top_executable"], str)
+    assert last["top_executable_bound"] in ("compute", "memory",
+                                            "unknown")
     # The authoritative LAST line is a strict superset with all three
     # measurement groups.
     for key in ("value", "run_weighted_tasks_per_sec_per_chip",
@@ -81,7 +94,9 @@ def test_bench_quick_emits_full_capture_contract():
         assert key in last, (key, last)
     assert last["strict_b8_tasks_per_sec_per_chip"] > 0
     measured_after_first = {"time_to_first_step_cold_s",
-                            "time_to_first_step_warm_s"}
+                            "time_to_first_step_warm_s",
+                            "mfu_compute_frac", "dispatch_gap_frac",
+                            "top_executable", "top_executable_bound"}
     for key, val in first.items():
         if key in measured_after_first:
             continue

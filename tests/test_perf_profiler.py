@@ -1,0 +1,518 @@
+"""Perf lab contract (telemetry/profiler.py + scripts/perf_report.py).
+
+Pure units (roofline math, cost-card schema round trip, trace
+attribution, region indexing), the structural zero-cost pin
+(``profile_every_n_steps=0`` installs NOTHING), the tier-1 bitwise
+weight/compile-count parity proof (profiler on vs off over one tiny
+store-armed run each — riding the test_health-style tiny fixture, no
+new training geometry), cost cards landing in both the AOT store dir
+and ``logs/PROFILE.json``, and the perf_report.py CLI artifact schema
+through the real entrypoint (over the SAME tiny run — no extra
+training)."""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.telemetry import profiler
+from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# pure units
+
+
+def test_resolve_peaks_table_and_source():
+    pk = profiler.resolve_peaks("TPU v5 lite", env={})
+    assert pk["source"] == "table"
+    assert pk["peak_flops"] == 197e12
+    assert pk["hbm_bytes_per_s"] == 819e9
+    # Bare v5 reads as v5p (the bench.py ordering, preserved).
+    assert profiler.resolve_peaks("TPU v5", env={})["peak_flops"] == 459e12
+
+
+def test_resolve_peaks_override_wins_over_table():
+    pk = profiler.resolve_peaks(
+        "TPU v5 lite", env={profiler.PEAK_FLOPS_ENV: "4.56e14"})
+    assert pk["source"] == "override"
+    assert pk["peak_flops"] == 4.56e14
+    # The table's bandwidth survives a flops-only override.
+    assert pk["hbm_bytes_per_s"] == 819e9
+    pk = profiler.resolve_peaks(
+        "nonsense_chip_a", env={profiler.HBM_GBPS_ENV: "100"})
+    assert pk["source"] == "override"
+    assert pk["hbm_bytes_per_s"] == 100e9
+
+
+def test_resolve_peaks_unknown_warns_once():
+    kind = "never_seen_chip_xyz"
+    with pytest.warns(UserWarning, match="matches no entry"):
+        pk = profiler.resolve_peaks(kind, env={})
+    assert pk == {"peak_flops": 0.0, "hbm_bytes_per_s": 0.0,
+                  "source": "unknown"}
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        profiler.resolve_peaks(kind, env={})
+    assert not caught  # warn-once per kind per process
+
+
+def test_roofline_verdict_boundaries():
+    # ridge = 100e12 / 1e12 = 100 flops/byte.
+    peak, bw = 100e12, 1e12
+    at_ridge = profiler.roofline_verdict(100e9, 1e9, peak, bw)
+    assert at_ridge["bound"] == "compute"  # AI == ridge: MXU-bound
+    assert at_ridge["arithmetic_intensity"] == 100.0
+    assert at_ridge["ceiling_flops_per_s"] == peak
+    below = profiler.roofline_verdict(99e9, 1e9, peak, bw)
+    assert below["bound"] == "memory"
+    assert below["ceiling_flops_per_s"] == pytest.approx(99e12)
+    # Unknown peaks / missing measurements never guess.
+    assert profiler.roofline_verdict(1e9, 1e6, 0.0, bw)["bound"] == \
+        "unknown"
+    assert profiler.roofline_verdict(1e9, 0.0, peak, bw)["bound"] == \
+        "unknown"
+    assert profiler.roofline_verdict(0.0, 1e6, peak, bw)["bound"] == \
+        "unknown"
+
+
+def test_cost_card_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "PROFILE.json")
+    peaks = profiler.resolve_peaks("TPU v4", env={})
+    card = profiler.build_cost_card(
+        "train_so1_msl0",
+        flops_info={"flops": 1e12, "source": "hlo_trip_expanded",
+                    "trip_counts": {"cond": 5}},
+        bytes_accessed=1e9, memory={"peak_bytes": 123},
+        fingerprint="abcd", device_kind="TPU v4", peaks=peaks)
+    assert card["bound"] == "compute"  # AI 1000 >> v4 ridge ~224
+    profiler.merge_profile(path, [card], device_kind="TPU v4",
+                           peaks=peaks, fingerprint="abcd" * 16)
+    doc = profiler.load_profile(path)
+    assert doc["schema"] == profiler.PROFILE_SCHEMA
+    assert doc["peak_flops_source"] == "table"
+    assert doc["cards"]["train_so1_msl0"] == card
+    # Merge semantics: same name updates, other names survive.
+    other = dict(card, name="eval", flops=2.0)
+    updated = dict(card, flops=3.0)
+    profiler.merge_profile(path, [other, updated],
+                           device_kind="TPU v4", peaks=peaks)
+    doc = profiler.load_profile(path)
+    assert set(doc["cards"]) == {"train_so1_msl0", "eval"}
+    assert doc["cards"]["train_so1_msl0"]["flops"] == 3.0
+    # Unreadable / foreign files degrade to None, never raise.
+    assert profiler.load_profile(str(tmp_path / "missing.json")) is None
+    (tmp_path / "foreign.json").write_text('{"schema": "other"}')
+    assert profiler.load_profile(str(tmp_path / "foreign.json")) is None
+
+
+def test_trace_window_attribution():
+    idx = {"dot.3": "inner_support_grad", "add.4": "other"}
+    events = [
+        # two overlapping spans of the train module: union 90us
+        {"ph": "X", "ts": 100.0, "dur": 50.0, "name": "dot.3",
+         "args": {"hlo_module": "jit_step", "hlo_op": "dot.3"}},
+        {"ph": "X", "ts": 140.0, "dur": 50.0, "name": "add.4",
+         "args": {"hlo_module": "jit_step", "hlo_op": "add.4"}},
+        # an unindexed module
+        {"ph": "X", "ts": 200.0, "dur": 10.0, "name": "mul",
+         "args": {"hlo_module": "jit_other", "hlo_op": "mul"}},
+        # host spans without hlo_module are NOT device time
+        {"ph": "X", "ts": 0.0, "dur": 500.0, "name": "PjitFunction(f)"},
+    ]
+    s = profiler.summarize_trace_events(
+        events, wall_seconds=400e-6, region_indexes={"jit_step": idx})
+    assert s["device_compute_seconds"] == pytest.approx(100e-6)
+    # envelope [100, 210] = 110us -> idle 10us; gap = 400 - 110 = 290us
+    assert s["device_idle_seconds"] == pytest.approx(10e-6)
+    assert s["host_gap_seconds"] == pytest.approx(290e-6)
+    assert s["device_compute_frac"] == pytest.approx(0.25)
+    assert s["top_executable"] == "jit_step"
+    assert s["per_executable_seconds"]["jit_step"] == \
+        pytest.approx(100e-6)
+    assert s["per_region_seconds"]["inner_support_grad"] == \
+        pytest.approx(50e-6)
+    assert s["per_region_seconds"][profiler.UNATTRIBUTED] == \
+        pytest.approx(10e-6)
+    # Empty window: everything is host gap, no crash.
+    empty = profiler.summarize_trace_events([], wall_seconds=1e-3)
+    assert empty["device_compute_seconds"] == 0.0
+    assert empty["dispatch_gap_frac"] == pytest.approx(1.0)
+    assert empty["top_executable"] is None
+
+
+def test_trace_window_marker_clips_stale_spans():
+    """Ops of the PREVIOUS step still in flight when the capture began
+    lie outside the WINDOW_MARKER host span and must not attribute into
+    this window (observed live: device_compute > wall without the
+    clip). Straddling spans clip to their in-window part."""
+    events = [
+        {"ph": "X", "ts": 1000.0, "dur": 500.0,
+         "name": profiler.WINDOW_MARKER},
+        # entirely before the window: previous step's tail
+        {"ph": "X", "ts": 0.0, "dur": 900.0, "name": "dot.1",
+         "args": {"hlo_module": "jit_step", "hlo_op": "dot.1"}},
+        # straddles the start: only the inside 100us counts
+        {"ph": "X", "ts": 900.0, "dur": 200.0, "name": "dot.2",
+         "args": {"hlo_module": "jit_step", "hlo_op": "dot.2"}},
+        # fully inside
+        {"ph": "X", "ts": 1200.0, "dur": 100.0, "name": "dot.3",
+         "args": {"hlo_module": "jit_step", "hlo_op": "dot.3"}},
+    ]
+    s = profiler.summarize_trace_events(events, wall_seconds=500e-6)
+    assert s["per_executable_seconds"]["jit_step"] == \
+        pytest.approx(200e-6)
+    assert s["device_compute_seconds"] == pytest.approx(200e-6)
+    assert 0 <= s["device_compute_frac"] <= 1
+
+
+def test_region_index_from_hlo():
+    hlo = (
+        'HloModule jit_train_so1_msl0, is_scheduled=true\n'
+        '  %dot.3 = f32[4]{0} dot(a, b), '
+        'op_name="jit(step)/jit(main)/inner_support_grad/dot_general"\n'
+        '  %f.4 = f32[4]{0} add(a, b), '
+        'op_name="jit(step)/jit(main)/transpose"\n'
+        '  %g.5 = f32[4]{0} add(a, b), '
+        'op_name="jit(step)/task_adapt/inner_lslr_update/mul"\n')
+    module, idx = profiler.region_index_from_hlo(hlo)
+    assert module == "jit_train_so1_msl0"
+    assert idx == {"dot.3": "inner_support_grad",
+                   "f.4": profiler.OTHER_REGION,
+                   "g.5": "inner_lslr_update"}  # innermost label wins
+
+
+def test_match_card_trace_module_to_store_slot():
+    cards = {"train_so1_msl0": {"name": "train_so1_msl0"},
+             "eval": {"name": "eval"}}
+    assert profiler._match_card("jit_train_so1_msl0", cards) \
+        is cards["train_so1_msl0"]
+    assert profiler._match_card("jit_eval_step", cards) is cards["eval"]
+    assert profiler._match_card("jit_unrelated", cards) is None
+
+
+def test_attach_roofline_rates():
+    summary = {"per_executable_seconds": {"jit_train": 0.5}}
+    card = {"name": "train", "flops": 1e9, "bound": "memory",
+            "ceiling_flops_per_s": 4e9}
+    profiler.attach_roofline(summary, {"train": card}, steps=2)
+    entry = summary["roofline"]["jit_train"]
+    assert entry["achieved_flops_per_s"] == pytest.approx(4e9)
+    assert entry["frac_of_ceiling"] == pytest.approx(1.0)
+    assert entry["bound"] == "memory"
+
+
+def test_crash_bundle_carries_profile(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.resilience import flightrec
+    profile = tmp_path / "PROFILE.json"
+    profile.write_text(json.dumps(
+        {"schema": profiler.PROFILE_SCHEMA, "cards": {}}))
+    prev = flightrec.register_profile(str(profile))
+    try:
+        bundle = str(tmp_path / "bundle")
+        flightrec.write_crash_bundle(bundle, reason="test")
+        copied = os.path.join(bundle, flightrec.PROFILE_FILE)
+        assert os.path.exists(copied)
+        assert json.load(open(copied))["schema"] == \
+            profiler.PROFILE_SCHEMA
+    finally:
+        flightrec.register_profile(prev)
+    # Unregistered: bundles simply omit the file (best-effort).
+    bundle2 = str(tmp_path / "bundle2")
+    flightrec.write_crash_bundle(bundle2, reason="test")
+    assert not os.path.exists(os.path.join(bundle2,
+                                           flightrec.PROFILE_FILE))
+
+
+def test_trace_exporter_perf_lane():
+    from howtotrainyourmamlpytorch_tpu.telemetry import trace as trace_mod
+    events = [
+        {"ts": 100.0, "event": "perf_profile", "wall_seconds": 0.25,
+         "device_compute_frac": 0.1, "top_executable": "jit_step"},
+        {"ts": 101.0, "event": "checkpoint", "epoch": 0},
+    ]
+    trace = trace_mod.build_trace(events=events)
+    trace_mod.validate_trace(trace)
+    perf = [e for e in trace["traceEvents"]
+            if e["tid"] == trace_mod.PROFILE_TID]
+    assert len(perf) == 1
+    span = perf[0]
+    assert span["ph"] == "X" and span["name"] == "perf_sample"
+    assert span["dur"] == 250_000  # 0.25 s in us
+    assert span["args"]["top_executable"] == "jit_step"
+
+
+def test_failed_start_window_consumes_cadence(monkeypatch):
+    """A backend that cannot trace must fail once per cadence period,
+    not once per train step: the ATTEMPT records the iteration, so
+    due() goes quiet for the next N iterations."""
+    import jax
+
+    sampler = profiler.PerfSampler(every_n=5)
+
+    def boom(*a, **k):
+        raise RuntimeError("cannot trace")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    assert sampler.due(3)
+    with pytest.warns(UserWarning, match="sample failed"):
+        assert sampler.start_window(3) is False
+    assert not sampler.due(7)   # slot consumed by the failed attempt
+    assert sampler.due(8)
+
+
+def test_abort_window_releases_the_profiler():
+    """An exception between start and end (dispatch error, Ctrl-C)
+    aborts the capture: the process-wide trace is stopped, so the NEXT
+    sample's start_trace succeeds instead of failing 'already
+    started'."""
+    import jax.numpy as jnp
+
+    sampler = profiler.PerfSampler(every_n=1)
+    assert sampler.start_window(0)
+    sampler.abort_window()
+    assert sampler._window is None
+    # A fresh capture works — the aborted one released the profiler.
+    assert sampler.start_window(1)
+    row = sampler.end_window(jnp.zeros(()), iteration=1)
+    assert row is not None and row["wall_seconds"] >= 0
+    # Aborting with no live window is a no-op.
+    sampler.abort_window()
+
+
+def _load_perf_report_module():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_perf_report_under_test",
+        os.path.join(REPO, "scripts", "perf_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_perf_report_ranked_carries_achieved_vs_ceiling():
+    """The ranked table's key MFU-campaign signal: achieved FLOP/s vs
+    the roofline ceiling, taken from the newest sample's live
+    computation."""
+    pr = _load_perf_report_module()
+    profile = {"schema": profiler.PROFILE_SCHEMA,
+               "cards": {"train_so1_msl0": {
+                   "name": "train_so1_msl0", "flops": 1e9,
+                   "bound": "memory", "ceiling_flops_per_s": 4e9,
+                   "arithmetic_intensity": 4.0}}}
+    events = [{"event": "perf_profile", "wall_seconds": 1.0,
+               "device_compute_frac": 0.5, "dispatch_gap_frac": 0.4,
+               "top_executable": "jit_train_so1_msl0",
+               "per_executable_seconds": {"jit_train_so1_msl0": 0.5},
+               "roofline": {"jit_train_so1_msl0": {
+                   "achieved_flops_per_s": 2e9, "bound": "memory",
+                   "ceiling_flops_per_s": 4e9,
+                   "frac_of_ceiling": 0.5}}}]
+    report = pr.build_report(profile, pr.accumulate_rows(events))
+    top = report["ranked"][0]
+    assert top["achieved_flops_per_s"] == pytest.approx(2e9)
+    assert top["frac_of_ceiling"] == pytest.approx(0.5)
+    assert top["bound"] == "memory"
+    assert "%ceil" in pr.format_report(report)
+
+
+# ---------------------------------------------------------------------------
+# tiny runs: structural pin, bitwise parity, cost cards, CLI
+
+
+def _tiny_cfg(root, name, **kw):
+    base = dict(
+        experiment_name=name, experiment_root=str(root),
+        dataset_name="synthetic_perf",
+        image_height=8, image_width=8, image_channels=1,
+        num_classes_per_set=2, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2,
+        cnn_num_filters=4, num_stages=1,
+        number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2,
+        total_epochs=1, total_iter_per_epoch=3,
+        num_evaluation_tasks=2, max_models_to_save=1,
+        second_order=False, use_multi_step_loss_optimization=False,
+        compute_dtype="float32", dispatch_sync_every=1,
+        live_progress=False)
+    base.update(kw)
+    return MAMLConfig(**base)
+
+
+def _run(cfg):
+    from howtotrainyourmamlpytorch_tpu.experiment import ExperimentBuilder
+    builder = ExperimentBuilder(cfg)
+    builder.run_experiment()
+    return builder
+
+
+@pytest.fixture(scope="module")
+def _process_warm(tmp_path_factory):
+    """One throwaway tiny run so the PROCESS-scoped jit caches (the
+    convert_element_type-sized utility programs a first run compiles)
+    are warm before either parity leg — compile-count parity must
+    compare the runs' OWN executables, not who ran first in the
+    pytest process."""
+    root = tmp_path_factory.mktemp("perf_warm")
+    _run(_tiny_cfg(root, "perf_warm"))
+
+
+@pytest.fixture(scope="module")
+def profiled_run(tmp_path_factory, _process_warm):
+    """ONE profiled tiny store-armed run shared by the parity, cost-card,
+    report-section and CLI tests below (the tier-1 budget rule: the
+    satellite checks ride this fixture instead of each paying its own
+    training run). Peak overrides supply MEASURED-style device peaks
+    (the CPU kind has no table entry) so the cost cards carry a real
+    compute/memory verdict — the acceptance criterion — not
+    "unknown"."""
+    root = tmp_path_factory.mktemp("perf_on")
+    cfg = _tiny_cfg(root, "perf_on", profile_every_n_steps=1,
+                    aot_store_dir=str(root / "aot"))
+    overrides = {profiler.PEAK_FLOPS_ENV: "1e11",
+                 profiler.HBM_GBPS_ENV: "10"}
+    saved = {k: os.environ.get(k) for k in overrides}
+    os.environ.update(overrides)
+    try:
+        return _run(cfg)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_profile_off_installs_nothing_and_parity(tmp_path_factory,
+                                                 profiled_run):
+    """THE acceptance pin: with the knob at 0 nothing is installed (no
+    sampler, no perf rows, no perf/* metrics) AND the run is bitwise
+    identical — final weights and cache-warm compile counts — to the
+    profiled run (same config modulo the knob and runtime-only
+    paths)."""
+    root = tmp_path_factory.mktemp("perf_off")
+    cfg = _tiny_cfg(root, "perf_off", aot_store_dir=str(root / "aot"))
+    off = _run(cfg)
+    assert off._perf is None  # structural pin
+    events = read_jsonl(os.path.join(off.paths["logs"], "events.jsonl"))
+    assert not [e for e in events if e.get("event") == "perf_profile"]
+    assert not any(k.startswith("perf/")
+                   for k in off.registry.snapshot())
+    on = profiled_run
+    # Bitwise weight parity: the profiler is pure host-side observation.
+    leaves_off = jax.tree.leaves(jax.device_get(off.state.params))
+    leaves_on = jax.tree.leaves(jax.device_get(on.state.params))
+    assert len(leaves_off) == len(leaves_on)
+    for a, b in zip(leaves_off, leaves_on):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Compile-count parity: capture adds zero compiles.
+    assert (off.registry.counter("compile/count").value
+            == on.registry.counter("compile/count").value)
+
+
+def test_profiled_run_rows_gauges_and_report_section(profiled_run):
+    b = profiled_run
+    events = read_jsonl(os.path.join(b.paths["logs"], "events.jsonl"))
+    rows = [e for e in events if e.get("event") == "perf_profile"]
+    assert rows  # sampled on the knob's cadence
+    for row in rows:
+        assert 0 < row["wall_seconds"]
+        assert 0 <= row["device_compute_frac"] <= 1
+        assert 0 <= row["dispatch_gap_frac"] <= 1
+        assert row["per_executable_seconds"]
+        assert isinstance(row["top_executable"], str)
+        # named_scope regions attribute real device time
+        assert row["per_region_seconds"]
+    assert b.registry.counter(profiler.SAMPLES_COUNTER).value == \
+        len(rows)
+    assert b.registry.gauge(profiler.COMPUTE_FRAC_GAUGE).value > 0
+    from howtotrainyourmamlpytorch_tpu.telemetry import summarize_events
+    s = summarize_events(events)
+    assert s["perf"]["samples"] == len(rows)
+    assert isinstance(s["perf"]["top_executable"], str)
+    assert 0 <= s["perf"]["device_compute_frac"] <= 1
+
+
+def test_cost_cards_in_store_and_logs(profiled_run):
+    """The AOT store doubles as the cost database: compiling-and-
+    populating records one roofline card per executable in the
+    fingerprint dir's PROFILE.json, and the run merges them into
+    logs/PROFILE.json."""
+    b = profiled_run
+    store_doc = profiler.load_profile(b._aot_store.profile_path())
+    assert store_doc is not None
+    assert {"train_so0_msl0", "eval"} <= set(store_doc["cards"])
+    logs_doc = profiler.load_profile(
+        os.path.join(b.paths["logs"], profiler.PROFILE_FILE))
+    assert logs_doc is not None
+    assert {"train_so0_msl0", "eval"} <= set(logs_doc["cards"])
+    card = logs_doc["cards"]["train_so0_msl0"]
+    assert card["flops"] > 0
+    assert card["bytes_accessed"] > 0
+    assert card["fingerprint"] == b._aot_store.fingerprint[:16]
+    # The fixture's measured-peak overrides give a REAL roofline
+    # verdict (the acceptance criterion), recorded as such.
+    assert card["bound"] in ("compute", "memory")
+    assert card["arithmetic_intensity"] > 0
+    assert card["ceiling_flops_per_s"] > 0
+    assert logs_doc["peak_flops_source"] == "override"
+
+
+def test_perf_report_cli_artifact_schema(profiled_run):
+    """The real entrypoint over the real run: jax-free, human table +
+    last-JSON-line artifact."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_report.py"),
+         profiled_run.paths["logs"]],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    artifact = json.loads(lines[-1])
+    assert artifact["metric"] == "perf_report"
+    assert artifact["ok"] is True
+    assert artifact["cards"] >= 2
+    assert artifact["samples"] >= 1
+    assert isinstance(artifact["top_executable"], str)
+    # The fixture's peak overrides give real verdicts, and the train
+    # step dominates the tiny window's device time by orders of
+    # magnitude — the report names it WITH its roofline verdict (the
+    # acceptance criterion).
+    assert "train" in artifact["top_executable"]
+    assert artifact["top_executable_bound"] in ("compute", "memory")
+    assert 0 <= artifact["device_compute_frac"] <= 1
+    assert 0 <= artifact["dispatch_gap_frac"] <= 1
+    # Human half renders the ranked table before the artifact.
+    assert "perf report" in r.stdout
+
+
+def test_perf_report_cli_errors_are_json(tmp_path):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_report.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert r.returncode == 1
+    err = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "error" in err
+
+
+def test_perf_report_cli_explicit_events_typo_errors(tmp_path):
+    """An EXPLICIT --events path that doesn't exist exits 1 — samples=0
+    must mean 'never sampled', not 'typo'd the path'."""
+    profile = tmp_path / "PROFILE.json"
+    profile.write_text(json.dumps(
+        {"schema": profiler.PROFILE_SCHEMA, "cards": {}}))
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "perf_report.py"),
+         str(profile), "--events", str(tmp_path / "nope.jsonl")],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert r.returncode == 1
+    err = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "does not exist" in err["error"]

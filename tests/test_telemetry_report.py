@@ -23,14 +23,15 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # v3 the resilience section, v4 the data-plane section, v5 the
 # watchdog section, v6 the optimization-health section, v7 the
 # checkpoint-lifecycle section, v8 the pod-fault-domain cluster
-# section, v9 the AOT warm-start section, v10 the elastic-pod section).
+# section, v9 the AOT warm-start section, v10 the elastic-pod section,
+# v11 the serving-fleet section, v12 the perf-lab section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
     "watchdog", "health", "checkpoint", "cluster", "warm_start",
-    "elastic", "fleet",
+    "elastic", "fleet", "perf",
 }
 
 
@@ -510,6 +511,44 @@ def test_summarize_events_elastic_section():
 def test_elastic_section_unavailable_without_subsystem():
     s = summarize_events([{"event": "train_epoch", "epoch": 0}])
     assert s["elastic"] == UNAVAILABLE
+
+
+def test_summarize_events_perf_section():
+    """v12: perf/samples accumulates reset-aware across process
+    segments (a preempted profiled run restarts at 0), cross-checked
+    against the explicit perf_profile rows; the window-split fractions
+    and top executable take the most recent row — the current shape of
+    the step, which is what the MFU campaign reads."""
+    events = [
+        {"event": "perf_profile", "iter": 2, "wall_seconds": 0.5,
+         "device_compute_frac": 0.10, "dispatch_gap_frac": 0.85,
+         "top_executable": "jit_train_so1_msl0",
+         "per_executable_seconds": {"jit_train_so1_msl0": 0.05}},
+        {"event": "metrics",
+         "metrics": {"perf/samples": 1.0, "perf/sample_seconds": 0.5}},
+        # Restart: counters reset, a new sample shows the step after an
+        # optimization landed.
+        {"event": "perf_profile", "iter": 12, "wall_seconds": 0.25,
+         "device_compute_frac": 0.40, "dispatch_gap_frac": 0.55,
+         "top_executable": "jit_train_so1_msl0",
+         "per_executable_seconds": {"jit_train_so1_msl0": 0.10}},
+        {"event": "metrics",
+         "metrics": {"perf/samples": 1.0, "perf/sample_seconds": 0.25}},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    pf = s["perf"]
+    assert pf["samples"] == 2               # both segments counted
+    assert pf["device_compute_frac"] == pytest.approx(0.40)
+    assert pf["dispatch_gap_frac"] == pytest.approx(0.55)
+    assert pf["top_executable"] == "jit_train_so1_msl0"
+    assert pf["top_executable_seconds"] == pytest.approx(0.10)
+    assert "perf" in format_table(s)
+
+
+def test_perf_section_unavailable_without_subsystem():
+    s = summarize_events([{"event": "train_epoch", "epoch": 0}])
+    assert s["perf"] == UNAVAILABLE
 
 
 def test_summarize_events_fleet_section():
