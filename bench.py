@@ -223,6 +223,24 @@ def load_workload(config_path: str, batch_override: int,
         task_microbatches=cfg.effective_task_microbatches(n_dev))
 
 
+def quick_shrink(cfg: MAMLConfig, n_dev: int) -> MAMLConfig:
+    """Tiny shapes for CI/CPU sanity — applied identically to the
+    headline and (in quick mode) the strict-b8 leg, so --quick
+    smoke-executes EVERY code path a real capture runs. Module-level
+    and SHARED with scripts/tune_parity.py: the autotune parity gate
+    must probe numerics at the same geometry ``bench --quick`` trials
+    measured at, so the shapes live in exactly one place."""
+    quick_batch = max(2 * n_dev, 2)
+    cfg = cfg.replace(
+        image_height=16, image_width=16,
+        cnn_num_filters=8, num_stages=2,
+        batch_size=quick_batch)
+    # Same clamp as load_workload: the shipped configs'
+    # task_microbatches need not divide the shrunken quick batch.
+    return cfg.replace(
+        task_microbatches=cfg.effective_task_microbatches(n_dev))
+
+
 class Workload(NamedTuple):
     """A config built + AOT-compiled at its steady-state epoch — THE
     single build path behind the headline, run-weighted and strict-b8
@@ -246,28 +264,78 @@ class Workload(NamedTuple):
 COMPILER_OPTIONS: dict = {}
 
 
-def parse_compiler_options(pairs) -> dict:
-    """Validate ``--compiler-option KEY=VAL`` pairs into a dict; raises
-    ValueError on malformed or repeated keys. Parses into a LOCAL dict
-    (ADVICE r5): the duplicate check must test THIS invocation's
-    options only — checking against the module-global COMPILER_OPTIONS
-    (which main() populates and never clears) falsely rejected options
-    on a second main() call in the same process."""
-    opts: dict = {}
-    for kv in pairs:
-        key, sep, val = kv.partition("=")
-        if not sep or not key or not val:
-            # Empty VAL rejected too (ADVICE r4): an empty string
-            # forwarded through PJRT compiler_options surfaces as a
-            # confusing server-side compile error far from the CLI.
-            raise ValueError(
-                f"--compiler-option needs KEY=VAL, got {kv!r}")
-        if key in opts:
-            raise ValueError(
-                f"--compiler-option {key!r} given twice; repeated keys "
-                f"would silently overwrite")
-        opts[key] = val
-    return opts
+# KEY=VAL validation moved to its canonical home in tune/space.py (the
+# jax-free autotune driver and MAMLConfig validation share it);
+# re-exported here because the perf scripts and the unit tests import
+# it from bench. Same rules, same error text.
+from howtotrainyourmamlpytorch_tpu.tune.space import (  # noqa: E402
+    parse_compiler_options)
+
+
+def resolve_compiler_options(cli_opts: dict, tuned_path,
+                             cfg: MAMLConfig) -> "tuple[dict, dict, str]":
+    """The effective (options, config_overrides, source) this capture
+    runs — precedence: explicit ``--compiler-option`` CLI pairs
+    ("cli"), an adopted autotune record via ``--tuned`` ("tuned" —
+    the only source with a non-empty overrides channel: a winner is a
+    POINT in the joint space), the benched config's own
+    ``xla_compiler_options`` key ("config"), else compiler defaults
+    ("none"). The TUNED.json is read exactly ONCE — both channels from
+    one snapshot, so a concurrent atomic rewrite of the record can
+    never yield a mixed point. CLI + --tuned together is a hard error,
+    not a merge: a capture whose artifact says "tuned" must be running
+    EXACTLY the adopted set. Raises ValueError on the conflict or an
+    unreadable/rejected TUNED.json (record.read_tuned refuses
+    adopted=false records)."""
+    if cli_opts and tuned_path:
+        raise ValueError(
+            "--compiler-option and --tuned are mutually exclusive: the "
+            "artifact must attribute the flag set to one source")
+    if cli_opts:
+        return dict(cli_opts), {}, "cli"
+    if tuned_path:
+        opts, overrides = read_tuned_record(tuned_path)
+        return opts, overrides, "tuned"
+    if cfg.xla_compiler_options:
+        return dict(cfg.xla_compiler_options_dict), {}, "config"
+    return {}, {}, "none"
+
+
+def read_tuned_record(tuned_path: str) -> "tuple[dict, dict]":
+    """(xla_compiler_options, config_overrides) of an ADOPTED autotune
+    record. A winner is a POINT in the joint space — flag set AND
+    structural overrides — so a capture labeled "tuned" must apply
+    both; returning only the flags would bench the untuned structural
+    config under a "tuned" label (r13 review catch). Raises ValueError
+    on a rejected/malformed record (record.read_tuned refuses
+    adopted=false)."""
+    from howtotrainyourmamlpytorch_tpu.tune.record import read_tuned
+    doc = read_tuned(tuned_path)
+    opts = doc.get("xla_compiler_options") or {}
+    overrides = doc.get("config_overrides") or {}
+    if not isinstance(opts, dict) or not isinstance(overrides, dict):
+        raise ValueError(
+            f"--tuned {tuned_path!r}: xla_compiler_options / "
+            f"config_overrides are not mappings")
+    return {str(k): str(v) for k, v in opts.items()}, dict(overrides)
+
+
+def apply_tuned_overrides(cfg: MAMLConfig, overrides: dict,
+                          n_dev: int) -> MAMLConfig:
+    """The adopted structural overrides applied to a benched workload,
+    with ``task_microbatches`` re-clamped at THIS box's geometry (the
+    load_workload/quick-shrink batch may differ from the sweep's) so
+    the executed config matches what is recorded. Unknown keys raise
+    (MAMLConfig.replace is a dataclass replace — a typo'd override
+    must not vanish)."""
+    if not overrides:
+        return cfg
+    try:
+        cfg = cfg.replace(**overrides)
+    except TypeError as e:
+        raise ValueError(f"--tuned config_overrides: {e}") from None
+    return cfg.replace(
+        task_microbatches=cfg.effective_task_microbatches(n_dev))
 
 
 def build_steady_state(cfg: MAMLConfig, devices,
@@ -326,6 +394,12 @@ def main() -> int:
                          "e.g. xla_tpu_scoped_vmem_limit_kib=65536). "
                          "Client-side XLA_FLAGS do NOT reach the "
                          "tunneled server compiler — this does.")
+    ap.add_argument("--tuned", default=None, metavar="TUNED.json",
+                    help="apply an ADOPTED autotune flag set "
+                         "(scripts/autotune.py winner record; refuses "
+                         "adopted=false records). Mutually exclusive "
+                         "with --compiler-option; the artifact's "
+                         "compiler_options_source says which applied.")
     ap.add_argument("--no-warm-start", action="store_true",
                     help="skip the AOT warm-start leg (the "
                          "time_to_first_step_cold_s/_warm_s keys); it "
@@ -338,11 +412,18 @@ def main() -> int:
     args = ap.parse_args()
     try:
         parsed_options = parse_compiler_options(args.compiler_option)
-    except ValueError as e:
+        # Fast-fail resolution of the cli/tuned sources BEFORE backend
+        # init (a malformed option or rejected TUNED.json must not
+        # cost a backend bring-up); the "config" source can only
+        # resolve after the workload config loads, below.
+        (effective_options, tuned_overrides,
+         options_source) = resolve_compiler_options(
+            parsed_options, args.tuned, MAMLConfig())
+    except (ValueError, OSError) as e:
         print(json.dumps({"error": str(e)}))
         return 1
     COMPILER_OPTIONS.clear()
-    COMPILER_OPTIONS.update(parsed_options)
+    COMPILER_OPTIONS.update(effective_options)
 
     devices = init_backend(args.backend_timeout)
     # Compile telemetry (docs/PERF.md § Observability): every AOT
@@ -357,24 +438,31 @@ def main() -> int:
     config_path = args.config or os.path.join(
         repo, "experiment_config",
         "mini-imagenet_maml++_5-way_5-shot_DA_b12.json")
-    def quick_shrink(c: MAMLConfig) -> MAMLConfig:
-        """Tiny shapes for CI/CPU sanity — applied identically to the
-        headline and (in quick mode) the strict-b8 leg, so --quick
-        smoke-executes EVERY code path a real capture runs."""
-        quick_batch = max(2 * n_dev, 2)
-        c = c.replace(
-            image_height=16, image_width=16,
-            cnn_num_filters=8, num_stages=2,
-            batch_size=quick_batch)
-        # Same clamp as load_workload: the shipped configs'
-        # task_microbatches need not divide the shrunken quick batch.
-        return c.replace(
-            task_microbatches=c.effective_task_microbatches(n_dev))
-
     cfg = load_workload(config_path, args.batch, n_dev)
     if args.quick:
-        cfg = quick_shrink(cfg)
+        cfg = quick_shrink(cfg, n_dev)
         args.steps = min(args.steps, 3)
+    if options_source == "none":
+        # Re-resolve now that the workload config is loaded — the
+        # "config" source (a JSON carrying its own adopted flag set)
+        # can only be known here, and the precedence rules must have
+        # exactly ONE home (cli/tuned already resolved + fast-failed
+        # above, so this can only return "config" or "none").
+        effective_options, _, options_source = resolve_compiler_options(
+            {}, None, cfg)
+        COMPILER_OPTIONS.update(effective_options)
+    if options_source == "tuned":
+        # The adopted point is flags AND structural overrides; apply
+        # both so the "tuned" label means the capture ran the winner.
+        try:
+            cfg = apply_tuned_overrides(cfg, tuned_overrides, n_dev)
+        except ValueError as e:
+            print(json.dumps({"error": str(e)}))
+            return 1
+    # Single-channel discipline: this tool forwards the effective
+    # options explicitly at every timed_compile; strip the config copy
+    # so the jit level doesn't carry a second (identical) set.
+    cfg = cfg.replace(xla_compiler_options=())
 
     # Dataset open probe (datastore/ subsystem, docs/DATA.md): resolve
     # the TRAIN split's image source exactly as the training loader
@@ -467,6 +555,14 @@ def main() -> int:
         "compile_seconds": round(
             registry.counter(COMPILE_SECONDS).value, 3),
         "compile_count": int(registry.counter(COMPILE_COUNT).value),
+        # Flag-set attribution (autotune subsystem, docs/PERF.md §
+        # Autotune): the PJRT compiler options every compile in this
+        # capture ran with, and where they came from — "cli"
+        # (--compiler-option), "tuned" (--tuned TUNED.json), "config"
+        # (the workload JSON's xla_compiler_options key) or "none".
+        # A BENCH_* row is now attributable to its exact flag set.
+        "compiler_options": effective_options,
+        "compiler_options_source": options_source,
         "feed_stall_frac": 0.0,
         # Serving keys (serve/ subsystem): part of the artifact schema
         # so one consumer reads train and serve captures uniformly, but
@@ -779,7 +875,7 @@ def main() -> int:
                              "mini-imagenet_maml++_5-way_5-shot_DA.json"),
                 0, n_dev)
             if args.quick:
-                b8_cfg = quick_shrink(b8_cfg)
+                b8_cfg = quick_shrink(b8_cfg, n_dev)
             wl8 = build_steady_state(b8_cfg, devices, registry)
             b8 = measure_rate(wl8.compiled, wl8.state, wl8.batch_ep,
                               wl8.epoch, batch_size=b8_cfg.batch_size,

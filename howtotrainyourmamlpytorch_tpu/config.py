@@ -234,6 +234,26 @@ class MAMLConfig:
     # Python tracing/lowering too, and loads are integrity-checked with
     # counted fail-soft JIT fallback. None = off.
     aot_store_dir: Optional[str] = None
+    # XLA compiler options ("KEY=VAL", ...) forwarded via PJRT
+    # compiler_options to every sharded-step compile (parallel/mesh.py
+    # and serve/adapt.py pass them at the jit level, so the lazy-jit,
+    # AOT-adoption, serve-warmup and prewarm compile paths all carry
+    # them — bench.py's --compiler-option rationale: client-side
+    # XLA_FLAGS never reach the tunneled server compiler, PJRT options
+    # do). STRUCTURAL for the AOT store fingerprint (deliberately NOT
+    # in parallel/aot.py § _RUNTIME_ONLY_KEYS): the options change the
+    # compiled program, so tuned and untuned executables live in
+    # distinct fingerprint dirs and can never be served for each other.
+    # Typically written by the autotune winner record
+    # (scripts/autotune.py → TUNED.json, docs/PERF.md § Autotune);
+    # accepted as a JSON dict, a list of "KEY=VAL" strings, or one
+    # comma-separated string (the CLI override form:
+    # --xla_compiler_options k1=v1,k2=v2). The comma spelling cannot
+    # express an option whose VALUE itself contains commas (e.g.
+    # xla_disable_hlo_passes=p1,p2) — use the JSON dict/list spelling
+    # for those (the CLI coercion also accepts JSON:
+    # --xla_compiler_options '["xla_disable_hlo_passes=p1,p2"]').
+    xla_compiler_options: Tuple[str, ...] = ()
     # TensorBoard scalar logging (beyond-reference observability; the
     # reference logs CSVs only, which we also keep). Events are written
     # under <experiment>/logs/tensorboard/ when enabled.
@@ -721,6 +741,17 @@ class MAMLConfig:
             from howtotrainyourmamlpytorch_tpu.resilience.faults import (
                 FaultPlan)
             FaultPlan.parse(self.fault_spec)
+        if self.xla_compiler_options:
+            # Same KEY=VAL rules as bench.py's --compiler-option (one
+            # validator: tune/space.py, stdlib-only — lazy import keeps
+            # the config module's import graph flat). Option SEMANTICS
+            # are deliberately not checked here: only the backend knows
+            # its flag table, and an unknown option hard-fails the
+            # first compile loudly (the autotune harness counts exactly
+            # that as an invalid_flag trial).
+            from howtotrainyourmamlpytorch_tpu.tune.space import (
+                parse_compiler_options)
+            parse_compiler_options(self.xla_compiler_options)
 
     # ---- derived values -------------------------------------------------
     @property
@@ -872,6 +903,21 @@ class MAMLConfig:
         return math.gcd(self.task_microbatches, local)
 
     @property
+    def xla_compiler_options_dict(self) -> Dict[str, str]:
+        """The resolved PJRT ``compiler_options`` mapping every compile
+        consumer (parallel/mesh.py, serve/adapt.py, bench.py) reads —
+        one resolution point so the executed options can never drift
+        from the recorded tuple. ``{}`` when unset."""
+        out: Dict[str, str] = {}
+        # `or ()`: from_dict normalizes a JSON null to (), but a
+        # directly-constructed config can still carry None — every
+        # consumer (incl. the prewarm artifact) reads through here.
+        for kv in (self.xla_compiler_options or ()):
+            key, _, val = str(kv).partition("=")
+            out[key] = val
+        return out
+
+    @property
     def effective_serve_adapt_steps(self) -> int:
         """Inner steps per served request: the explicit override, else the
         evaluation step count (serving IS evaluation-style adaptation —
@@ -965,6 +1011,31 @@ class MAMLConfig:
         if isinstance(kwargs.get("serve_buckets"), list):
             kwargs["serve_buckets"] = tuple(
                 tuple(b) for b in kwargs["serve_buckets"])
+        # xla_compiler_options: JSON dicts ({"k": "v"}), lists of
+        # "KEY=VAL" and one comma-separated CLI string all normalize to
+        # the canonical sorted tuple — the SAME option set must always
+        # hash to the SAME AOT store fingerprint however it was spelled.
+        xo = kwargs.get("xla_compiler_options")
+
+        def _by_key(pairs):
+            # Sort by option NAME, not the raw "KEY=VAL" string — the
+            # string sort order depends on where '=' falls against the
+            # value's first character, so dict and list spellings of
+            # one option set would canonicalize (and FINGERPRINT)
+            # differently (r13 review catch).
+            return tuple(sorted(pairs,
+                                key=lambda s: s.partition("=")[0]))
+        if xo is None and "xla_compiler_options" in kwargs:
+            kwargs["xla_compiler_options"] = ()  # JSON null == unset
+        elif isinstance(xo, dict):
+            kwargs["xla_compiler_options"] = _by_key(
+                f"{k}={v}" for k, v in xo.items())
+        elif isinstance(xo, str):
+            kwargs["xla_compiler_options"] = _by_key(
+                s.strip() for s in xo.split(",") if s.strip())
+        elif isinstance(xo, (list, tuple)):
+            kwargs["xla_compiler_options"] = _by_key(
+                str(s) for s in xo)
         kwargs["ignored_keys"] = tuple(sorted(ignored))
         return cls(**kwargs)
 

@@ -118,6 +118,11 @@ EXEC_FALLBACKS = "aot/exec_fallbacks"
 # recompile on the next tweak; wrongly EXCLUDING a structural one (a
 # learning rate is baked into the program as constants) would silently
 # run the WRONG executable — so when in doubt a field stays in the hash.
+# ``xla_compiler_options`` is deliberately ABSENT here (i.e. structural):
+# PJRT options change the emitted program, so a tuned flag set keys its
+# own fingerprint dir — adopted autotune winners and untuned runs can
+# share one store root without ever serving each other's executables
+# (docs/PERF.md § Autotune; pinned by tests/test_tune.py).
 _RUNTIME_ONLY_KEYS = frozenset({
     "experiment_name", "experiment_root", "dataset_path",
     "dataset_pack_path", "dataset_name", "download_datasets",

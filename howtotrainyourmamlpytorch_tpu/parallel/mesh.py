@@ -228,6 +228,16 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
     bsh = batch_sharding(mesh)
     axes = tuple(mesh.axis_names)
     batch_spec = P(axes)   # leading (task) axis split over both mesh axes
+    # XLA compiler options (cfg.xla_compiler_options, the autotune
+    # adoption channel) attach at the JIT level: jax preserves them
+    # through explicit .lower().compile() (verified on the pinned
+    # jax), so the lazy-jit dispatch path, the AOT-store adoption
+    # compiles (parallel/aot.py § load_or_compile), the serve warmup
+    # and the prewarm CLI all compile THE tuned program from this one
+    # wiring point. Passed only when non-empty so an untuned config's
+    # jit calls are byte-identical to the pre-autotune build.
+    jit_opts = ({"compiler_options": cfg.xla_compiler_options_dict}
+                if cfg.xla_compiler_options else {})
 
     train_step = make_train_step(cfg, apply_fn, reduce_axes=axes)
     train_steps = {}
@@ -248,6 +258,7 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
                 in_shardings=(repl, bsh, None),
                 out_shardings=(repl, repl),
                 donate_argnums=(0,),
+                **jit_opts,
             )
             # Undonated twin for the AOT store (MeshPlan docstring):
             # same computation, no aliasing — safe to
@@ -256,6 +267,7 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
                 smapped,
                 in_shardings=(repl, bsh, None),
                 out_shardings=(repl, repl),
+                **jit_opts,
             )
     if cfg.aot_store_dir:
         # One numerics world when the store is armed: donation changes
@@ -285,6 +297,7 @@ def make_sharded_steps(cfg: MAMLConfig, apply_fn,
         # scalars + logits) makes every host able to device_get the full
         # result — required for multi-host, harmless single-host.
         out_shardings=repl,
+        **jit_opts,
     )
     return MeshPlan(mesh=mesh, train_steps=train_steps,
                     eval_step=eval_step, aot_train_steps=aot_train_steps)

@@ -136,6 +136,12 @@ def make_serve_steps(cfg: MAMLConfig, apply_fn, mesh) -> ServeSteps:
     # make_sharded_steps — and serialized donating executables are
     # unsafe on this jaxlib anyway).
     f32_wire = not cfg.transfer_images_uint8 and not cfg.aot_store_dir
+    # Tuned XLA options ride the jit (the parallel/mesh.py §
+    # make_sharded_steps wiring): the serve engine's warmup compiles,
+    # its AOT-store adoption and the prewarm CLI all inherit them —
+    # ServeSteps serves the SAME tuned program training adopted.
+    jit_opts = ({"compiler_options": cfg.xla_compiler_options_dict}
+                if cfg.xla_compiler_options else {})
 
     def adapt_shard(params, lslr, bn_state, sx, sy, sw):
         def one(sx1, sy1, sw1):
@@ -155,11 +161,13 @@ def make_serve_steps(cfg: MAMLConfig, apply_fn, mesh) -> ServeSteps:
         in_shardings=(repl, repl, repl, bsh, bsh, bsh),
         out_shardings=repl,
         donate_argnums=(3, 5) if f32_wire else (),
+        **jit_opts,
     )
     aot_adapt = jax.jit(
         adapt_smapped,
         in_shardings=(repl, repl, repl, bsh, bsh, bsh),
         out_shardings=repl,
+        **jit_opts,
     )
 
     def predict_shard(params, fast_stack, bn_stack, qx):
@@ -186,11 +194,13 @@ def make_serve_steps(cfg: MAMLConfig, apply_fn, mesh) -> ServeSteps:
         in_shardings=(repl, bsh, bsh, bsh),
         out_shardings=repl,
         donate_argnums=(3,) if f32_wire else (),
+        **jit_opts,
     )
     aot_predict = jax.jit(
         predict_smapped,
         in_shardings=(repl, bsh, bsh, bsh),
         out_shardings=repl,
+        **jit_opts,
     )
     return ServeSteps(adapt=adapt, predict=predict, mesh=mesh,
                       aot_adapt=aot_adapt, aot_predict=aot_predict)

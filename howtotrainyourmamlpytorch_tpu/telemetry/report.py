@@ -33,8 +33,14 @@ from typing import Any, Dict, List, Optional, Union
 # restarts, gauges last-wins); v12: + "perf" (perf lab,
 # telemetry/profiler.py: sampled device-time attribution — sample
 # counters reset-aware across process lifetimes, window-split fractions
-# and the top device-time executable last-signal in log order)
-SCHEMA = "maml_tpu_telemetry_report_v12"
+# and the top device-time executable last-signal in log order);
+# v13: + "tune" (autotune subsystem, tune/ + scripts/autotune.py:
+# trial counts/failures from tune/* counters reset-aware across
+# sweep-driver segments (a killed-and-resumed sweep spans processes by
+# design) cross-checked against the explicit tune_trial rows; best
+# objective the max over ok rows; adopted-vs-rejected verdict and the
+# tuned fingerprint last-signal from the tune_adopt row)
+SCHEMA = "maml_tpu_telemetry_report_v13"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -657,6 +663,77 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "top_executable_seconds": pf_top_seconds,
         }
 
+    # Tune section (tune/ + scripts/autotune.py, schema v13): tune/*
+    # counters ride the sweep driver's registry "metrics" rows and
+    # accumulate reset-aware — one sweep log legitimately spans several
+    # driver lifetimes (the kill-and-resume contract is the ledger's
+    # whole point) — cross-checked against the explicit tune_trial
+    # rows. The best objective is the MAX over successful trial rows
+    # (higher is better for both objective keys: mfu and
+    # tasks/s/chip); the adoption verdict and tuned fingerprint take
+    # the most recent tune_adopt row in log order. Logs without the
+    # subsystem summarize to "unavailable".
+    tn_totals: Dict[str, float] = {}
+    tn_prev: Dict[str, float] = {}
+    tn_seen = False
+    tn_rows = 0
+    tn_failed_rows = 0
+    tn_best: Metric = UNAVAILABLE
+    tn_objective: Metric = UNAVAILABLE
+    tn_adopted: Metric = UNAVAILABLE
+    tn_fingerprint: Metric = UNAVAILABLE
+    for e in events:
+        if e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if not any(k.startswith("tune/") for k in m):
+                continue
+            tn_seen = True
+            for key in ("tune/trials_run", "tune/trials_failed",
+                        "tune/invalid_flag_failures"):
+                if m.get(key) is not None:
+                    _accumulate_counter(tn_totals, tn_prev, key,
+                                        float(m[key]))
+        elif e.get("event") == "tune_trial":
+            tn_seen = True
+            tn_rows += 1
+            if e.get("outcome") != "ok":
+                tn_failed_rows += 1
+            v = e.get("objective")
+            if isinstance(v, (int, float)) and math.isfinite(float(v)):
+                # Anchor the unit on the FIRST scored row (the
+                # baseline runs first): a trial whose flops walk
+                # failed falls back from mfu to tasks/s, and a raw
+                # cross-unit max would report its ~46 over everyone
+                # else's ~0.04.
+                key = (str(e["objective_key"])
+                       if e.get("objective_key") is not None else None)
+                if tn_objective == UNAVAILABLE and key is not None:
+                    tn_objective = key
+                if key == tn_objective and (
+                        tn_best == UNAVAILABLE or float(v) > tn_best):
+                    tn_best = round(float(v), 6)
+        elif e.get("event") == "tune_adopt":
+            tn_seen = True
+            if e.get("adopted") is not None:
+                tn_adopted = bool(e["adopted"])
+            if e.get("tuned_fingerprint"):
+                tn_fingerprint = str(e["tuned_fingerprint"])[:16]
+    tune_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if tn_seen:
+        tune_sec = {
+            "trials_run": max(int(tn_totals.get("tune/trials_run", 0)),
+                              tn_rows),
+            "trials_failed": max(
+                int(tn_totals.get("tune/trials_failed", 0)),
+                tn_failed_rows),
+            "invalid_flag_failures": int(
+                tn_totals.get("tune/invalid_flag_failures", 0)),
+            "best_objective": tn_best,
+            "objective": tn_objective,
+            "adopted": tn_adopted,
+            "tuned_fingerprint": tn_fingerprint,
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -696,6 +773,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "elastic": elastic_sec,
         "fleet": fleet_sec,
         "perf": perf_sec,
+        "tune": tune_sec,
     }
 
 
@@ -733,6 +811,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("elastic", summary["elastic"]),
         ("fleet", summary["fleet"]),
         ("perf", summary["perf"]),
+        ("tune", summary["tune"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

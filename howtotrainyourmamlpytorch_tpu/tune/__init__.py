@@ -1,0 +1,34 @@
+"""Autotune subsystem (docs/PERF.md § Autotune).
+
+Searches the joint space of raw XLA compiler options and structural
+config knobs (remat policy, task microbatching, fast-math BN) for a
+faster compiled program, with every trial crash-isolated in a
+subprocess and the winner adopted only through parity + accuracy
+gates:
+
+* :mod:`~.space` — axis/assignment declaration, validity pruning,
+  ``parse_compiler_options`` (canonical home; bench.py re-exports);
+* :mod:`~.harness` — subprocess bench legs + outcome classification
+  (a bad flag hard-aborts its child, never the sweep) and the
+  parity/accuracy gate legs;
+* :mod:`~.record` — the crash-recoverable ``TUNE.json`` ledger
+  (resume never repeats a terminal trial) and the ``TUNED.json``
+  adoption record the ``xla_compiler_options`` config key applies.
+
+Every module here is stdlib-only (plus the stdlib-only
+``ckpt.manifest`` atomic-write idiom): the driver CLI
+(``scripts/autotune.py``) runs jax-free — jax lives in the trial
+subprocesses.
+"""
+
+from howtotrainyourmamlpytorch_tpu.tune.space import (
+    Axis, SearchSpace, Trial, default_space, parse_compiler_options,
+    space_from_spec, trial_id)
+from howtotrainyourmamlpytorch_tpu.tune.record import (
+    TrialLedger, decide_adoption, read_tuned, write_tuned)
+
+__all__ = [
+    "Axis", "SearchSpace", "Trial", "TrialLedger", "decide_adoption",
+    "default_space", "parse_compiler_options", "read_tuned",
+    "space_from_spec", "trial_id", "write_tuned",
+]

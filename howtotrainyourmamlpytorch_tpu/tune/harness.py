@@ -1,0 +1,227 @@
+"""Crash-isolated autotune trial legs: every trial is its own process.
+
+A bad XLA flag does not raise politely — PJRT can hard-abort the whole
+process (and an aggressive remat/microbatch point can OOM it), so a
+trial is NEVER run in the driver: each one is a fresh ``bench.py``
+subprocess (the existing ``--config`` + ``--compiler-option`` plumbing
+and last-JSON-line artifact contract), and whatever happens to it —
+clean artifact, Python error line, abort signal, timeout, OOM — is
+classified into a counted outcome. A crashed trial is a ledger row,
+never a dead sweep.
+
+The objective is read from the trial artifact: ``mfu`` when the device
+peak is known (the honest utilization number, BENCH_r05's stuck-at-4%
+being this subsystem's reason to exist), else ``value``
+(tasks/s/chip — CPU CI boxes have no peak-FLOPs table row). The PR-12
+cost-card keys (``mfu_compute_frac``, ``dispatch_gap_frac``,
+``top_executable_bound``) ride along so a winner's roofline verdict is
+in the ledger next to its rate.
+
+Stdlib-only — imported by the jax-free driver; jax lives only in the
+children.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from howtotrainyourmamlpytorch_tpu.tune.space import Trial
+
+# Substrings that classify a failed leg's output. Checked in order —
+# an invalid flag surfaces as INVALID_ARGUMENT from the compile, an
+# exhausted heap as RESOURCE_EXHAUSTED/bad_alloc from the runtime.
+_INVALID_FLAG_MARKERS = ("No such compile option",
+                         "INVALID_ARGUMENT: While setting option")
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "std::bad_alloc", "MemoryError",
+                "Out of memory")
+
+# Artifact keys copied from the trial's last JSON line into its ledger
+# row (the sweep's cost-card context; absent keys stay absent).
+_CARRY_KEYS = ("value", "mfu", "compile_seconds", "compile_count",
+               "mfu_compute_frac", "dispatch_gap_frac",
+               "top_executable_bound", "flops_per_task",
+               "peak_flops_source", "workload")
+
+
+def write_trial_config(trial: Trial, base_config: Dict[str, Any],
+                       trials_dir: str) -> str:
+    """The trial's config JSON: the base workload dict + this trial's
+    structural overrides (experiment_name suffixed so artifacts are
+    attributable). The XLA channel rides the CLI, not the file — the
+    artifact's ``compiler_options_source`` must say "cli" for sweep
+    legs, reserving "tuned"/"config" for adopted sets."""
+    cfg = dict(base_config)
+    # The flags channel is CLI-ONLY for sweep legs: a base config that
+    # already carries an adopted xla_compiler_options (the re-tuning
+    # case) must not leak it into trial configs — the baseline has to
+    # be the UNTUNED program, and XLA-axis trials would otherwise mix
+    # old config-sourced flags with new CLI-sourced ones depending on
+    # which axes the trial carries.
+    cfg.pop("xla_compiler_options", None)
+    cfg.update(trial.config_overrides)
+    cfg["experiment_name"] = (str(base_config.get("experiment_name",
+                                                  "autotune"))
+                              + f"_tune_{trial.trial_id}")
+    os.makedirs(trials_dir, exist_ok=True)
+    path = os.path.join(trials_dir, f"{trial.trial_id}.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f, indent=2, sort_keys=True)
+    return path
+
+
+def classify_failure(returncode: Optional[int], tail: str) -> str:
+    """Outcome label for a non-ok leg. Signal deaths (negative rc) are
+    aborts; the marker scan separates the two failure classes the
+    sweep's accounting cares about (a space full of invalid flags vs a
+    box too small for the point)."""
+    if returncode is None:
+        return "timeout"
+    for marker in _INVALID_FLAG_MARKERS:
+        if marker in tail:
+            return "invalid_flag"
+    for marker in _OOM_MARKERS:
+        if marker in tail:
+            return "oom"
+    if returncode < 0:
+        return "crashed"
+    return "error"
+
+
+def last_json_line(stdout: str) -> Optional[Dict[str, Any]]:
+    for line in reversed(stdout.splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def run_trial(trial: Trial, *, base_config: Dict[str, Any],
+              sweep_dir: str, bench_py: str, steps: int = 3,
+              quick: bool = True, timeout_s: float = 600.0,
+              env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """One isolated bench leg; returns the ledger row (never raises on
+    a failed child). The child runs with bench's cheap flags — the
+    sweep needs the headline + cost-card legs only, not the warm-start
+    / run-weighted / strict-b8 captures (each costs extra compiles per
+    trial)."""
+    trials_dir = os.path.join(sweep_dir, "trials")
+    cfg_path = write_trial_config(trial, base_config, trials_dir)
+    cmd = [sys.executable, bench_py, "--config", cfg_path,
+           "--steps", str(steps), "--no-warm-start",
+           "--no-run-weighted", "--no-strict-b8"]
+    if quick:
+        cmd.append("--quick")
+    for k, v in sorted(trial.compiler_options.items()):
+        cmd += ["--compiler-option", f"{k}={v}"]
+    log_path = os.path.join(trials_dir, f"{trial.trial_id}.log")
+    t0 = time.monotonic()
+    rc: Optional[int] = None
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env,
+                              cwd=os.path.dirname(bench_py) or None)
+        out, err, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        def _txt(s):
+            return s.decode(errors="replace") if isinstance(s, bytes) \
+                else (s or "")
+        out, err = _txt(e.stdout), _txt(e.stderr)
+    seconds = round(time.monotonic() - t0, 3)
+    with open(log_path, "w") as f:
+        f.write(f"$ {' '.join(cmd)}\n{out}\n--- stderr ---\n{err}")
+    row: Dict[str, Any] = {
+        "assignment": trial.assignment,
+        "compiler_options": trial.compiler_options,
+        "config_overrides": trial.config_overrides,
+        "seconds": seconds,
+        "returncode": rc,
+        "log": os.path.relpath(log_path, sweep_dir),
+    }
+    artifact = last_json_line(out)
+    if (rc == 0 and artifact
+            and artifact.get("metric") == "meta_tasks_per_sec_per_chip"
+            and isinstance(artifact.get("value"), (int, float))):
+        row["outcome"] = "ok"
+        for key in _CARRY_KEYS:
+            if artifact.get(key) is not None:
+                row[key] = artifact[key]
+        if isinstance(artifact.get("mfu"), (int, float)):
+            row["objective"], row["objective_key"] = (
+                float(artifact["mfu"]), "mfu")
+        else:
+            row["objective"], row["objective_key"] = (
+                float(artifact["value"]), "tasks_per_sec_per_chip")
+        return row
+    tail = (out + "\n" + err)[-8000:]
+    row["outcome"] = classify_failure(rc, tail)
+    # The child's own error line (bench prints {"error": ...} on
+    # argparse/flag-parse failures) beats a raw tail when present.
+    if artifact and artifact.get("error"):
+        row["error"] = str(artifact["error"])[:500]
+    else:
+        row["error"] = tail.strip().splitlines()[-1][:500] if \
+            tail.strip() else f"returncode {rc}"
+    return row
+
+
+def run_parity(winner_cfg_path: str, base_cfg_path: str, *,
+               parity_py: str, compiler_options: Dict[str, str],
+               steps: int = 2, tolerance: float = 5e-3,
+               timeout_s: float = 600.0,
+               env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """The parity gate leg (scripts/tune_parity.py in a subprocess —
+    same crash isolation as a trial: the tuned program being probed is
+    the one built from a flag set that might abort). Returns the
+    probe's verdict dict, or a synthesized failure."""
+    cmd = [sys.executable, parity_py,
+           "--config", winner_cfg_path, "--base-config", base_cfg_path,
+           "--steps", str(steps), "--tolerance", str(tolerance)]
+    for k, v in sorted(compiler_options.items()):
+        cmd += ["--compiler-option", f"{k}={v}"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s, env=env)
+        verdict = last_json_line(proc.stdout)
+        if verdict and verdict.get("metric") == "tune_parity":
+            return verdict
+        return {"metric": "tune_parity", "pass": False, "mode": "fail",
+                "error": (proc.stdout + proc.stderr)[-500:]
+                or f"returncode {proc.returncode}"}
+    except subprocess.TimeoutExpired:
+        return {"metric": "tune_parity", "pass": False, "mode": "fail",
+                "error": f"parity probe timed out after {timeout_s}s"}
+
+
+def run_accuracy_gate(config_path: str, *, gate_py: str,
+                      overrides: Optional[List[str]] = None,
+                      min_accuracy: Optional[float] = None,
+                      timeout_s: float = 0.0,
+                      env: Optional[Dict[str, str]] = None
+                      ) -> Dict[str, Any]:
+    """scripts/accuracy_gate.py as a gate leg. This trains the FULL
+    schedule on real data — hours on real hardware — so the driver
+    exposes an explicit skip (recorded, never silent). Exit 2 is "ran,
+    below gate"; both verdict classes return the gate's own JSON."""
+    cmd = [sys.executable, gate_py, "--config", config_path]
+    if min_accuracy is not None:
+        cmd += ["--min-accuracy", str(min_accuracy)]
+    cmd += list(overrides or [])
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout_s or None, env=env)
+        verdict = last_json_line(proc.stdout)
+        if verdict and verdict.get("gate") == "accuracy":
+            return verdict
+        return {"gate": "accuracy", "pass": False,
+                "error": (proc.stdout + proc.stderr)[-500:]
+                or f"returncode {proc.returncode}"}
+    except subprocess.TimeoutExpired:
+        return {"gate": "accuracy", "pass": False,
+                "error": f"accuracy gate timed out after {timeout_s}s"}

@@ -1,0 +1,229 @@
+"""Autotune search space: the knobs worth searching, declared once.
+
+A sweep point is an *assignment* — one value per axis — split into the
+two channels a trial actually exercises:
+
+* ``kind="xla"`` axes become PJRT ``compiler_options`` KEY=VAL pairs
+  (forwarded to every compile; the only working channel for
+  per-experiment compiler knobs in this environment — bench.py's
+  ``--compiler-option`` rationale), and
+* ``kind="config"`` axes become :class:`MAMLConfig` field overrides
+  (``remat_policy``, ``task_microbatches``, ``bn_fast_math``, … — the
+  structural knobs that reshape the compiled program).
+
+Per-axis validity predicates prune assignments that cannot execute
+(e.g. a ``task_microbatches`` that shares no factor with the per-device
+task count) BEFORE a subprocess is spawned for them — pruned points are
+recorded, never silently dropped. Every enumeration also carries the
+identity assignment (no overrides, no flags) as the ``baseline`` trial:
+the objective a winner must beat, and the untuned program the parity
+gate compares against.
+
+Deliberately stdlib-only (no jax, no config import): the jax-free
+driver (``scripts/autotune.py``) imports this at module level, and a
+bad XLA flag must be *spawnable* — validation of flag syntax lives
+here (:func:`parse_compiler_options`, canonical home; bench.py
+re-exports it), validation of flag *semantics* is the trial subprocess
+hard-failing, which the harness counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+TUNE_SCHEMA = "maml_tpu_tune_v1"
+
+# The trial id of the identity assignment (always enumerated first).
+BASELINE_TRIAL_ID = "baseline"
+
+
+def parse_compiler_options(pairs) -> dict:
+    """Validate ``KEY=VAL`` compiler-option pairs into a dict; raises
+    ValueError on malformed or repeated keys. Canonical home of the
+    rule (moved from bench.py, which re-exports it — the jax-free
+    driver and MAMLConfig validation need it without a jax import).
+    Parses into a LOCAL dict (ADVICE r5): the duplicate check must test
+    THIS invocation's options only — checking against a module-global
+    populated by a previous call falsely rejected options on a second
+    call in the same process."""
+    opts: dict = {}
+    for kv in pairs:
+        key, sep, val = str(kv).partition("=")
+        if not sep or not key or not val:
+            # Empty VAL rejected too (ADVICE r4): an empty string
+            # forwarded through PJRT compiler_options surfaces as a
+            # confusing server-side compile error far from the CLI.
+            raise ValueError(
+                f"--compiler-option needs KEY=VAL, got {kv!r}")
+        if key in opts:
+            raise ValueError(
+                f"--compiler-option {key!r} given twice; repeated keys "
+                f"would silently overwrite")
+        opts[key] = val
+    return opts
+
+
+@dataclasses.dataclass(frozen=True)
+class Axis:
+    """One searchable knob.
+
+    ``valid`` (optional) is a predicate ``(value, assignment) -> bool
+    or str``: False/str rejects the full assignment (a str is the
+    recorded reason). It sees the WHOLE assignment so cross-axis
+    constraints (dtype x fast-math, microbatch x geometry) live on the
+    axis that owns them.
+    """
+    name: str
+    values: Tuple[Any, ...]
+    kind: str = "config"  # "xla" | "config"
+
+    valid: Optional[Callable[[Any, Dict[str, Any]], Any]] = None
+
+    def __post_init__(self):
+        if self.kind not in ("xla", "config"):
+            raise ValueError(
+                f"axis {self.name!r}: kind must be 'xla' or 'config', "
+                f"got {self.kind!r}")
+        if not self.values:
+            raise ValueError(f"axis {self.name!r} has no values")
+        if len(set(map(repr, self.values))) != len(self.values):
+            raise ValueError(f"axis {self.name!r} repeats a value")
+
+
+@dataclasses.dataclass(frozen=True)
+class Trial:
+    """One enumerated sweep point, id'd by its canonical assignment."""
+    trial_id: str
+    assignment: Dict[str, Any]            # axis name -> value
+    compiler_options: Dict[str, str]      # the "xla" channel
+    config_overrides: Dict[str, Any]      # the "config" channel
+
+
+def trial_id(assignment: Dict[str, Any]) -> str:
+    """Stable content id of an assignment — the ledger key, so a
+    resumed sweep recognizes completed points whatever order a changed
+    driver enumerates them in."""
+    if not assignment:
+        return BASELINE_TRIAL_ID
+    blob = json.dumps(assignment, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
+class SearchSpace:
+    """Cartesian product of axes, validity-pruned, baseline-first."""
+
+    def __init__(self, axes: Sequence[Axis]):
+        names = [a.name for a in axes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate axis name in {names}")
+        self.axes = tuple(axes)
+
+    def enumerate(self) -> Tuple[List[Trial], List[Dict[str, Any]]]:
+        """(trials, pruned): trials leads with the identity/baseline
+        point; pruned records every validity-rejected assignment with
+        the refusing axis + reason — a sweep that silently covered
+        less than its space would claim coverage it never ran."""
+        trials = [Trial(BASELINE_TRIAL_ID, {}, {}, {})]
+        pruned: List[Dict[str, Any]] = []
+        for combo in itertools.product(*(a.values for a in self.axes)):
+            assignment = {a.name: v for a, v in zip(self.axes, combo)}
+            reason = self._rejection(assignment)
+            if reason is not None:
+                pruned.append({"assignment": assignment, **reason})
+                continue
+            xla, cfg = self.split(assignment)
+            trials.append(Trial(trial_id(assignment), assignment,
+                                xla, cfg))
+        return trials, pruned
+
+    def _rejection(self, assignment: Dict[str, Any]
+                   ) -> Optional[Dict[str, str]]:
+        for a in self.axes:
+            if a.valid is None:
+                continue
+            verdict = a.valid(assignment[a.name], assignment)
+            if verdict is True or verdict is None:
+                continue
+            return {"axis": a.name,
+                    "reason": (verdict if isinstance(verdict, str)
+                               else "axis validity predicate")}
+        return None
+
+    def split(self, assignment: Dict[str, Any]
+              ) -> Tuple[Dict[str, str], Dict[str, Any]]:
+        """(compiler_options, config_overrides) for one assignment.
+        The xla channel is validated through the same KEY=VAL rules as
+        the CLI — a space whose axis NAME is malformed must die at
+        enumeration, not as N identical subprocess failures."""
+        xla: Dict[str, str] = {}
+        cfg: Dict[str, Any] = {}
+        for a in self.axes:
+            v = assignment[a.name]
+            if a.kind == "xla":
+                xla[a.name] = str(v)
+            else:
+                cfg[a.name] = v
+        parse_compiler_options([f"{k}={v}" for k, v in xla.items()])
+        return xla, cfg
+
+
+def _microbatch_valid(per_device_tasks: int):
+    def check(value, assignment):
+        if int(per_device_tasks) % int(value) == 0:
+            return True
+        return (f"task_microbatches {value} does not divide the "
+                f"per-device task count {per_device_tasks}")
+    return check
+
+
+def default_space(platform: str = "cpu",
+                  per_device_tasks: int = 12) -> SearchSpace:
+    """The in-tree knobs that have never been searched jointly
+    (ROADMAP item 1): the four remat policies (meta/inner.py §
+    _remat_policy), the accumulation chunk count, the fast-math BN
+    fold, plus one raw XLA axis per platform. The XLA values are
+    platform-gated because PJRT hard-rejects unknown options — a TPU
+    vmem knob offered on CPU would turn the whole axis into counted
+    failures."""
+    axes = [
+        Axis("remat_policy",
+             ("nothing", "dots", "conv_outs", "block_outs")),
+        Axis("task_microbatches", (1, 2, 3, 4),
+             valid=_microbatch_valid(per_device_tasks)),
+        Axis("bn_fast_math", (False, True)),
+    ]
+    if platform == "tpu":
+        axes.append(Axis("xla_tpu_scoped_vmem_limit_kib",
+                         ("16384", "32768", "65536"), kind="xla"))
+    else:
+        axes.append(Axis("xla_llvm_disable_expensive_passes",
+                         ("False", "True"), kind="xla"))
+    return SearchSpace(axes)
+
+
+def space_from_spec(spec: Dict[str, Any]) -> SearchSpace:
+    """Build a space from a JSON spec — the ``--space`` file format:
+
+        {"axes": [{"name": ..., "kind": "xla"|"config",
+                   "values": [...]}, ...]}
+
+    Spec axes carry no predicates (predicates are code); an invalid
+    point in a spec file is a DELIBERATE sweep member — exactly how a
+    crash-isolation proof injects a known-bad flag trial.
+    """
+    axes_spec = spec.get("axes")
+    if not isinstance(axes_spec, list) or not axes_spec:
+        raise ValueError("space spec needs a non-empty 'axes' list")
+    axes = []
+    for a in axes_spec:
+        try:
+            axes.append(Axis(name=str(a["name"]),
+                             values=tuple(a["values"]),
+                             kind=str(a.get("kind", "config"))))
+        except KeyError as e:
+            raise ValueError(f"space spec axis missing {e}") from None
+    return SearchSpace(axes)

@@ -244,6 +244,12 @@ def main(argv=None) -> int:
         "store_dir": (stores[-1].dir if stores else None),
         "fingerprint": (stores[-1].fingerprint if stores else None),
         "fingerprints": [s.fingerprint for s in stores],
+        # Tuned compiler options, if any (the autotune adoption loop:
+        # TUNED.json → xla_compiler_options → this prewarm → the tuned
+        # fingerprint dir a training launch then hits warm). Recorded so
+        # a store populated with the wrong flag set is diagnosable from
+        # the prewarm artifact alone.
+        "xla_compiler_options": cfg.xla_compiler_options_dict,
         "workload": cfg.experiment_name,
         "executables": executables,
     }), flush=True)

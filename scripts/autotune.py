@@ -1,0 +1,479 @@
+"""Autotune driver: crash-isolated XLA-flag + structural-knob sweep
+with parity-gated winner adoption into the AOT store.
+
+    python scripts/autotune.py \
+        --config experiment_config/mini-imagenet_maml++_5-way_5-shot_DA_b12.json \
+        --out /path/to/sweep [--space SPACE.json] [--quick] \
+        [--accuracy-gate run|skip] [--prove-warm-train]
+
+Drives the tune/ subsystem end to end (docs/PERF.md § Autotune):
+
+1. **Enumerate** the search space (tune/space.py): XLA
+   ``compiler_options`` axes x structural config axes (remat policy,
+   task microbatching, fast-math BN), validity-pruned, baseline-first.
+2. **Sweep**: every trial is its own ``bench.py`` subprocess
+   (tune/harness.py) — a bad flag hard-aborts its child and is counted
+   (``invalid_flag``/``crashed``/``timeout``/``oom``), never the sweep.
+   The ledger (``TUNE.json``, tune/record.py) is atomically rewritten
+   around every trial: kill this driver mid-sweep and re-run it, and
+   completed trials are NEVER repeated (interrupted ones re-run with
+   their attempt count bumped).
+3. **Gate**: the best point must beat the baseline, pass the
+   bitwise-or-tolerance parity probe against the untuned program
+   (scripts/tune_parity.py, subprocess), and pass
+   scripts/accuracy_gate.py — or the sweep records an honest
+   ``adopted: false`` with the refusing gate. ``--accuracy-gate skip``
+   is allowed but RECORDED (boxes without real data cannot run the
+   full-schedule gate; the verdict says so).
+4. **Adopt**: the winner is written as ``TUNED.json`` — the
+   ``xla_compiler_options`` config key (+ structural overrides) a
+   launch applies; ``--prove-warm-train`` then prewarns the tuned
+   store (scripts/aot_prewarm.py) and launches a real
+   ``train_maml_system.py`` run against it, asserting the tuned
+   fingerprint dir delivers ``compiles_before_first_step == 0``.
+
+Trial rows and tune/* counters publish through the telemetry registry
+into ``<out>/logs/events.jsonl`` (schema v13 "tune" section,
+scripts/telemetry_report.py reads it).
+
+Artifact contract: the LAST stdout JSON line is
+``{"metric": "autotune", ...}``. Exit 0 iff the sweep completed (a
+rejected winner is a completed sweep; a driver error is not).
+
+No JAX import — the driver runs on a login node: tune/* and the config
+module are stdlib-only, and the telemetry registry/tracing modules are
+loaded by file path (the scripts/telemetry_report.py idiom). The
+artifact's ``jax_free`` key proves it per run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from howtotrainyourmamlpytorch_tpu.tune import harness, record, space  # noqa: E402
+
+
+def _load_module(name: str, relpath: str, register: str = None):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    if register:
+        # Seed sys.modules BEFORE exec so a module whose source says
+        # ``from howtotrainyourmamlpytorch_tpu.utils.tracing import …``
+        # resolves to this file-path load instead of dragging in the
+        # jax-importing package __init__ chain.
+        sys.modules[register] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_tracing = _load_module(
+    "_tune_tracing", "howtotrainyourmamlpytorch_tpu/utils/tracing.py",
+    register="howtotrainyourmamlpytorch_tpu.utils.tracing")
+_registry = _load_module(
+    "_tune_registry", "howtotrainyourmamlpytorch_tpu/telemetry/registry.py")
+
+TRIALS_RUN = "tune/trials_run"
+TRIALS_FAILED = "tune/trials_failed"
+TRIALS_RESUMED = "tune/trials_resumed"
+INVALID_FLAG = "tune/invalid_flag_failures"
+
+
+def load_json(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+def build_proof_config(base: dict, tuned: dict, out_dir: str) -> str:
+    """The warm-train proof workload: the winner's knobs at tiny
+    shapes + a 2-iteration schedule, store and experiment dirs inside
+    the sweep dir. Tiny by design — the proof is about the PLUMBING
+    (options -> fingerprint -> prewarmed store -> zero-compile first
+    dispatch), which is shape-independent; proving it costs seconds
+    instead of the real workload's cold-compile minutes."""
+    cfg = dict(base)
+    cfg.update(tuned.get("config_overrides") or {})
+    cfg.update({
+        "experiment_name": str(base.get("experiment_name", "autotune"))
+        + "_tuned_proof",
+        "xla_compiler_options": tuned.get("xla_compiler_options") or {},
+        "image_height": 16, "image_width": 16,
+        "cnn_num_filters": 8, "num_stages": 2,
+        "batch_size": 2, "mesh_shape": [1, 1],
+        "eval_batch_size": 2, "num_evaluation_tasks": 2,
+        "total_epochs": 1, "total_iter_per_epoch": 2,
+        "max_models_to_save": 1, "live_progress": False,
+        "aot_store_dir": os.path.join(out_dir, "aot"),
+        "experiment_root": os.path.join(out_dir, "exp"),
+    })
+    # The winner's microbatch count may not divide the tiny proof
+    # batch; clamp like bench's quick path (gcd degradation is
+    # bit-equivalent and the proof is not a throughput number).
+    mb = int(cfg.get("task_microbatches", 1) or 1)
+    if 2 % mb != 0:
+        cfg["task_microbatches"] = 1
+    path = os.path.join(out_dir, "proof.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f, indent=2, sort_keys=True)
+    return path
+
+
+def prove_warm_train(proof_cfg_path: str, out_dir: str, env) -> dict:
+    """Prewarm the tuned store, launch a REAL training run against it,
+    and read the warm_start telemetry row: the acceptance numbers are
+    ``compiles_before_first_step == 0`` and the run's fingerprint
+    matching the prewarmed (tuned) one."""
+    result: dict = {"ok": False}
+    prewarm = os.path.join(_REPO, "scripts", "aot_prewarm.py")
+    import subprocess
+    p = subprocess.run([sys.executable, prewarm, "--config",
+                        proof_cfg_path], capture_output=True, text=True,
+                       env=env, timeout=1800)
+    art = harness.last_json_line(p.stdout)
+    if not art or not art.get("ok"):
+        result["error"] = ("prewarm failed: "
+                           + ((art or {}).get("error")
+                              or (p.stdout + p.stderr)[-300:]))
+        return result
+    result["prewarm_fingerprint"] = art.get("fingerprint")
+    result["prewarm_executables"] = art.get("value")
+    result["prewarm_options"] = art.get("xla_compiler_options")
+    t = subprocess.run([sys.executable,
+                        os.path.join(_REPO, "train_maml_system.py"),
+                        "--name_of_args_json_file", proof_cfg_path],
+                       capture_output=True, text=True, env=env,
+                       timeout=1800)
+    if t.returncode != 0:
+        result["error"] = (f"tuned training run rc {t.returncode}: "
+                           + (t.stdout + t.stderr)[-300:])
+        return result
+    cfg = load_json(proof_cfg_path)
+    events_path = os.path.join(cfg["experiment_root"],
+                               cfg["experiment_name"], "logs",
+                               "events.jsonl")
+    warm = None
+    try:
+        with open(events_path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if row.get("event") == "warm_start":
+                    warm = row
+    except OSError as e:
+        result["error"] = f"no warm_start row readable: {e}"
+        return result
+    if warm is None:
+        result["error"] = "training run emitted no warm_start row"
+        return result
+    result["compiles_before_first_step"] = warm.get(
+        "compiles_before_first_step")
+    result["fingerprint"] = warm.get("aot_fingerprint")
+    fp = str(result.get("prewarm_fingerprint") or "")
+    result["fingerprint_match"] = bool(
+        fp and str(warm.get("aot_fingerprint") or "") == fp[:16])
+    result["ok"] = (warm.get("compiles_before_first_step") == 0
+                    and result["fingerprint_match"])
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="XLA-flag + structural-knob autotune sweep with "
+                    "parity-gated winner adoption (docs/PERF.md § "
+                    "Autotune)")
+    ap.add_argument("--config", required=True,
+                    help="experiment_config/*.json base workload")
+    ap.add_argument("--out", required=True, metavar="DIR",
+                    help="sweep directory (ledger, trial configs/logs, "
+                         "TUNED.json; re-running against it RESUMES)")
+    ap.add_argument("--space", default=None, metavar="SPEC.json",
+                    help="search-space spec (tune/space.py § "
+                         "space_from_spec); default: the built-in "
+                         "in-tree knob space for --platform")
+    ap.add_argument("--platform", default=None, choices=("cpu", "tpu"),
+                    help="XLA axis family for the default space "
+                         "(default: from MAML_JAX_PLATFORM/"
+                         "JAX_PLATFORMS, else tpu)")
+    ap.add_argument("--trials", type=int, default=0,
+                    help="cap enumerated trials (0 = the whole space; "
+                         "the baseline always runs)")
+    ap.add_argument("--steps", type=int, default=9,
+                    help="bench steps per trial leg")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny-shape trial legs (bench --quick): CI "
+                         "and plumbing proofs, not real captures")
+    ap.add_argument("--trial-timeout", type=float, default=900.0,
+                    help="seconds per trial subprocess (a wedged "
+                         "compile is a counted timeout)")
+    ap.add_argument("--parity-steps", type=int, default=2)
+    ap.add_argument("--parity-tolerance", type=float, default=5e-3)
+    ap.add_argument("--accuracy-gate", choices=("run", "skip"),
+                    default="run",
+                    help="'skip' records the skip verbatim in the "
+                         "verdict (boxes without the real dataset "
+                         "cannot run the full-schedule gate)")
+    ap.add_argument("--min-accuracy", type=float, default=None,
+                    help="forwarded to scripts/accuracy_gate.py")
+    ap.add_argument("--accuracy-timeout", type=float, default=0.0,
+                    help="seconds for the accuracy gate (0 = none)")
+    ap.add_argument("--prove-warm-train", action="store_true",
+                    help="after adoption: prewarm the tuned store and "
+                         "launch a real training run against it, "
+                         "asserting compiles_before_first_step == 0 "
+                         "from the tuned fingerprint dir")
+    args = ap.parse_args(argv)
+
+    t_start = time.monotonic()
+    out_dir = os.path.abspath(args.out)
+    os.makedirs(out_dir, exist_ok=True)
+
+    def fail(msg: str) -> int:
+        print(json.dumps({"metric": "autotune", "ok": False,
+                          "error": msg}), flush=True)
+        return 1
+
+    try:
+        base_config = load_json(args.config)
+    except (OSError, ValueError) as e:
+        return fail(f"unreadable --config: {e}")
+
+    platform = (args.platform
+                or os.environ.get("MAML_JAX_PLATFORM")
+                or os.environ.get("JAX_PLATFORMS") or "tpu").split(",")[0]
+    try:
+        if args.space:
+            sp = space.space_from_spec(load_json(args.space))
+        else:
+            import math
+            mesh_n = max(int(math.prod(base_config.get("mesh_shape",
+                                                       [1, 1]))), 1)
+            per_dev = (2 if args.quick else max(
+                int(base_config.get("batch_size", 1)) // mesh_n, 1))
+            sp = space.default_space(platform, per_device_tasks=per_dev)
+        trials, pruned = sp.enumerate()
+    except (OSError, ValueError, KeyError) as e:
+        return fail(f"bad search space: {e}")
+    if args.trials > 0:
+        trials = trials[:max(args.trials, 1)]
+
+    ledger = record.TrialLedger(out_dir)
+    try:
+        import hashlib
+        ledger.ensure_workload(hashlib.sha256(json.dumps(
+            base_config, sort_keys=True, default=str).encode())
+            .hexdigest())
+    except ValueError as e:
+        return fail(str(e))
+    registry = _registry.MetricsRegistry()
+    jsonl = _tracing.JsonlLogger(os.path.join(out_dir, "logs",
+                                              "events.jsonl"))
+    for name in (TRIALS_RUN, TRIALS_FAILED, TRIALS_RESUMED,
+                 INVALID_FLAG):
+        registry.counter(name)
+
+    env = dict(os.environ)
+    bench_py = os.path.join(_REPO, "bench.py")
+    done = set(ledger.completed_ids())
+    interrupted = set(ledger.interrupted_ids())
+    ran = resumed = 0
+    for trial in trials:
+        if trial.trial_id in done:
+            resumed += 1
+            registry.counter(TRIALS_RESUMED).inc()
+            continue
+        ledger.begin(trial.trial_id, trial.assignment)
+        row = harness.run_trial(
+            trial, base_config=base_config, sweep_dir=out_dir,
+            bench_py=bench_py, steps=args.steps, quick=args.quick,
+            timeout_s=args.trial_timeout, env=env)
+        ledger.complete(trial.trial_id, row)
+        ran += 1
+        registry.counter(TRIALS_RUN).inc()
+        if row["outcome"] != "ok":
+            registry.counter(TRIALS_FAILED).inc()
+            if row["outcome"] == "invalid_flag":
+                registry.counter(INVALID_FLAG).inc()
+        rec = ledger.record(trial.trial_id)
+        jsonl.log("tune_trial", trial_id=trial.trial_id,
+                  outcome=row["outcome"],
+                  objective=row.get("objective"),
+                  objective_key=row.get("objective_key"),
+                  assignment=trial.assignment,
+                  attempt=rec.get("attempt"),
+                  resumed_after_interrupt=(trial.trial_id
+                                           in interrupted),
+                  seconds=row["seconds"])
+        registry.flush_jsonl(jsonl)
+        print(json.dumps({"trial": trial.trial_id,
+                          "outcome": row["outcome"],
+                          "objective": row.get("objective"),
+                          "seconds": row["seconds"]}), flush=True)
+
+    counts = ledger.counts()
+    baseline = ledger.record(space.BASELINE_TRIAL_ID)
+    if baseline is not None:
+        baseline = {**baseline, "trial_id": space.BASELINE_TRIAL_ID}
+    # Rank in the BASELINE's objective unit only: a trial whose flops
+    # walk failed falls back from mfu to tasks/s and a raw max would
+    # crown it on unit mismatch alone. No baseline unit (the baseline
+    # trial itself failed) -> no ranking at all: an unkeyed cross-unit
+    # max would report a bogus 'best' even though adoption refuses.
+    base_key = (baseline or {}).get("objective_key")
+    best = ledger.best(objective_key=base_key) if base_key else None
+
+    # -- gates ----------------------------------------------------------
+    parity = accuracy = None
+    candidate = (best if best and baseline
+                 and best.get("trial_id") != space.BASELINE_TRIAL_ID
+                 and isinstance(baseline.get("objective"), (int, float))
+                 and best["objective"] > baseline["objective"] else None)
+    gates_reused = False
+    if candidate is not None:
+        trials_dir = os.path.join(out_dir, "trials")
+        winner_cfg = os.path.join(trials_dir,
+                                  f"{candidate['trial_id']}.json")
+        base_cfg = os.path.join(trials_dir, "baseline.json")
+        # Resume contract for the EXPENSIVE legs too: a prior driver
+        # segment's gate verdicts for THIS candidate are reused from
+        # the ledger (the accuracy gate trains the full schedule —
+        # re-paying it on every resume would gut the kill-and-resume
+        # story) — but only when produced under the SAME gate
+        # parameters (a re-run that tightened the tolerance must
+        # re-probe). A stored SKIP never satisfies a --accuracy-gate
+        # run request: the operator asked for the real gate this time.
+        gate_params = {"parity_steps": args.parity_steps,
+                       "parity_tolerance": args.parity_tolerance,
+                       "min_accuracy": args.min_accuracy}
+        stored = ledger.gates_for(candidate["trial_id"],
+                                  params=gate_params)
+        if stored is not None:
+            parity = stored.get("parity")
+            accuracy = stored.get("accuracy")
+            if (args.accuracy_gate == "run"
+                    and isinstance(accuracy, dict)
+                    and accuracy.get("skipped")):
+                accuracy = None
+            gates_reused = parity is not None and accuracy is not None
+        if not (isinstance(parity, dict) and "pass" in parity):
+            parity = harness.run_parity(
+                winner_cfg, base_cfg,
+                parity_py=os.path.join(_REPO, "scripts",
+                                       "tune_parity.py"),
+                compiler_options=(candidate.get("compiler_options")
+                                  or {}),
+                steps=args.parity_steps,
+                tolerance=args.parity_tolerance,
+                timeout_s=args.trial_timeout, env=env)
+            jsonl.log("tune_parity", **{k: v for k, v in parity.items()
+                                        if k != "metric"})
+        if accuracy is None:
+            if args.accuracy_gate == "skip":
+                accuracy = {"skipped": "--accuracy-gate skip (operator "
+                                       "choice; e.g. no real dataset "
+                                       "on this box)"}
+            else:
+                accuracy = harness.run_accuracy_gate(
+                    winner_cfg,
+                    gate_py=os.path.join(_REPO, "scripts",
+                                         "accuracy_gate.py"),
+                    min_accuracy=args.min_accuracy,
+                    timeout_s=args.accuracy_timeout, env=env)
+        ledger.record_gates(candidate["trial_id"], parity, accuracy,
+                            params=gate_params)
+
+    verdict = record.decide_adoption(best, baseline, parity, accuracy)
+
+    tuned_doc = {
+        "adopted": verdict["adopted"],
+        "reason": verdict["reason"],
+        "workload": base_config.get("experiment_name"),
+        "base_config": os.path.abspath(args.config),
+        "objective_key": (best or {}).get("objective_key"),
+        "objective": (best or {}).get("objective"),
+        "baseline_objective": (baseline or {}).get("objective"),
+        "trial_id": (best or {}).get("trial_id"),
+        "assignment": (best or {}).get("assignment"),
+        "xla_compiler_options": (best or {}).get("compiler_options"),
+        "config_overrides": (best or {}).get("config_overrides"),
+        "gates": {"parity": parity, "accuracy": accuracy},
+    }
+    tuned_path = record.write_tuned(out_dir, tuned_doc)
+
+    # -- warm-train proof ----------------------------------------------
+    warm_train = None
+    if verdict["adopted"] and args.prove_warm_train:
+        try:
+            proof_cfg = build_proof_config(base_config, tuned_doc,
+                                           out_dir)
+            warm_train = prove_warm_train(proof_cfg, out_dir, env)
+        except Exception as e:  # noqa: BLE001 — the sweep result must
+            # survive a proof-leg failure, visibly.
+            warm_train = {"ok": False,
+                          "error": f"{type(e).__name__}: {e}"}
+
+    jsonl.log("tune_adopt", adopted=verdict["adopted"],
+              reason=verdict["reason"],
+              trial_id=(best or {}).get("trial_id"),
+              objective=(best or {}).get("objective"),
+              objective_key=(best or {}).get("objective_key"),
+              baseline_objective=(baseline or {}).get("objective"),
+              tuned_fingerprint=((warm_train or {})
+                                 .get("prewarm_fingerprint")))
+    registry.flush_jsonl(jsonl)
+
+    # ok means THIS invocation's enumerated trials all reached a
+    # terminal state — judged over the enumeration, not the whole
+    # ledger: a trial stranded `running` by an earlier kill that a
+    # --trials cap or an edited --space no longer enumerates must not
+    # fail every future resume forever.
+    ok = all((ledger.record(t.trial_id) or {}).get("status")
+             in record.TERMINAL for t in trials)
+    artifact = {
+        "metric": "autotune",
+        "value": (best or {}).get("objective"),
+        "unit": (best or {}).get("objective_key"),
+        "ok": ok,
+        "workload": base_config.get("experiment_name"),
+        "trials_total": len(trials),
+        "trials_run": ran,
+        "trials_resumed": resumed,
+        "trials_ok": counts["ok"],
+        "trials_failed": counts["failed"],
+        "failed_by_outcome": counts["failed_by_outcome"],
+        "invalid_flag_failures": counts["failed_by_outcome"].get(
+            "invalid_flag", 0),
+        "pruned": len(pruned),
+        "baseline_objective": (baseline or {}).get("objective"),
+        "best": ({k: (best or {}).get(k) for k in
+                  ("trial_id", "objective", "objective_key",
+                   "assignment", "compiler_options",
+                   "config_overrides")} if best else None),
+        "gates": {"parity": parity, "accuracy": accuracy},
+        "gates_reused": gates_reused,
+        "adopted": verdict["adopted"],
+        "reason": verdict["reason"],
+        "tuned_path": tuned_path,
+        "warm_train": warm_train,
+        "ledger": ledger.path,
+        "events": jsonl.path,
+        "seconds": round(time.monotonic() - t_start, 1),
+        # The driver's jax-free contract, proven per run rather than
+        # promised: trials/gates/proofs all ran as subprocesses.
+        "jax_free": "jax" not in sys.modules,
+    }
+    print(json.dumps(artifact), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

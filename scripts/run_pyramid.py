@@ -76,6 +76,9 @@ SHARD_SIZE_OVERRIDES = {
     "tests/test_multiprocess_distributed.py": 90_000,
     "tests/test_perf_profiler.py": 60_000,  # tiny profiled runs + the
     #                                         perf_report CLI subprocess
+    "tests/test_tune.py": 120_000,          # the slow sweep smoke runs
+    #                                         real bench --quick children
+    #                                         (~80s each) + a resume leg
 }
 
 

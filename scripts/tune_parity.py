@@ -1,0 +1,182 @@
+"""Autotune parity gate: tuned program vs untuned program, one verdict.
+
+Runs K train steps of the TUNED candidate (a trial config + its XLA
+compiler options, applied through the ``xla_compiler_options`` config
+key — i.e. the exact plumbing a tuned training launch uses) and of the
+UNTUNED base program, from the same seed on the same synthetic batch,
+then compares the resulting train states and losses:
+
+* ``bitwise`` — every leaf identical (remat/microbatch points and most
+  pure scheduling flags land here: the math is unchanged by
+  construction);
+* ``tolerance`` — max relative error <= --tolerance (default 5e-3, the
+  bn_fast_math / perf-variants precedent in tests/test_outer.py);
+* ``fail`` — beyond tolerance, structurally incomparable states, or
+  the tuned program refusing to compile (a flag good enough to win the
+  sweep can still be a flag the backend rejects at this geometry —
+  that MUST refuse adoption, which is why the driver runs this probe
+  in a subprocess like any trial).
+
+Artifact contract: the LAST stdout JSON line is
+``{"metric": "tune_parity", "pass": ..., "mode": ...}``.
+Exit 0 pass, 2 fail, 1 error. Invoked by scripts/autotune.py
+(tune/harness.py § run_parity); runnable standalone for forensics.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="bitwise-or-tolerance parity: tuned vs untuned "
+                    "train program")
+    ap.add_argument("--config", required=True,
+                    help="the TUNED candidate's config JSON (trial "
+                         "structural overrides already applied)")
+    ap.add_argument("--base-config", required=True,
+                    help="the UNTUNED base config JSON")
+    ap.add_argument("--compiler-option", action="append", default=[],
+                    metavar="KEY=VAL",
+                    help="the candidate's XLA options (repeatable); "
+                         "applied via the xla_compiler_options config "
+                         "key — the adoption plumbing under test")
+    ap.add_argument("--steps", type=int, default=2,
+                    help="train steps to run on each side")
+    ap.add_argument("--tolerance", type=float, default=5e-3,
+                    help="max relative error accepted when not bitwise")
+    ap.add_argument("--full-shapes", action="store_true",
+                    help="skip the quick shrink (real geometry; slow)")
+    args = ap.parse_args(argv)
+
+    def emit(doc, rc):
+        print(json.dumps({"metric": "tune_parity", **doc}), flush=True)
+        return rc
+
+    from howtotrainyourmamlpytorch_tpu.tune.space import (
+        parse_compiler_options)
+    try:
+        options = parse_compiler_options(args.compiler_option)
+    except ValueError as e:
+        return emit({"pass": False, "mode": "fail", "error": str(e)}, 1)
+
+    platform = os.environ.get("MAML_JAX_PLATFORM")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+    import jax
+    import numpy as np
+
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.parallel import (
+        make_mesh, make_sharded_steps, replicated_sharding, shard_batch)
+    # quick_shrink shared with bench.py (one home for the --quick
+    # geometry): the parity gate probes numerics at the SAME shapes
+    # the sweep's bench --quick trials measured at.
+    from bench import quick_shrink, synthetic_batch
+
+    n_dev = len(jax.devices())
+
+    def build(path: str, xla_options: dict):
+        cfg = MAMLConfig.from_json_file(path)
+        per_chip = max(
+            cfg.batch_size // max(int(np.prod(cfg.mesh_shape)), 1), 1)
+        cfg = cfg.replace(batch_size=per_chip * n_dev,
+                          mesh_shape=(1, n_dev))
+        cfg = cfg.replace(
+            task_microbatches=cfg.effective_task_microbatches(n_dev))
+        if not args.full_shapes:
+            cfg = quick_shrink(cfg, n_dev)
+        cfg = cfg.replace(xla_compiler_options=tuple(
+            f"{k}={v}" for k, v in sorted(xla_options.items())))
+        init, apply = make_model(cfg)
+        mesh = make_mesh(cfg, jax.devices())
+        plan = make_sharded_steps(cfg, apply, mesh)
+        epoch = max(cfg.total_epochs - 1, 0)
+        key = (cfg.use_second_order(epoch), cfg.use_msl(epoch))
+        state = jax.device_put(
+            init_train_state(cfg, init, jax.random.PRNGKey(0)),
+            replicated_sharding(mesh))
+        batch = shard_batch(synthetic_batch(cfg, 0), mesh)
+        return cfg, plan.train_steps[key], key, state, batch, epoch
+
+    try:
+        (cfg_t, step_t, key_t, state_t, batch_t,
+         epoch_t) = build(args.config, options)
+        (cfg_b, step_b, key_b, state_b, batch_b,
+         epoch_b) = build(args.base_config, {})
+    except Exception as e:  # noqa: BLE001 — a refused flag/config IS
+        # the verdict, not a tool crash.
+        return emit({"pass": False, "mode": "fail",
+                     "error": f"{type(e).__name__}: {e}"}, 2)
+    if key_t != key_b:
+        return emit({"pass": False, "mode": "fail",
+                     "error": f"phase keys differ: {key_t} vs {key_b}"},
+                    2)
+
+    def run(step, state, batch, epoch):
+        import jax.numpy as jnp
+        ep_arr = jnp.float32(epoch)
+        loss = None
+        for _ in range(max(args.steps, 1)):
+            state, metrics = step(state, batch, ep_arr)
+            loss = float(jax.device_get(metrics.loss))
+        return jax.device_get(state), loss
+
+    try:
+        final_t, loss_t = run(step_t, state_t, batch_t, epoch_t)
+        final_b, loss_b = run(step_b, state_b, batch_b, epoch_b)
+    except Exception as e:  # noqa: BLE001 — compile/execute refusal of
+        # the tuned program must land as a parity FAIL verdict.
+        return emit({"pass": False, "mode": "fail",
+                     "error": f"{type(e).__name__}: {e}"}, 2)
+
+    leaves_t, tdef = jax.tree.flatten(final_t)
+    leaves_b, bdef = jax.tree.flatten(final_b)
+    if tdef != bdef or len(leaves_t) != len(leaves_b):
+        return emit({"pass": False, "mode": "fail",
+                     "error": "state trees structurally incomparable"},
+                    2)
+    bitwise = True
+    max_rel = 0.0
+    for a, b in zip(leaves_t, leaves_b):
+        a, b = np.asarray(a), np.asarray(b)
+        if a.shape != b.shape or a.dtype != b.dtype:
+            return emit({"pass": False, "mode": "fail",
+                         "error": "leaf shape/dtype mismatch"}, 2)
+        if a.tobytes() != b.tobytes():
+            bitwise = False
+            af = a.astype(np.float64, copy=False)
+            bf = b.astype(np.float64, copy=False)
+            # Magnitude floor 1e-6: near-zero leaves (fresh Adam
+            # moments) would otherwise turn a denormal-sized absolute
+            # difference into an unbounded "relative" error and fail
+            # every legitimately-tolerance-class point.
+            denom = np.maximum(np.maximum(np.abs(af), np.abs(bf)), 1e-6)
+            rel = np.max(np.abs(af - bf) / denom)
+            if not np.isfinite(rel):
+                return emit({"pass": False, "mode": "fail",
+                             "error": "non-finite divergence"}, 2)
+            max_rel = max(max_rel, float(rel))
+    mode = ("bitwise" if bitwise
+            else "tolerance" if max_rel <= args.tolerance else "fail")
+    ok = mode != "fail"
+    return emit({"pass": ok, "mode": mode, "bitwise": bitwise,
+                 "max_rel_err": round(max_rel, 9),
+                 "tolerance": args.tolerance,
+                 "steps": args.steps,
+                 "loss_tuned": loss_t, "loss_untuned": loss_b,
+                 "compared_leaves": len(leaves_t)},
+                0 if ok else 2)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,11 @@ def test_bench_quick_emits_full_capture_contract():
     # always measured (never null) — a wiring regression must fail here.
     assert first["compile_count"] > 0
     assert first["compile_seconds"] > 0
+    # Flag-set attribution (ISSUE 15): every BENCH_* row names the
+    # compiler options it ran with and their source — compiler
+    # defaults here.
+    assert first["compiler_options"] == {}
+    assert first["compiler_options_source"] == "none"
     assert first["feed_stall_frac"] == 0.0  # synthetic device-resident
     #                                         batch: no host feed to stall
     # Data-plane keys (ISSUE 4): the dataset open probe is always
@@ -147,3 +152,54 @@ def test_bench_rejects_malformed_compiler_option():
     err = json.loads([ln for ln in r.stdout.splitlines()
                       if ln.startswith("{")][-1])
     assert "compiler-option" in err["error"]
+
+
+def _bench_error(args):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")] + args,
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, MAML_JAX_PLATFORM="cpu"), cwd=REPO)
+    assert r.returncode == 1, (r.returncode, r.stdout, r.stderr[-500:])
+    return json.loads([ln for ln in r.stdout.splitlines()
+                       if ln.startswith("{")][-1])
+
+
+def test_bench_tuned_flag_fast_fails_before_backend():
+    """--tuned fast-fails on an unreadable record, a rejected
+    (adopted=false) record, and the --compiler-option conflict — all
+    BEFORE backend init, with the JSON error-line contract."""
+    err = _bench_error(["--tuned", "/nonexistent/TUNED.json"])
+    assert "TUNED.json" in err["error"] or "No such file" in err["error"]
+    err = _bench_error(["--tuned", "/tmp/x.json",
+                        "--compiler-option", "a=1"])
+    assert "mutually exclusive" in err["error"]
+
+
+def test_bench_tuned_rejected_record_refused(tmp_path):
+    from howtotrainyourmamlpytorch_tpu.tune import record
+    p = record.write_tuned(str(tmp_path), {"adopted": False,
+                                           "reason": "parity"})
+    err = _bench_error(["--tuned", p])
+    assert "adopted=false" in err["error"]
+
+
+def test_bench_resolution_precedence_unit(tmp_path):
+    """resolve_compiler_options: cli > tuned > config > none, with the
+    artifact source naming the applied channel and the tuned record
+    read ONCE (both channels from one snapshot — no mixed point under
+    a concurrent rewrite)."""
+    import bench
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    from howtotrainyourmamlpytorch_tpu.tune import record
+    cfg_opts = MAMLConfig(xla_compiler_options=("b=2",))
+    assert bench.resolve_compiler_options({"a": "1"}, None, cfg_opts) \
+        == ({"a": "1"}, {}, "cli")
+    assert bench.resolve_compiler_options({}, None, cfg_opts) \
+        == ({"b": "2"}, {}, "config")
+    assert bench.resolve_compiler_options({}, None, MAMLConfig()) \
+        == ({}, {}, "none")
+    p = record.write_tuned(str(tmp_path), {
+        "adopted": True, "xla_compiler_options": {"k": "v"},
+        "config_overrides": {"remat_policy": "dots"}})
+    assert bench.resolve_compiler_options({}, p, MAMLConfig()) \
+        == ({"k": "v"}, {"remat_policy": "dots"}, "tuned")

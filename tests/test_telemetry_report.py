@@ -24,14 +24,15 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # watchdog section, v6 the optimization-health section, v7 the
 # checkpoint-lifecycle section, v8 the pod-fault-domain cluster
 # section, v9 the AOT warm-start section, v10 the elastic-pod section,
-# v11 the serving-fleet section, v12 the perf-lab section).
+# v11 the serving-fleet section, v12 the perf-lab section, v13 the
+# autotune section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
     "watchdog", "health", "checkpoint", "cluster", "warm_start",
-    "elastic", "fleet", "perf",
+    "elastic", "fleet", "perf", "tune",
 }
 
 
@@ -614,6 +615,78 @@ def test_summarize_events_fleet_section():
 def test_fleet_section_unavailable_without_subsystem():
     s = summarize_events([{"event": "train_epoch", "epoch": 0}])
     assert s["fleet"] == UNAVAILABLE
+
+
+def test_tune_section_reset_aware_across_sweep_segments():
+    """Autotune section (schema v13): one sweep log legitimately spans
+    several DRIVER lifetimes — the ledger's kill-and-resume contract —
+    so tune/* counters must accumulate reset-aware across the
+    segments, cross-checked against the explicit tune_trial rows; the
+    best objective is the max over ok rows; the adoption verdict and
+    tuned fingerprint ride the tune_adopt row."""
+    events = [
+        # Segment 1: three trials (one invalid-flag failure), then the
+        # driver is killed — its final flush carries the counters.
+        {"event": "tune_trial", "trial_id": "baseline", "outcome": "ok",
+         "objective": 6.9, "objective_key": "tasks_per_sec_per_chip"},
+        {"event": "tune_trial", "trial_id": "aaa", "outcome":
+         "invalid_flag", "objective": None},
+        {"event": "tune_trial", "trial_id": "bbb", "outcome": "ok",
+         "objective": 7.4, "objective_key": "tasks_per_sec_per_chip"},
+        {"event": "metrics",
+         "metrics": {"tune/trials_run": 3.0, "tune/trials_failed": 1.0,
+                     "tune/invalid_flag_failures": 1.0}},
+        # Segment 2 (resumed driver): counters RESET to a smaller
+        # value — the new segment contributes whole, not as a delta.
+        {"event": "tune_trial", "trial_id": "ccc", "outcome": "ok",
+         "objective": 8.1, "objective_key": "tasks_per_sec_per_chip"},
+        # A row scored in a DIFFERENT unit (failed flops walk degraded
+        # mfu->tasks/s, or vice versa) must not win best_objective on
+        # raw magnitude — the unit anchors on the first scored row.
+        {"event": "tune_trial", "trial_id": "ddd", "outcome": "ok",
+         "objective": 999.0, "objective_key": "mfu"},
+        {"event": "metrics",
+         "metrics": {"tune/trials_run": 1.0, "tune/trials_failed": 0.0,
+                     "tune/invalid_flag_failures": 0.0}},
+        {"event": "tune_adopt", "adopted": True,
+         "reason": "parity passed (bitwise)", "trial_id": "ccc",
+         "tuned_fingerprint": "deadbeefdeadbeefcafe"},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    tn = s["tune"]
+    assert tn["trials_run"] == 5          # row fallback beats counters
+    assert tn["trials_failed"] == 1
+    assert tn["invalid_flag_failures"] == 1
+    assert tn["best_objective"] == 8.1
+    assert tn["objective"] == "tasks_per_sec_per_chip"
+    assert tn["adopted"] is True
+    assert tn["tuned_fingerprint"] == "deadbeefdeadbeef"  # 16-char key
+    assert "tune" in format_table(s)
+
+
+def test_tune_section_rejected_sweep_and_row_fallback():
+    """A rejected winner reads as adopted=False (the honest verdict is
+    a first-class signal), and a log whose registry flush was lost
+    still counts trials from the explicit rows."""
+    events = [
+        {"event": "tune_trial", "trial_id": "baseline", "outcome": "ok",
+         "objective": 6.9, "objective_key": "mfu"},
+        {"event": "tune_trial", "trial_id": "aaa", "outcome": "crashed"},
+        {"event": "tune_adopt", "adopted": False,
+         "reason": "parity gate: fail"},
+    ]
+    tn = summarize_events(events)["tune"]
+    assert tn["trials_run"] == 2          # row fallback, no metrics row
+    assert tn["trials_failed"] == 1
+    assert tn["best_objective"] == 6.9
+    assert tn["adopted"] is False
+    assert tn["tuned_fingerprint"] == UNAVAILABLE
+
+
+def test_tune_section_unavailable_without_subsystem():
+    s = summarize_events([{"event": "train_epoch", "epoch": 0}])
+    assert s["tune"] == UNAVAILABLE
 
 
 def test_health_section_nonfinite_grad_norm_visible():

@@ -1,0 +1,560 @@
+"""Autotune subsystem tests (tune/, scripts/autotune.py, ISSUE 15).
+
+Tier-1 pins the pure pieces — space validity/enumeration and channel
+split, KEY=VAL validation at its canonical home, ledger
+resume-never-repeats + crashed-trial accounting through a FAKE bench
+(fast, deterministic child behaviors: ok / invalid flag / abort /
+timeout), the winner-gate refusal matrix (parity mismatch, missing
+accuracy, no improvement), TUNED.json adoption-record semantics, the
+``xla_compiler_options`` config key (validation, normalization,
+did-you-mean, CLI coercion), its AOT-store fingerprint sensitivity
+(tuned != untuned dir; runtime-only keys still excluded), and the
+mesh-level jit plumbing (a bad option VALUE hard-fails the compile —
+the crash the subprocess harness exists to contain).
+
+The slow profile adds the real-subprocess 2-axis sweep smoke:
+scripts/autotune.py driving real ``bench.py --quick`` children, one
+deliberately-invalid flag trial counted failed without killing the
+sweep, and a second driver run resuming from the ledger with zero
+repeated trials.
+"""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig  # noqa: E402
+from howtotrainyourmamlpytorch_tpu.parallel import aot  # noqa: E402
+from howtotrainyourmamlpytorch_tpu.parallel.mesh import (  # noqa: E402
+    make_mesh)
+from howtotrainyourmamlpytorch_tpu.tune import (  # noqa: E402
+    harness, record, space)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def tiny_cfg(**kw):
+    base = dict(
+        image_height=8, image_width=8, image_channels=1,
+        num_classes_per_set=2, num_samples_per_class=1,
+        num_target_samples=1, batch_size=2, cnn_num_filters=4,
+        num_stages=2, number_of_training_steps_per_iter=1,
+        number_of_evaluation_steps_per_iter=1, second_order=False,
+        use_multi_step_loss_optimization=False, total_epochs=1,
+        num_evaluation_tasks=2, compute_dtype="float32")
+    base.update(kw)
+    return MAMLConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# space
+
+
+def test_space_enumeration_baseline_first_and_channel_split():
+    sp = space.SearchSpace([
+        space.Axis("remat_policy", ("nothing", "dots")),
+        space.Axis("xla_flag_a", ("1", "2"), kind="xla"),
+    ])
+    trials, pruned = sp.enumerate()
+    assert not pruned
+    assert len(trials) == 1 + 4
+    assert trials[0].trial_id == space.BASELINE_TRIAL_ID
+    assert trials[0].assignment == {}
+    t = next(t for t in trials
+             if t.assignment == {"remat_policy": "dots",
+                                 "xla_flag_a": "2"})
+    assert t.compiler_options == {"xla_flag_a": "2"}
+    assert t.config_overrides == {"remat_policy": "dots"}
+    # Content-addressed ids: same assignment -> same id, any order.
+    assert space.trial_id({"b": 1, "a": 2}) == space.trial_id(
+        {"a": 2, "b": 1})
+    ids = [t.trial_id for t in trials]
+    assert len(set(ids)) == len(ids)
+
+
+def test_space_validity_predicate_prunes_with_reason():
+    sp = space.default_space("cpu", per_device_tasks=2)
+    trials, pruned = sp.enumerate()
+    # task_microbatches axis is (1, 2, 3, 4); 3 and 4 don't divide 2.
+    assert pruned
+    assert all(p["axis"] == "task_microbatches" for p in pruned)
+    assert all("does not divide" in p["reason"] for p in pruned)
+    assert all(t.assignment.get("task_microbatches") in (None, 1, 2)
+               for t in trials)
+    # Full coverage claim: trials + pruned == the cartesian product.
+    assert len(trials) - 1 + len(pruned) == 4 * 4 * 2 * 2
+
+
+def test_space_rejects_malformed_axes_and_specs():
+    with pytest.raises(ValueError, match="kind"):
+        space.Axis("a", (1,), kind="structural")
+    with pytest.raises(ValueError, match="no values"):
+        space.Axis("a", ())
+    with pytest.raises(ValueError, match="repeats"):
+        space.Axis("a", (1, 1))
+    with pytest.raises(ValueError, match="duplicate"):
+        space.SearchSpace([space.Axis("a", (1,)), space.Axis("a", (2,))])
+    with pytest.raises(ValueError, match="axes"):
+        space.space_from_spec({})
+    sp = space.space_from_spec({"axes": [
+        {"name": "bn_fast_math", "values": [False, True]},
+        {"name": "xla_x", "kind": "xla", "values": ["1"]}]})
+    trials, _ = sp.enumerate()
+    assert len(trials) == 3
+
+
+def test_parse_compiler_options_rules_at_canonical_home():
+    assert space.parse_compiler_options(["k=v", "k2=a=b"]) == {
+        "k": "v", "k2": "a=b"}
+    for bad in (["noeq"], ["k="], ["=v"], ["k=1", "k=2"]):
+        with pytest.raises(ValueError):
+            space.parse_compiler_options(bad)
+    # bench re-exports the SAME function (perf scripts import it there).
+    import bench
+    assert bench.parse_compiler_options is space.parse_compiler_options
+
+
+# ---------------------------------------------------------------------------
+# ledger
+
+
+def test_ledger_resume_never_repeats_and_attempt_bumps(tmp_path):
+    d = str(tmp_path)
+    led = record.TrialLedger(d)
+    led.begin("t1", {"a": 1})
+    led.complete("t1", {"outcome": "ok", "objective": 2.0})
+    led.begin("t2", {"a": 2})
+    led.complete("t2", {"outcome": "crashed", "error": "sig"})
+    led.begin("t3", {"a": 3})  # driver dies here: stays "running"
+    # Fresh driver against the same dir (the resume path):
+    led2 = record.TrialLedger(d)
+    assert sorted(led2.completed_ids()) == ["t1", "t2"]  # failed trials
+    #                       are terminal too — never re-run a crasher
+    assert led2.interrupted_ids() == ["t3"]
+    led2.begin("t3", {"a": 3})
+    assert led2.record("t3")["attempt"] == 2  # the interruption's scar
+    led2.complete("t3", {"outcome": "ok", "objective": 1.0})
+    counts = led2.counts()
+    assert counts == {"ok": 2, "failed": 1, "running": 0,
+                      "failed_by_outcome": {"crashed": 1}}
+    best = led2.best()
+    assert best["trial_id"] == "t1" and best["objective"] == 2.0
+    # Unit-anchored ranking: a trial scored in a DIFFERENT objective
+    # unit (a failed flops walk degrades mfu -> tasks/s) must not win
+    # a keyed ranking on raw magnitude.
+    led2.begin("t4", {"a": 4})
+    led2.complete("t4", {"outcome": "ok", "objective": 46.2,
+                         "objective_key": "tasks_per_sec_per_chip"})
+    led2.begin("t5", {"a": 5})
+    led2.complete("t5", {"outcome": "ok", "objective": 0.04,
+                         "objective_key": "mfu"})
+    assert led2.best()["objective"] == 46.2          # raw max
+    assert led2.best(objective_key="mfu")["trial_id"] == "t5"
+    # Every rewrite left a valid JSON file (atomic idiom).
+    with open(led2.path) as f:
+        assert json.load(f)["schema"] == record.LEDGER_SCHEMA
+
+
+def test_ledger_refuses_cross_workload_resume(tmp_path):
+    """Trial ids hash only the axis assignment — resuming a sweep dir
+    against a DIFFERENT base config would silently reuse
+    cross-workload results, so the ledger binds to one workload key."""
+    led = record.TrialLedger(str(tmp_path))
+    led.ensure_workload("aaaa")
+    led2 = record.TrialLedger(str(tmp_path))
+    led2.ensure_workload("aaaa")        # same workload resumes fine
+    with pytest.raises(ValueError, match="fresh --out"):
+        led2.ensure_workload("bbbb")
+
+
+def test_ledger_corrupt_file_quarantined_not_fatal(tmp_path):
+    p = tmp_path / record.LEDGER_FILE
+    p.write_text("{torn json")
+    led = record.TrialLedger(str(tmp_path))
+    assert led.completed_ids() == []
+    assert (tmp_path / (record.LEDGER_FILE + ".corrupt")).exists()
+
+
+# ---------------------------------------------------------------------------
+# harness (fake bench: fast, deterministic child behaviors)
+
+_FAKE_BENCH = textwrap.dedent("""\
+    #!/usr/bin/env python
+    import argparse, json, os, sys, time
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config"); ap.add_argument("--steps")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--no-warm-start", action="store_true")
+    ap.add_argument("--no-run-weighted", action="store_true")
+    ap.add_argument("--no-strict-b8", action="store_true")
+    ap.add_argument("--compiler-option", action="append", default=[])
+    a = ap.parse_args()
+    cfg = json.load(open(a.config))
+    mode = cfg.get("remat_policy", "ok")
+    opts = dict(kv.split("=", 1) for kv in a.compiler_option)
+    if "xla_bogus_flag" in opts:
+        sys.stderr.write("E0000 No such compile option: "
+                         "'xla_bogus_flag'\\n")
+        sys.exit(1)
+    if mode == "dots":      # stand-in for a hard abort
+        os.abort()
+    if mode == "conv_outs":  # stand-in for a wedged compile
+        time.sleep(60)
+    rate = 5.0 + len(opts)
+    print(json.dumps({"metric": "meta_tasks_per_sec_per_chip",
+                      "value": rate, "unit": "tasks/s/chip",
+                      "mfu": rate / 100.0, "compile_count": 1,
+                      "top_executable_bound": "compute",
+                      "workload": cfg.get("experiment_name")}))
+""")
+
+
+@pytest.fixture
+def fake_bench(tmp_path):
+    p = tmp_path / "fake_bench.py"
+    p.write_text(_FAKE_BENCH)
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    return str(p)
+
+
+def test_harness_counts_crashes_without_killing_sweep(tmp_path,
+                                                      fake_bench):
+    """The acceptance behavior at unit scale: ok, invalid-flag,
+    hard-abort and timeout children are all COUNTED outcomes of one
+    surviving sweep loop, with the ledger terminal for every one."""
+    sp = space.SearchSpace([
+        space.Axis("remat_policy",
+                   ("nothing", "dots", "conv_outs")),
+        space.Axis("xla_bogus_flag", ("1",), kind="xla"),
+    ])
+    trials, _ = sp.enumerate()
+    sweep = str(tmp_path / "sweep")
+    led = record.TrialLedger(sweep)
+    base = {"experiment_name": "fake"}
+    for t in trials:
+        # The bogus-flag axis makes every non-baseline trial invalid:
+        # strip it from all but the first so each behavior is seen.
+        if t.assignment.get("remat_policy") != "nothing":
+            t = space.Trial(t.trial_id, t.assignment, {},
+                            t.config_overrides)
+        led.begin(t.trial_id, t.assignment)
+        row = harness.run_trial(
+            t, base_config=base, sweep_dir=sweep, bench_py=fake_bench,
+            timeout_s=4.0)
+        led.complete(t.trial_id, row)
+    counts = led.counts()
+    assert counts["running"] == 0
+    assert counts["ok"] == 1                       # the baseline
+    assert counts["failed_by_outcome"]["invalid_flag"] == 1
+    assert counts["failed_by_outcome"]["crashed"] == 1
+    assert counts["failed_by_outcome"]["timeout"] == 1
+    # ok row carried the artifact subset + objective.
+    ok = led.best()
+    assert ok["trial_id"] == space.BASELINE_TRIAL_ID
+    assert ok["objective_key"] == "mfu"            # mfu preferred
+    assert ok["top_executable_bound"] == "compute"
+    # Every trial wrote its config + log for forensics.
+    for t in trials:
+        assert os.path.exists(
+            os.path.join(sweep, "trials", f"{t.trial_id}.json"))
+        assert os.path.exists(
+            os.path.join(sweep, "trials", f"{t.trial_id}.log"))
+
+
+def test_trial_config_strips_adopted_flags_from_base(tmp_path):
+    """Re-tuning an already-adopted config: the base's own
+    xla_compiler_options must NOT leak into trial configs — the
+    baseline has to be the untuned program and the flags channel is
+    CLI-only for sweep legs."""
+    t = space.Trial("baseline", {}, {}, {})
+    p = harness.write_trial_config(
+        t, {"experiment_name": "re",
+            "xla_compiler_options": {"old": "1"}}, str(tmp_path))
+    assert "xla_compiler_options" not in json.load(open(p))
+
+
+def test_harness_failure_classification():
+    assert harness.classify_failure(None, "") == "timeout"
+    assert harness.classify_failure(
+        1, "No such compile option: 'x'") == "invalid_flag"
+    assert harness.classify_failure(
+        1, "INVALID_ARGUMENT: While setting option y, 'z'"
+    ) == "invalid_flag"
+    assert harness.classify_failure(1, "RESOURCE_EXHAUSTED") == "oom"
+    assert harness.classify_failure(-6, "aborted") == "crashed"
+    assert harness.classify_failure(1, "Traceback ...") == "error"
+
+
+# ---------------------------------------------------------------------------
+# winner gate + adoption record
+
+
+def _ok(tid, obj):
+    return {"trial_id": tid, "objective": obj, "status": "ok"}
+
+
+def test_gate_refusal_matrix():
+    base, win = _ok("baseline", 5.0), _ok("abc", 6.0)
+    par_ok = {"pass": True, "mode": "bitwise"}
+    par_bad = {"pass": False, "mode": "fail", "error": "rel 0.2"}
+    acc_ok = {"pass": True}
+    # No winner / no baseline / no improvement.
+    assert not record.decide_adoption(None, base, None, None)["adopted"]
+    assert not record.decide_adoption(win, None, par_ok,
+                                      acc_ok)["adopted"]
+    v = record.decide_adoption(_ok("abc", 4.0), base, par_ok, acc_ok)
+    assert not v["adopted"] and "does not beat" in v["reason"]
+    v = record.decide_adoption(base, base, par_ok, acc_ok)
+    assert not v["adopted"] and "baseline is the best" in v["reason"]
+    # Unit mismatch refuses before any magnitude compare.
+    v = record.decide_adoption(
+        {**_ok("abc", 46.2), "objective_key": "tasks_per_sec_per_chip"},
+        {**base, "objective_key": "mfu"}, par_ok, acc_ok)
+    assert not v["adopted"] and "units differ" in v["reason"]
+    # Parity refusal beats everything else; it can never be skipped.
+    v = record.decide_adoption(win, base, par_bad, acc_ok)
+    assert not v["adopted"] and "parity gate" in v["reason"]
+    v = record.decide_adoption(win, base, None, acc_ok)
+    assert not v["adopted"] and "parity gate" in v["reason"]
+    # Accuracy refusal / absence refuses; an explicit skip is recorded.
+    v = record.decide_adoption(win, base, par_ok, {"pass": False})
+    assert not v["adopted"] and "accuracy gate" in v["reason"]
+    assert not record.decide_adoption(win, base, par_ok,
+                                      None)["adopted"]
+    v = record.decide_adoption(win, base, par_ok,
+                               {"skipped": "no real dataset"})
+    assert v["adopted"] and "SKIPPED: no real dataset" in v["reason"]
+    # All green.
+    assert record.decide_adoption(win, base, par_ok, acc_ok)["adopted"]
+
+
+def test_ledger_persists_gate_verdicts_for_resume(tmp_path):
+    """The expensive legs ride the resume contract too: gate verdicts
+    are keyed to the candidate trial in the ledger, reused by a
+    resumed driver, and dropped when the candidate changes."""
+    led = record.TrialLedger(str(tmp_path))
+    par = {"pass": True, "mode": "bitwise"}
+    acc = {"skipped": "no dataset"}
+    params = {"parity_tolerance": 5e-3, "min_accuracy": None}
+    led.record_gates("abc", par, acc, params=params)
+    led2 = record.TrialLedger(str(tmp_path))  # fresh driver segment
+    g = led2.gates_for("abc", params=params)
+    assert g["parity"] == par and g["accuracy"] == acc
+    assert led2.gates_for("other-winner") is None
+    # A verdict produced under DIFFERENT gate parameters never
+    # satisfies a resume that changed them (tightened tolerance).
+    assert led2.gates_for(
+        "abc", params={"parity_tolerance": 1e-4,
+                       "min_accuracy": None}) is None
+
+
+def test_bench_tuned_applies_structural_overrides(tmp_path):
+    """A winner is a POINT in the joint space: bench --tuned must
+    apply the config_overrides channel too (a purely structural winner
+    benched as 'tuned' would otherwise measure the baseline), with the
+    microbatch count re-clamped at the local geometry and unknown
+    override keys refused loudly."""
+    import bench
+    p = record.write_tuned(str(tmp_path), {
+        "adopted": True,
+        "xla_compiler_options": {"a": "1"},
+        "config_overrides": {"remat_policy": "dots",
+                             "task_microbatches": 12}})
+    opts, overrides = bench.read_tuned_record(p)
+    assert opts == {"a": "1"}
+    cfg = bench.apply_tuned_overrides(tiny_cfg(), overrides, n_dev=1)
+    assert cfg.remat_policy == "dots"
+    assert cfg.task_microbatches == 2   # gcd-clamped to batch 2 / 1 dev
+    with pytest.raises(ValueError, match="config_overrides"):
+        bench.apply_tuned_overrides(tiny_cfg(), {"not_a_field": 1}, 1)
+
+
+def test_quick_shrink_shared_between_bench_and_parity_gate():
+    """One home for the --quick geometry: the parity gate probes the
+    SAME shapes the sweep's bench --quick trials measured at."""
+    import bench
+    src = open(os.path.join(REPO, "scripts", "tune_parity.py")).read()
+    assert "from bench import quick_shrink" in src
+    c = bench.quick_shrink(tiny_cfg(batch_size=16,
+                                    task_microbatches=4), n_dev=1)
+    assert (c.image_height, c.cnn_num_filters, c.num_stages,
+            c.batch_size) == (16, 8, 2, 2)
+    assert c.task_microbatches == 2     # clamped to the quick batch
+
+
+def test_tuned_record_roundtrip_and_rejected_refusal(tmp_path):
+    p = record.write_tuned(str(tmp_path), {
+        "adopted": True, "xla_compiler_options": {"a": "1"}})
+    doc = record.read_tuned(p)
+    assert doc["xla_compiler_options"] == {"a": "1"}
+    p2 = record.write_tuned(str(tmp_path), {"adopted": False,
+                                            "reason": "parity"})
+    with pytest.raises(ValueError, match="adopted=false"):
+        record.read_tuned(p2)
+    (tmp_path / "notatuned.json").write_text("{}")
+    with pytest.raises(ValueError, match="not a"):
+        record.read_tuned(str(tmp_path / "notatuned.json"))
+
+
+# ---------------------------------------------------------------------------
+# the xla_compiler_options config key
+
+
+def test_config_key_validation_and_normalization():
+    with pytest.raises(ValueError, match="KEY=VAL"):
+        MAMLConfig(xla_compiler_options=("noeq",))
+    with pytest.raises(ValueError, match="twice"):
+        MAMLConfig(xla_compiler_options=("a=1", "a=2"))
+    forms = [{"b": "2", "a": "1"}, "b=2, a=1", ["b=2", "a=1"]]
+    cfgs = [MAMLConfig.from_dict({"xla_compiler_options": f})
+            for f in forms]
+    # Every spelling canonicalizes identically (same fingerprint).
+    assert all(c.xla_compiler_options == ("a=1", "b=2") for c in cfgs)
+    assert cfgs[0].xla_compiler_options_dict == {"a": "1", "b": "2"}
+    # Sort is by option NAME, not the raw string: 'xla=1' vs 'xla2=2'
+    # string-sorts the other way ('=' < '2'), which would give dict
+    # and list spellings of one set different fingerprints.
+    tricky = [{"xla": "1", "xla2": "2"}, ["xla2=2", "xla=1"],
+              "xla2=2,xla=1"]
+    canon = [MAMLConfig.from_dict({"xla_compiler_options": f}
+                                  ).xla_compiler_options
+             for f in tricky]
+    assert canon[0] == canon[1] == canon[2]
+    # JSON null means unset, not a crash in every dict consumer.
+    c = MAMLConfig.from_dict({"xla_compiler_options": None})
+    assert c.xla_compiler_options == ()
+    assert c.xla_compiler_options_dict == {}
+    with pytest.raises(ValueError, match="did you mean"):
+        MAMLConfig.from_dict({"xla_compiler_optons": {"a": "1"}})
+
+
+def test_config_key_cli_override_coercion():
+    from train_maml_system import get_args
+    cfg = get_args(["--xla_compiler_options", "b=2,a=1"])
+    assert cfg.xla_compiler_options == ("a=1", "b=2")
+
+
+def test_fingerprint_tuned_vs_untuned_and_runtime_exclusion():
+    """The adoption invariant: a tuned flag set keys its OWN store
+    fingerprint dir (tuned and untuned executables can never serve for
+    each other), while runtime-only keys still share one (a path tweak
+    must not cold-start a tuned store)."""
+    cfg = tiny_cfg()
+    mesh = make_mesh(cfg.replace(mesh_shape=(1, 1)), jax.devices()[:1])
+    fp = aot.store_fingerprint(cfg, mesh)
+    tuned = cfg.replace(
+        xla_compiler_options=("xla_llvm_disable_expensive_passes=True",))
+    assert aot.store_fingerprint(tuned, mesh) != fp
+    # Different option VALUES are different programs too.
+    tuned2 = cfg.replace(
+        xla_compiler_options=("xla_llvm_disable_expensive_passes=False",))
+    assert aot.store_fingerprint(tuned2, mesh) != \
+        aot.store_fingerprint(tuned, mesh)
+    # Runtime-only keys stay excluded alongside the new structural one.
+    assert aot.store_fingerprint(
+        tuned.replace(aot_store_dir="/tmp/elsewhere"), mesh) == \
+        aot.store_fingerprint(tuned, mesh)
+    assert "xla_compiler_options" not in aot._RUNTIME_ONLY_KEYS
+
+
+def test_mesh_jit_plumbing_bad_option_value_hard_fails_compile():
+    """End-to-end plumbing pin: an invalid option VALUE in the config
+    reaches the backend through make_sharded_steps' jit wiring and
+    hard-fails the compile — exactly the crash class the subprocess
+    harness isolates (and proof the options are APPLIED, not carried
+    as inert metadata)."""
+    from howtotrainyourmamlpytorch_tpu.meta import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.parallel import (
+        make_sharded_steps, replicated_sharding, shard_batch)
+    from bench import synthetic_batch
+    bad = tiny_cfg(
+        mesh_shape=(1, 1),
+        xla_compiler_options=("xla_cpu_enable_fast_math=bogus",))
+    mesh = make_mesh(bad, jax.devices()[:1])
+    init, apply = make_model(bad)
+    plan = make_sharded_steps(bad, apply, mesh)
+    state = jax.device_put(init_train_state(bad, init,
+                                            jax.random.PRNGKey(0)),
+                           replicated_sharding(mesh))
+    batch = shard_batch(synthetic_batch(bad, 0), mesh)
+    with pytest.raises(Exception, match="xla_cpu_enable_fast_math"):
+        plan.eval_step.lower(state, batch).compile()
+
+
+# ---------------------------------------------------------------------------
+# the real-subprocess sweep smoke (slow profile)
+
+
+@pytest.mark.slow
+def test_autotune_cli_sweep_counts_invalid_flag_and_resumes(tmp_path):
+    """scripts/autotune.py against REAL bench --quick children: a
+    2-axis space (one structural, one XLA axis with one deliberately
+    invalid VALUE) completes with the bad trial counted failed, the
+    artifact honest about adoption, and a second driver run resuming
+    with zero repeated trials. The driver itself must stay jax-free."""
+    spec = tmp_path / "space.json"
+    spec.write_text(json.dumps({"axes": [
+        {"name": "remat_policy", "values": ["nothing"]},
+        {"name": "xla_cpu_enable_fast_math", "kind": "xla",
+         "values": ["False", "bogus"]},
+    ]}))
+    out = tmp_path / "sweep"
+    cmd = [sys.executable, os.path.join(REPO, "scripts", "autotune.py"),
+           "--config", os.path.join(
+               REPO, "experiment_config",
+               "mini-imagenet_maml++_5-way_5-shot_DA_b12.json"),
+           "--out", str(out), "--space", str(spec), "--quick",
+           "--steps", "3", "--trial-timeout", "900",
+           "--accuracy-gate", "skip"]
+    # Pin the bench children to ONE device: the pytest conftest exports
+    # XLA_FLAGS forcing 8 virtual CPU devices and subprocesses inherit
+    # it — an 8-way-sharded 16-task quick bench on a 1-core box blows
+    # every trial past its timeout (the test_pod_e2e explicit-flags
+    # idiom, in reverse).
+    env = dict(os.environ, MAML_JAX_PLATFORM="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    r = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=2100, env=env, cwd=REPO)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    art = json.loads([ln for ln in r.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert art["metric"] == "autotune" and art["ok"]
+    assert art["jax_free"] is True
+    assert art["trials_total"] == 3       # baseline + 2
+    assert art["trials_run"] == 3 and art["trials_resumed"] == 0
+    assert art["trials_failed"] == 1
+    assert art["invalid_flag_failures"] == 1
+    assert art["baseline_objective"] > 0
+    # Honest verdict either way: adopted with recorded skip, or a
+    # reasoned refusal (quick-shape noise decides which).
+    assert isinstance(art["adopted"], bool)
+    assert art["reason"]
+    assert os.path.exists(art["tuned_path"])
+    # Resume: same command, zero repeats, same totals.
+    r2 = subprocess.run(cmd, capture_output=True, text=True,
+                        timeout=600, env=env, cwd=REPO)
+    assert r2.returncode == 0, (r2.stdout[-2000:], r2.stderr[-2000:])
+    art2 = json.loads([ln for ln in r2.stdout.splitlines()
+                       if ln.startswith("{")][-1])
+    assert art2["trials_run"] == 0
+    assert art2["trials_resumed"] == 3
+    assert art2["trials_failed"] == 1     # the ledger remembers
+    # The sweep's telemetry stream summarizes into the v13 section.
+    from howtotrainyourmamlpytorch_tpu.telemetry.report import (
+        summarize_events)
+    from howtotrainyourmamlpytorch_tpu.utils.tracing import read_jsonl
+    tn = summarize_events(read_jsonl(art["events"]))["tune"]
+    assert tn["trials_run"] >= 3
+    assert tn["invalid_flag_failures"] == 1
+    assert isinstance(tn["adopted"], bool)
