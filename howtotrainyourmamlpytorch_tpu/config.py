@@ -358,6 +358,24 @@ class MAMLConfig:
                                            # mean in-flight) requests
                                            # before its keys spill to the
                                            # next ring position
+    reqtrace_sample_rate: float = 0.0      # head-based request-trace
+                                           # sampling rate in [0, 1].
+                                           # 0 = off (the default):
+                                           # NOTHING is installed — no
+                                           # span ring, no wire bytes —
+                                           # and serving is bitwise
+                                           # identical. 1 = trace every
+                                           # request (benches, proof runs)
+    fleet_slo_p95_ms: float = 2000.0       # per-request latency SLO the
+                                           # controller's ledger judges
+                                           # good/bad against (a request
+                                           # slower than this is "bad")
+    fleet_slo_target_frac: float = 0.95    # SLO target: the fraction of
+                                           # requests that must be good.
+                                           # burn rate = bad_frac /
+                                           # (1 - target): 1.0 = burning
+                                           # the error budget exactly at
+                                           # the sustainable rate
 
     # ---- checkpoint lifecycle (ckpt/ subsystem, docs/CHECKPOINT.md) ----
     ckpt_async: int = 0                    # 1 = epoch saves snapshot host-
@@ -686,6 +704,17 @@ class MAMLConfig:
             if getattr(self, name) < 0:
                 raise ValueError(f"{name} must be >= 0 (0 = derived "
                                  f"from fleet_lease_interval_s)")
+        if not 0.0 <= self.reqtrace_sample_rate <= 1.0:
+            raise ValueError(
+                f"reqtrace_sample_rate must be in [0, 1] (0 = tracing "
+                f"off), got {self.reqtrace_sample_rate}")
+        if self.fleet_slo_p95_ms <= 0:
+            raise ValueError("fleet_slo_p95_ms must be > 0")
+        if not 0.0 < self.fleet_slo_target_frac < 1.0:
+            raise ValueError(
+                f"fleet_slo_target_frac must be in (0, 1) — 1.0 leaves "
+                f"zero error budget and the burn rate divides by it, "
+                f"got {self.fleet_slo_target_frac}")
         if self.flight_recorder_events < 1:
             raise ValueError("flight_recorder_events must be >= 1")
         if self.require_mesh not in (0, 1):

@@ -74,7 +74,10 @@ class FewShotRequest:
     [0, N-way); ``query_x``: (Q, H, W, C). ``deadline`` is an ABSOLUTE
     ``time.monotonic()`` instant (None = the engine applies the config
     default). ``arrival_time`` defaults to construction time so latency
-    measurements include queueing.
+    measurements include queueing. ``enqueue_time`` is stamped by the
+    batcher at ADMISSION (None until then) — bucket wait is measured
+    from there, not from dequeue. ``trace`` is the optional request-
+    trace context (telemetry/reqtrace.py); None = unsampled.
     """
     support_x: np.ndarray
     support_y: np.ndarray
@@ -82,6 +85,8 @@ class FewShotRequest:
     deadline: Optional[float] = None
     request_id: int = field(default_factory=lambda: next(_ids))
     arrival_time: float = field(default_factory=time.monotonic)
+    enqueue_time: Optional[float] = None
+    trace: Optional[dict] = None
 
     def __post_init__(self) -> None:
         self.support_x = np.asarray(self.support_x)
@@ -200,13 +205,17 @@ class RequestBatcher:
             if len(self._queue) >= self.max_queue_depth:
                 raise QueueFullError(
                     f"serve queue at max depth {self.max_queue_depth}")
+            # Stamped only once admission is certain: a rejected submit
+            # must leave the request untouched (the caller may retry it
+            # later, and the deadline clock must not have been running
+            # while it was never queued). enqueue_time marks the same
+            # instant — queue wait is measured from ADMISSION, not from
+            # dequeue, or bucket wait would be invisibly attributed to
+            # whatever phase dequeues the request.
+            now = time.monotonic() if now is None else now
             if stamp_deadline:
-                # Stamped only once admission is certain: a rejected
-                # submit must leave the request untouched (the caller
-                # may retry it later, and the deadline clock must not
-                # have been running while it was never queued).
-                now = time.monotonic() if now is None else now
                 req.deadline = now + self.default_deadline_ms / 1e3
+            req.enqueue_time = now
             self._queue.append((req, bucket))
         return bucket
 

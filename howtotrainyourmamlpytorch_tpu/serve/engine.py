@@ -73,6 +73,7 @@ from howtotrainyourmamlpytorch_tpu.serve.cache import (
 from howtotrainyourmamlpytorch_tpu.serve.fleet.l2cache import (
     L2AdaptedParamsCache)
 from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+from howtotrainyourmamlpytorch_tpu.telemetry import reqtrace
 from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
     LATEST, CheckpointManager, CorruptCheckpointError)
@@ -240,6 +241,18 @@ class ServingEngine:
         # deadline and never trips. Installed only when this process has
         # no beacon already (a training-owned watchdog wins) and
         # restored on close(), like the registry/compile listener.
+        # Request tracing (telemetry/reqtrace.py): a span ring is
+        # installed ONLY when sampling is on — rate=0 (the default)
+        # installs nothing, every hook below is one `get() is None`
+        # check, and serving is bitwise identical (the zero-cost
+        # discipline health/profiler pin). Restored on close() like the
+        # compile listener.
+        self._reqtrace_ring: Optional[reqtrace.SpanRing] = None
+        self._prev_reqtrace: Optional[reqtrace.SpanRing] = None
+        if cfg.reqtrace_sample_rate > 0:
+            self._reqtrace_ring = reqtrace.SpanRing(
+                registry=self.registry)
+            self._prev_reqtrace = reqtrace.install(self._reqtrace_ring)
         self._watchdog: Optional[watchdog.Watchdog] = None
         self._prev_beacon = None
         self._prev_recorder = None
@@ -326,6 +339,9 @@ class ServingEngine:
                 pass
         self._compile_watch.uninstall()
         resilience.set_registry(self._prev_resilience_registry)
+        if self._reqtrace_ring is not None:
+            reqtrace.install(self._prev_reqtrace)
+            self._reqtrace_ring = None
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
@@ -345,11 +361,15 @@ class ServingEngine:
         BucketError/QueueFullError before any side effect (the caller
         sheds load); both rejections are counted."""
         reg = self.registry
+        trace = req.trace if reqtrace.get() is not None else None
+        t0 = time.monotonic() if trace is not None else 0.0
         try:
             bucket = self.batcher.submit(req, now=now)
         except (QueueFullError, ValueError):
             reg.counter("serve/rejected_total").inc()
             raise
+        reqtrace.record_span(trace, reqtrace.SPAN_ADMIT, t0,
+                             time.monotonic() - t0)
         reg.counter("serve/requests_total").inc()
         reg.gauge("serve/queue_depth").set(self.batcher.depth)
         return bucket
@@ -461,6 +481,21 @@ class ServingEngine:
         if not group:
             return responses
 
+        # Queue wait measured from ADMISSION (the batcher's enqueue
+        # stamp), not from dequeue — always-on histogram (the satellite
+        # fix: bucket wait used to be invisibly folded into end-to-end
+        # latency) plus a batch_wait span per traced request.
+        t_deq = time.monotonic()
+        tracing = reqtrace.get() is not None
+        for req in group:
+            if req.enqueue_time is not None:
+                wait = max(0.0, t_deq - req.enqueue_time)
+                reg.histogram("serve/queue_wait_seconds").observe(wait)
+                if tracing and req.trace is not None:
+                    reqtrace.record_span(req.trace,
+                                         reqtrace.SPAN_BATCH_WAIT,
+                                         req.enqueue_time, wait)
+
         # Cache lookup per request (hits skip adaptation entirely). The
         # cache is an OPTIMIZATION, never a dependency: any lookup/store
         # failure degrades that request to the adapt-on-miss path
@@ -473,6 +508,8 @@ class ServingEngine:
         tiers: List[Optional[str]] = []
         misses: List[int] = []
         for i, key in enumerate(keys):
+            t_probe = (time.monotonic()
+                       if tracing and group[i].trace is not None else None)
             try:
                 cached = self.cache.get(key)
             except Exception:
@@ -496,6 +533,13 @@ class ServingEngine:
                         self.cache.put(key, cached)
                     except Exception:
                         reg.counter("resilience/cache_errors").inc()
+            if t_probe is not None:
+                # Hit tier on the span ("miss" spelled out — the trace
+                # consumer never infers absence).
+                reqtrace.record_span(group[i].trace,
+                                     reqtrace.SPAN_CACHE_PROBE, t_probe,
+                                     time.monotonic() - t_probe,
+                                     tier=tier or "miss")
             tiers.append(tier)
             if cached is not None:
                 entries[i] = cached
@@ -516,7 +560,16 @@ class ServingEngine:
             reg.histogram("serve/batch_occupancy",
                           buckets=_OCCUPANCY_BUCKETS).observe(
                               batch["occupancy"])
+            t_adapt = time.monotonic()
             adapted = self._run_adapt(batch)
+            if tracing:
+                # Batch-level duration attributed to each missed member
+                # (they shared the executable invocation).
+                dur = time.monotonic() - t_adapt
+                for i in misses:
+                    reqtrace.record_span(group[i].trace,
+                                         reqtrace.SPAN_ADAPT, t_adapt,
+                                         dur, batched=len(misses))
             for j, i in enumerate(misses):
                 entry = jax.tree.map(lambda x, j=j: x[j], adapted)
                 entries[i] = entry
@@ -535,9 +588,15 @@ class ServingEngine:
                     except Exception:
                         reg.counter("resilience/cache_errors").inc()
 
+        t_predict = time.monotonic()
         logits = self._run_predict([entries[i] for i in range(len(group))],
                                    group, bucket)
         t_done = time.monotonic()
+        if tracing:
+            for req in group:
+                reqtrace.record_span(req.trace, reqtrace.SPAN_PREDICT,
+                                     t_predict, t_done - t_predict,
+                                     batched=len(group))
         for i, req in enumerate(group):
             lg = np.asarray(logits[i, :req.num_query])
             reg.counter("serve/responses_total").inc()
@@ -932,7 +991,12 @@ class ServingEngine:
                       **extra: Any) -> Dict[str, Any]:
         """One ``metrics`` row carrying the full serve/* snapshot —
         the row scripts/telemetry_report.py keys its "serving" section
-        on."""
+        on. When request tracing is on, the engine-owned span ring
+        drains into the same stream first (one ``request_trace`` row per
+        span, stamped with the same ``extra`` fields — so a replica's
+        spans carry its replica id)."""
         self._mirror_cache_counters()
         self.registry.gauge("serve/queue_depth").set(self.batcher.depth)
+        if self._reqtrace_ring is not None:
+            self._reqtrace_ring.flush(jsonl, **extra)
         return self.registry.flush_jsonl(jsonl, **extra)

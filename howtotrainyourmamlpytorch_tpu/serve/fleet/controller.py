@@ -48,9 +48,12 @@ router process hosts the controller).
 from __future__ import annotations
 
 import json
+import math
 import os
+import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 ROLLOUT_FILE = "ROLLOUT.json"
 ROLLOUT_SCHEMA = "maml_fleet_rollout_v1"
@@ -67,6 +70,9 @@ HALTS_COUNTER = "fleet/rolling_swap_halts"
 QUEUE_GAUGE = "fleet/queue_depth_total"
 P95_GAUGE = "fleet/p95_ms_max"
 HIT_FRAC_GAUGE = "fleet/cache_hit_frac_min"
+SLO_BURN_GAUGE = "fleet/slo_burn_rate"
+SLO_GOOD_COUNTER = "fleet/slo_good_total"
+SLO_BAD_COUNTER = "fleet/slo_bad_total"
 
 # Replica-side aggregate counters re-published fleet-wide (summed over
 # replica payloads, delta-accumulated so the controller's counters stay
@@ -93,6 +99,120 @@ def _atomic_write_json(path: str, obj: Any) -> None:
     os.replace(tmp, path)
 
 
+def _nearest_rank(sorted_values: List[float], q: float) -> float:
+    # utils/tracing.py § nearest_rank, re-implemented (no package
+    # imports — the one pinned quantile definition, PR-1's p95 fix).
+    if not sorted_values:
+        raise ValueError("nearest_rank of an empty sequence")
+    if not 0 < q <= 1:
+        raise ValueError(f"quantile {q} outside (0, 1]")
+    return sorted_values[max(0, math.ceil(q * len(sorted_values)) - 1)]
+
+
+class SLOLedger:
+    """Per-tenant rolling good/bad request windows against a latency SLO.
+
+    Each observed request is judged against ``slo_p95_ms`` (good = at or
+    under) into a per-tenant rolling window of the last ``window``
+    requests.  The headline signal is the **burn rate**:
+
+        burn = bad_fraction / (1 - target_frac)
+
+    — the SRE error-budget convention: 1.0 means the fleet is spending
+    its error budget exactly as fast as the SLO allows; 2.0 means the
+    budget burns at twice the sustainable rate (scale up); well under
+    1.0 means latency headroom (scale-down is safe).  Feeding
+    :func:`advise` this instead of raw queue depth makes autoscaling
+    SLO-derived: queue depth says the fleet is busy, burn rate says the
+    USERS are hurting.
+
+    Thread-safe (the driver's response callbacks observe concurrently);
+    stdlib-only.  ``registry`` is the metrics-registry duck — when it
+    also has ``histogram`` (the real MetricsRegistry, or the bench's
+    mini duck), per-tenant latency histograms land under
+    ``fleet/tenant/<t>/latency_ms`` so flush rows carry the per-tenant
+    tail, reset-aware like every other counter stream.
+    """
+
+    def __init__(self, *, slo_p95_ms: float, target_frac: float,
+                 window: int = 512, registry: Optional[Any] = None):
+        if slo_p95_ms <= 0:
+            raise ValueError(f"slo_p95_ms must be > 0, got {slo_p95_ms}")
+        if not 0.0 < target_frac < 1.0:
+            raise ValueError(
+                f"target_frac must be in (0, 1), got {target_frac}")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.slo_p95_ms = float(slo_p95_ms)
+        self.target_frac = float(target_frac)
+        self.window = int(window)
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, deque] = {}
+        if registry is not None:
+            registry.counter(SLO_GOOD_COUNTER)
+            registry.counter(SLO_BAD_COUNTER)
+
+    def observe(self, tenant: Any, latency_ms: float) -> bool:
+        """Record one completed request; returns whether it met the SLO."""
+        latency_ms = float(latency_ms)
+        ok = latency_ms <= self.slo_p95_ms
+        tenant = str(tenant)
+        with self._lock:
+            window = self._tenants.get(tenant)
+            if window is None:
+                window = self._tenants[tenant] = deque(maxlen=self.window)
+            window.append((latency_ms, ok))
+        reg = self.registry
+        if reg is not None:
+            reg.counter(SLO_GOOD_COUNTER if ok else SLO_BAD_COUNTER).inc()
+            if hasattr(reg, "histogram"):
+                reg.histogram(f"fleet/tenant/{tenant}/latency_ms").observe(
+                    latency_ms)
+            reg.gauge(SLO_BURN_GAUGE).set(self.burn_rate() or 0.0)
+        return ok
+
+    def _rows(self, tenant: Optional[str]) -> List[Tuple[float, bool]]:
+        if tenant is not None:
+            return list(self._tenants.get(str(tenant)) or ())
+        out: List[Tuple[float, bool]] = []
+        for window in self._tenants.values():
+            out.extend(window)
+        return out
+
+    def burn_rate(self, tenant: Optional[str] = None) -> Optional[float]:
+        """Error-budget burn rate over the rolling window(s); None when
+        nothing has been observed (an honest "no data", never a fake
+        0 — advise() treats None as "no SLO signal")."""
+        with self._lock:
+            rows = self._rows(tenant)
+        if not rows:
+            return None
+        bad_frac = sum(1 for _, ok in rows if not ok) / len(rows)
+        return bad_frac / (1.0 - self.target_frac)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant ledger view: window count, bad fraction, burn
+        rate, and EXACT nearest-rank p50/p95/p99 latency from the
+        window (the window holds raw values, so no bucket error)."""
+        with self._lock:
+            tenants = {t: list(w) for t, w in self._tenants.items()}
+        out: Dict[str, Dict[str, Any]] = {}
+        for t, rows in sorted(tenants.items()):
+            lat = sorted(ms for ms, _ in rows)
+            bad = sum(1 for _, ok in rows if not ok)
+            out[t] = {
+                "count": len(rows),
+                "bad_frac": bad / len(rows) if rows else 0.0,
+                "burn_rate": ((bad / len(rows))
+                              / (1.0 - self.target_frac) if rows else 0.0),
+                "p50_ms": _nearest_rank(lat, 0.50) if lat else None,
+                "p95_ms": _nearest_rank(lat, 0.95) if lat else None,
+                "p99_ms": _nearest_rank(lat, 0.99) if lat else None,
+            }
+        return out
+
+
 class FleetController:
     """Rolling-swap driver + fleet signal aggregator.
 
@@ -107,13 +227,23 @@ class FleetController:
     def __init__(self, fleet_dir: str,
                  members: Callable[[], Dict[int, Dict[str, Any]]],
                  *, registry: Optional[Any] = None,
-                 step_stall_timeout_s: float = 600.0):
+                 step_stall_timeout_s: float = 600.0,
+                 slo_p95_ms: float = 2000.0,
+                 slo_target_frac: float = 0.95):
         self.fleet_dir = fleet_dir
         self.members = members
         self.registry = registry
         self.step_stall_timeout_s = float(step_stall_timeout_s)
         self.rollout_path = os.path.join(fleet_dir, ROLLOUT_FILE)
         self._agg_prev: Dict[str, Dict[int, float]] = {}
+        # SLO ledger (config: fleet_slo_p95_ms / fleet_slo_target_frac):
+        # whoever observes completed requests — the bench driver, a real
+        # frontend — calls controller.slo.observe(tenant, latency_ms);
+        # publish_signals folds the burn rate into the signal dict
+        # advise() reads.
+        self.slo = SLOLedger(slo_p95_ms=slo_p95_ms,
+                             target_frac=slo_target_frac,
+                             registry=registry)
         if registry is not None:
             for name in (SWAPS_COUNTER, SWAP_STEPS_COUNTER, HALTS_COUNTER):
                 registry.counter(name)
@@ -299,17 +429,20 @@ class FleetController:
                 delta = float(v) if v < p else float(v) - p
                 prev[rid] = float(v)
                 sums[label] += delta
+        burn = self.slo.burn_rate()
         if self.registry is not None:
             self.registry.gauge(QUEUE_GAUGE).set(queue_total)
             if p95_max is not None:
                 self.registry.gauge(P95_GAUGE).set(p95_max)
             if hit_min is not None:
                 self.registry.gauge(HIT_FRAC_GAUGE).set(hit_min)
+            if burn is not None:
+                self.registry.gauge(SLO_BURN_GAUGE).set(burn)
             for label, name in _AGG_COUNTERS.items():
                 if sums[label] > 0:
                     self.registry.counter(name).inc(sums[label])
         return {"queue_depth_total": queue_total, "p95_ms_max": p95_max,
-                "cache_hit_frac_min": hit_min,
+                "cache_hit_frac_min": hit_min, "slo_burn_rate": burn,
                 **{k: sums[k] for k in _AGG_COUNTERS}}
 
 
@@ -317,18 +450,34 @@ def advise(signals: Dict[str, Any], *, live: int,
            queue_per_replica_high: float = 32.0,
            p95_high_ms: float = 2000.0,
            queue_per_replica_low: float = 1.0,
-           min_replicas: int = 1) -> str:
+           min_replicas: int = 1,
+           burn_rate_high: float = 2.0,
+           burn_rate_low: float = 0.25) -> str:
     """Pure autoscale verdict from one signal snapshot: ``scale_up``
-    when queueing or tail latency says the fleet is behind,
-    ``scale_down`` when it is idle beyond the floor, else ``hold``.
-    Deliberately a function, not a loop — the operator (or bench)
-    decides what to do with the advice."""
+    when queueing, tail latency or the SLO burn rate says the fleet is
+    behind, ``scale_down`` when it is idle beyond the floor AND the
+    error budget has headroom, else ``hold``. Deliberately a function,
+    not a loop — the operator (or bench) decides what to do with the
+    advice.
+
+    The burn-rate clauses make the verdict SLO-derived: a burn rate at
+    or past ``burn_rate_high`` scales up even with short queues (slow
+    replicas hurt users without queueing), and a scale-down is vetoed
+    while burn exceeds ``burn_rate_low`` (shrinking a fleet that is
+    already spending error budget is how outages start). A snapshot
+    with no SLO signal (``slo_burn_rate`` absent or None — no ledger,
+    or nothing observed yet) behaves exactly as before the ledger
+    existed."""
     live = max(int(live), 1)
     per = float(signals.get("queue_depth_total") or 0.0) / live
     p95 = signals.get("p95_ms_max")
+    burn = signals.get("slo_burn_rate")
+    has_burn = isinstance(burn, (int, float))
     if per >= queue_per_replica_high or (
-            isinstance(p95, (int, float)) and p95 >= p95_high_ms):
+            isinstance(p95, (int, float)) and p95 >= p95_high_ms) or (
+            has_burn and burn >= burn_rate_high):
         return "scale_up"
-    if per <= queue_per_replica_low and live > max(min_replicas, 1):
+    if (per <= queue_per_replica_low and live > max(min_replicas, 1)
+            and (not has_burn or burn <= burn_rate_low)):
         return "scale_down"
     return "hold"

@@ -122,8 +122,8 @@ class ReplicaServer:
         self.sock.listen(16)
         self.port = self.sock.getsockname()[1]
         self.running = True
-        # req_id -> (conn, caller_id)
-        self._pending: Dict[int, Tuple[Any, Any]] = {}
+        # req_id -> (conn, caller_id, trace ctx or None)
+        self._pending: Dict[int, Tuple[Any, Any, Any]] = {}
         self._pending_lock = threading.Lock()
         # Per-connection send locks, weakly keyed on the socket itself:
         # entries die with their connection (no manual cleanup, no
@@ -166,13 +166,24 @@ class ReplicaServer:
     def _submit(self, conn, msg: Dict[str, Any]) -> None:
         from howtotrainyourmamlpytorch_tpu.serve import FewShotRequest
         caller_id = msg.get("id")
+        trace = msg.get("trace")
         try:
             req = FewShotRequest(
                 support_x=msg["support_x"], support_y=msg["support_y"],
-                query_x=msg["query_x"], deadline=msg.get("deadline"))
+                query_x=msg["query_x"], deadline=msg.get("deadline"),
+                trace=trace)
             with self._pending_lock:
-                self._pending[req.request_id] = (conn, caller_id)
+                self._pending[req.request_id] = (conn, caller_id, trace)
             try:
+                if trace is not None and trace.get("recv_t") is not None:
+                    # Socket-queue span: frame received (recv_msg's
+                    # stamp, this process's clock) -> engine admission.
+                    rt = fleet_router.reqtrace_mod()
+                    t_sub = time.monotonic()
+                    rt.record_span(trace, rt.SPAN_SOCKET_QUEUE,
+                                   trace["recv_t"],
+                                   t_sub - trace["recv_t"],
+                                   replica=self.replica_id)
                 self.engine.submit(req)
             except Exception as e:
                 with self._pending_lock:
@@ -180,11 +191,14 @@ class ReplicaServer:
                 raise e
         except Exception as e:  # noqa: BLE001 — a bad/overflow request
             # answers THAT caller; the serve loop never sees it.
-            self._send(conn, {
+            resp = {
                 "op": "response", "id": caller_id, "predictions": None,
                 "cache_hit": False, "cache_tier": None, "latency_s": 0.0,
                 "error": f"rejected: {type(e).__name__}",
-                "replica": self.replica_id})
+                "replica": self.replica_id}
+            if trace is not None:
+                resp["trace"] = trace
+            self._send(conn, resp)
 
     def _acceptor(self) -> None:
         while self.running:
@@ -312,15 +326,29 @@ class ReplicaServer:
                     dest = self._pending.pop(resp.request_id, None)
                 if dest is None:
                     continue
-                conn, caller_id = dest
-                self._send(conn, {
+                conn, caller_id, trace = dest
+                out = {
                     "op": "response", "id": caller_id,
                     "predictions": (None if resp.predictions is None
                                     else np.asarray(resp.predictions)),
                     "cache_hit": resp.cache_hit,
                     "cache_tier": resp.cache_tier,
                     "latency_s": resp.latency_seconds,
-                    "error": resp.error, "replica": self.replica_id})
+                    "error": resp.error, "replica": self.replica_id}
+                if trace is not None:
+                    # The context rides the response too: the send
+                    # itself records wire_send here, the driver's
+                    # recv_msg records wire_recv on its side.
+                    out["trace"] = trace
+                    t_resp = time.monotonic()
+                    self._send(conn, out)
+                    rt = fleet_router.reqtrace_mod()
+                    rt.record_span(trace, rt.SPAN_RESPOND, t_resp,
+                                   time.monotonic() - t_resp,
+                                   replica=self.replica_id,
+                                   tier=resp.cache_tier or "miss")
+                else:
+                    self._send(conn, out)
             draining = os.path.exists(
                 fleet_router.drain_path(self.fleet_dir, self.replica_id))
             if draining:

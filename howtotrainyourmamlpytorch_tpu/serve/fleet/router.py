@@ -62,6 +62,40 @@ LEASE_PREFIX = "replica_"
 LEASE_SUFFIX = ".lease"
 DRAIN_SUFFIX = ".drain"
 
+# -- request tracing (telemetry/reqtrace.py), resolved lazily ------------
+# This module must stay loadable by file path with no package imports
+# (the jax-free frontend contract above), but its spans must land in the
+# SAME per-process ring the engine installs. Resolution order:
+# 1. the package copy already in sys.modules — replica processes import
+#    the engine (which imports reqtrace) before this module runs a
+#    traced request, so they always share the engine's module object and
+#    with it the installed ring;
+# 2. a file-path load of ../../telemetry/reqtrace.py under a private
+#    name — the jax-free driver path (telemetry/__init__ imports health
+#    which imports jax, so the package route is closed to it). The
+#    driver reaches the same object via reqtrace_mod() to mint/install.
+_REQTRACE_PKG = "howtotrainyourmamlpytorch_tpu.telemetry.reqtrace"
+_reqtrace_cached: Optional[Any] = None
+
+
+def reqtrace_mod() -> Any:
+    """The process's request-trace module (shared object — see above)."""
+    global _reqtrace_cached
+    if _reqtrace_cached is None:
+        import sys
+        mod = sys.modules.get(_REQTRACE_PKG)
+        if mod is None:
+            import importlib.util
+            path = os.path.abspath(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                os.pardir, os.pardir, "telemetry", "reqtrace.py"))
+            spec = importlib.util.spec_from_file_location(
+                "_maml_fleet_reqtrace", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        _reqtrace_cached = mod
+    return _reqtrace_cached
+
 LIVE = "live"
 STALLED = "stalled"
 DEAD = "dead"
@@ -363,18 +397,27 @@ class FleetRouter:
             return self._in_flight.get(int(replica_id), 0)
 
     # -- routing ----------------------------------------------------------
-    def route(self, key: str) -> Optional[int]:
+    def route(self, key: str,
+              ctx: Optional[Dict[str, Any]] = None) -> Optional[int]:
         """Pick the replica for ``key``: the ring primary unless it is
         past its bounded-load capacity, else the next ring position
         (counted as a spill), else — everyone saturated — the
         least-loaded routable replica (affinity yields to liveness).
-        None (counted) when the ring is empty."""
+        None (counted) when the ring is empty. ``ctx`` is an optional
+        request-trace context — a sampled request records a ``route``
+        span carrying the pick and whether it spilled."""
         reg = self.registry
+        t0 = time.monotonic() if ctx is not None else 0.0
         with self._lock:
             cands = self.ring.candidates(key)
             if not cands:
                 if reg is not None:
                     reg.counter(NO_REPLICA_COUNTER).inc()
+                if ctx is not None:
+                    rt = reqtrace_mod()
+                    rt.record_span(ctx, rt.SPAN_ROUTE, t0,
+                                   time.monotonic() - t0, replica=None,
+                                   spilled=False)
                 return None
             total = sum(self._in_flight.get(r, 0) for r in cands)
             cap = math.ceil(self.load_factor * (total + 1) / len(cands))
@@ -393,6 +436,11 @@ class FleetRouter:
             reg.counter(REQUESTS_COUNTER).inc()
             if spilled:
                 reg.counter(SPILLS_COUNTER).inc()
+        if ctx is not None:
+            rt = reqtrace_mod()
+            rt.record_span(ctx, rt.SPAN_ROUTE, t0,
+                           time.monotonic() - t0, replica=chosen,
+                           spilled=bool(spilled))
         return chosen
 
     def complete(self, replica_id: int) -> None:
@@ -419,8 +467,17 @@ MAX_FRAME_BYTES = 1 << 28  # 256 MiB: no sane request is bigger
 
 
 def send_msg(sock, obj: Any) -> None:
+    # Sampled requests carry their trace context as an optional "trace"
+    # key (omitted entirely when unsampled — rate=0 wire bytes are
+    # byte-identical to untraced builds); the send itself is a span.
+    ctx = obj.get("trace") if isinstance(obj, dict) else None
+    t0 = time.monotonic() if ctx is not None else 0.0
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(WIRE_MAGIC + _LEN.pack(len(payload)) + payload)
+    if ctx is not None:
+        rt = reqtrace_mod()
+        rt.record_span(ctx, rt.SPAN_WIRE_SEND, t0,
+                       time.monotonic() - t0, frame_bytes=len(payload))
 
 
 def _recv_exact(sock, n: int) -> bytes:
@@ -440,4 +497,20 @@ def recv_msg(sock) -> Any:
     (length,) = _LEN.unpack(head[len(WIRE_MAGIC):])
     if length > MAX_FRAME_BYTES:
         raise ConnectionError(f"frame of {length} bytes exceeds cap")
-    return pickle.loads(_recv_exact(sock, length))
+    # The wire_recv span starts AFTER the head arrives: reader threads
+    # park in the blocking head read between requests, and that idle
+    # time is not wire time. Whether the frame was sampled is only
+    # knowable after unpickling, so the clock reads are unconditional
+    # (two monotonic calls; no allocation when untraced).
+    t0 = time.monotonic()
+    msg = pickle.loads(_recv_exact(sock, length))
+    ctx = msg.get("trace") if isinstance(msg, dict) else None
+    if ctx is not None:
+        t1 = time.monotonic()
+        rt = reqtrace_mod()
+        rt.record_span(ctx, rt.SPAN_WIRE_RECV, t0, t1 - t0,
+                       frame_bytes=length)
+        # Receipt instant for the receiver's queue span (replica reader:
+        # recv -> engine submit) — local monotonic time, this process.
+        ctx["recv_t"] = t1
+    return msg

@@ -39,8 +39,16 @@ from typing import Any, Dict, List, Optional, Union
 # sweep-driver segments (a killed-and-resumed sweep spans processes by
 # design) cross-checked against the explicit tune_trial rows; best
 # objective the max over ok rows; adopted-vs-rejected verdict and the
-# tuned fingerprint last-signal from the tune_adopt row)
-SCHEMA = "maml_tpu_telemetry_report_v13"
+# tuned fingerprint last-signal from the tune_adopt row);
+# v14: + "requests" (request tracing + SLO ledger,
+# telemetry/reqtrace.py + serve/fleet/controller.py: span/drop
+# counters reset-aware per `replica` source like the fleet section —
+# one log interleaves several replicas' flushes plus the driver's —
+# cross-checked against the explicit request_trace rows, which are
+# assembled into traces for the linked fraction, dominant latency
+# tier and tenant count; SLO good/bad totals reset-aware, burn-rate
+# gauge last-wins)
+SCHEMA = "maml_tpu_telemetry_report_v14"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -72,6 +80,27 @@ def _accumulate_counter(totals: Dict[str, float],
     totals[key] = totals.get(key, 0.0) + (value if value < p
                                           else value - p)
     prev[key] = value
+
+
+def _reqtrace():
+    """telemetry/reqtrace.py — the one definition of trace assembly /
+    "linked" / tier attribution. Resolved lazily: the package copy when
+    it is already imported, else a file-path load from this module's
+    own directory (this module must stay importable by file path on a
+    jax-free login node, and reqtrace.py honors the same contract)."""
+    import sys
+    mod = sys.modules.get("howtotrainyourmamlpytorch_tpu.telemetry"
+                          ".reqtrace")
+    if mod is None:
+        import importlib.util
+        import os
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "reqtrace.py")
+        spec = importlib.util.spec_from_file_location(
+            "_report_reqtrace_impl", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    return mod
 
 
 def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
@@ -734,6 +763,85 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "tuned_fingerprint": tn_fingerprint,
         }
 
+    # Requests section (telemetry/reqtrace.py + the controller's SLO
+    # ledger, schema v14): reqtrace/* span counters ride registry
+    # "metrics" rows from every traced process — replicas AND the
+    # jax-free driver — so, like the fleet section, reset tracking is
+    # keyed per (`replica` source, metric); the explicit request_trace
+    # rows are the cross-check AND the raw material: assembled into
+    # traces they yield the linked fraction (causally-complete span
+    # sets), the dominant latency tier and the tenant population. SLO
+    # good/bad totals accumulate reset-aware; the burn-rate gauge takes
+    # the most recent signal. Untraced runs summarize to "unavailable".
+    _RQ_COUNTERS = {
+        "spans": "reqtrace/spans",
+        "dropped": "reqtrace/dropped",
+        "slo_good": "fleet/slo_good_total",
+        "slo_bad": "fleet/slo_bad_total",
+    }
+    rq_totals: Dict[str, float] = {}
+    rq_prev: Dict[str, float] = {}
+    rq_seen = False
+    rq_burn: Metric = UNAVAILABLE
+    rq_rows: List[Dict[str, Any]] = []
+    for e in events:
+        if e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if not any(k.startswith("reqtrace/")
+                       or k in ("fleet/slo_good_total",
+                                "fleet/slo_bad_total",
+                                "fleet/slo_burn_rate") for k in m):
+                continue
+            rq_seen = True
+            source = str(e.get("replica", ""))
+            for key in _RQ_COUNTERS.values():
+                if m.get(key) is not None:
+                    _accumulate_counter(rq_totals, rq_prev,
+                                        f"{source}:{key}",
+                                        float(m[key]))
+            if m.get("fleet/slo_burn_rate") is not None:
+                rq_burn = round(float(m["fleet/slo_burn_rate"]), 4)
+        elif e.get("event") == "request_trace":
+            rq_seen = True
+            rq_rows.append(e)
+    requests_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if rq_seen:
+        def _rq_total(key: str) -> float:
+            return sum(v for k, v in rq_totals.items()
+                       if k.split(":", 1)[1] == key)
+
+        rq = _reqtrace()
+        rq_traces = rq.assemble(rq_rows)
+        rq_linked = sum(1 for t in rq_traces.values() if rq.linked(t))
+        rq_tiers = {tier: 0.0 for tier in rq.TIERS}
+        for t in rq_traces.values():
+            if rq.linked(t):
+                attr = rq.attribute(t)
+                for tier in rq.TIERS:
+                    rq_tiers[tier] += attr[tier]
+        good = _rq_total("fleet/slo_good_total")
+        bad = _rq_total("fleet/slo_bad_total")
+        requests_sec = {
+            "spans_recorded": max(int(_rq_total("reqtrace/spans")),
+                                  len(rq_rows)),
+            "spans_dropped": int(_rq_total("reqtrace/dropped")),
+            "trace_rows": len(rq_rows),
+            "traces": len(rq_traces),
+            "linked": rq_linked,
+            "linked_frac": (round(rq_linked / len(rq_traces), 4)
+                            if rq_traces else UNAVAILABLE),
+            "dominant_tier": (max(rq.TIERS,
+                                  key=lambda k: rq_tiers[k])
+                              if rq_linked else UNAVAILABLE),
+            "tenants": len({t["tenant"] for t in rq_traces.values()
+                            if t["tenant"]}),
+            "slo_good": int(good),
+            "slo_bad": int(bad),
+            "slo_bad_frac": (round(bad / (good + bad), 4)
+                             if good + bad > 0 else UNAVAILABLE),
+            "slo_burn_rate": rq_burn,
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -774,6 +882,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "fleet": fleet_sec,
         "perf": perf_sec,
         "tune": tune_sec,
+        "requests": requests_sec,
     }
 
 
@@ -812,6 +921,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("fleet", summary["fleet"]),
         ("perf", summary["perf"]),
         ("tune", summary["tune"]),
+        ("requests", summary["requests"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

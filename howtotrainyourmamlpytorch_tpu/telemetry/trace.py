@@ -21,6 +21,12 @@ the Trace Event Profiling Tool spec), loadable in Perfetto
   straggler's rising progress age is visible at a glance), and ``"i"``
   markers for checkpoints, rewinds, preemptions, watchdog trips and
   grad-norm warnings.
+* **request_trace rows** (``telemetry/reqtrace.py``) become the request
+  lane: per-hop ``"X"`` spans on :data:`REQUEST_TID` keyed by the
+  REAL OS pid (router and replicas render as distinct processes), plus
+  one Chrome flow ``"s"``/``"f"`` arrow per trace stitching the
+  router-side ``wire_send`` end to the replica-side ``socket_queue``
+  start — following one request across processes is a click.
 
 Track layout: ``pid`` = host (process index), ``tid`` = phase class
 (:data:`PHASE_TIDS`), so a pod renders as one row of phase lanes per
@@ -60,6 +66,7 @@ HEARTBEAT_TID = 8   # per-host heartbeat markers
 MARKER_TID = 9      # instant markers (checkpoints, trips, faults, ...)
 _UNKNOWN_TID = 10   # future phase names degrade here, never crash
 PROFILE_TID = 11    # perf-lab sampled windows (telemetry/profiler.py)
+REQUEST_TID = 12    # request-trace spans (telemetry/reqtrace.py)
 
 # events.jsonl rows rendered as instant markers on the marker lane.
 _INSTANT_EVENTS = (
@@ -67,7 +74,7 @@ _INSTANT_EVENTS = (
     "validation", "health_grad_norm_warn",
 )
 
-_VALID_PH = {"B", "E", "X", "i"}
+_VALID_PH = {"B", "E", "X", "i", "s", "f"}
 
 
 def _us(ts: Any) -> int:
@@ -132,10 +139,52 @@ def spans_from_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     spans, per-host heartbeat markers (``pid`` = host index from the
     gathered vectors), and instant markers for the run-lifecycle rows."""
     out: List[Dict[str, Any]] = []
+    # Flow anchors for the request lane: per trace_id, the router-side
+    # wire_send END and the replica-side socket_queue START. One s/f
+    # pair per trace draws the cross-process arrow in Perfetto. Each
+    # anchor keeps the EARLIEST such span (keyed on start ts): the
+    # request-direction wire_send precedes the response-direction one,
+    # and rows arrive in whatever order the events files concatenate.
+    flow_send: Dict[str, tuple] = {}    # trace_id -> (start, ts_us, pid)
+    flow_recv: Dict[str, tuple] = {}    # trace_id -> (start, ts_us, pid)
     for row in events:
         event = row.get("event")
         ts = row.get("ts")
         if ts is None:
+            continue
+        if (event == "request_trace"
+                and isinstance(row.get("ts_start"), (int, float))
+                and isinstance(row.get("dur_s"), (int, float))
+                and row["dur_s"] >= 0):
+            # Request-trace spans keep their REAL OS pid: the router and
+            # each replica render as distinct process tracks, and the
+            # flow arrows below stitch one request across them. The
+            # span's epoch start rides in ts_start (NOT ts — the logger
+            # stamps ts at write time, i.e. at ring flush).
+            span_ts = _us(row["ts_start"])
+            span_pid = int(row.get("pid") or 0)
+            out.append({
+                "name": str(row.get("name") or "span"), "cat": "request",
+                "ph": "X", "ts": span_ts,
+                "dur": max(_us(row["dur_s"]), 1),
+                "pid": span_pid, "tid": REQUEST_TID,
+                "args": _args(row, skip=("ts", "event", "ts_start",
+                                         "dur_s", "t_mono", "pid",
+                                         "name")),
+            })
+            tid_ = row.get("trace_id")
+            if isinstance(tid_, str) and tid_:
+                if row.get("name") == "wire_send":
+                    cur = flow_send.get(tid_)
+                    if cur is None or span_ts < cur[0]:
+                        flow_send[tid_] = (
+                            span_ts,
+                            span_ts + max(_us(row["dur_s"]), 1),
+                            span_pid)
+                elif row.get("name") == "socket_queue":
+                    cur = flow_recv.get(tid_)
+                    if cur is None or span_ts < cur[0]:
+                        flow_recv[tid_] = (span_ts, span_ts, span_pid)
             continue
         if (event == "train_epoch"
                 and isinstance(row.get("epoch_seconds"), (int, float))
@@ -192,6 +241,20 @@ def spans_from_events(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
                 "tid": MARKER_TID, "s": "t",
                 "args": _args(row, skip=("ts", "event")),
             })
+    # One flow arrow per trace: wire_send end (router pid) ->
+    # socket_queue start (replica pid). Emitted only when BOTH anchors
+    # exist in different processes — an arrow inside one pid is noise.
+    for trace_id, (_, s_ts, s_pid) in flow_send.items():
+        anchor = flow_recv.get(trace_id)
+        if anchor is None or anchor[2] == s_pid:
+            continue
+        _, f_ts, f_pid = anchor
+        out.append({"name": "request", "cat": "request", "ph": "s",
+                    "id": trace_id, "ts": s_ts, "pid": s_pid,
+                    "tid": REQUEST_TID, "args": {}})
+        out.append({"name": "request", "cat": "request", "ph": "f",
+                    "bp": "e", "id": trace_id, "ts": f_ts, "pid": f_pid,
+                    "tid": REQUEST_TID, "args": {}})
     return out
 
 
@@ -230,9 +293,10 @@ def trace_stats(trace: Dict[str, Any]) -> Dict[str, Any]:
 
 def validate_trace(trace: Dict[str, Any]) -> None:
     """Raise ValueError unless ``trace`` is schema-valid: every event
-    has ``ph`` ∈ {B, E, X, i} with int ``ts``/``pid``/``tid``, X spans
-    carry positive ``dur``, and each (pid, tid) track's timestamps are
-    monotone. The test suite's (and CI's) single validity gate."""
+    has ``ph`` ∈ {B, E, X, i, s, f} with int ``ts``/``pid``/``tid``, X
+    spans carry positive ``dur``, flow events (s/f) carry an ``id`` and
+    no ``dur``, and each (pid, tid) track's timestamps are monotone.
+    The test suite's (and CI's) single validity gate."""
     rows = trace.get("traceEvents")
     if not isinstance(rows, list):
         raise ValueError("trace has no traceEvents list")
@@ -246,6 +310,11 @@ def validate_trace(trace: Dict[str, Any]) -> None:
         if e["ph"] == "X" and not (isinstance(e.get("dur"), int)
                                    and e["dur"] > 0):
             raise ValueError(f"event {i}: X span without positive dur")
+        if e["ph"] in ("s", "f"):
+            if not isinstance(e.get("id"), (str, int)):
+                raise ValueError(f"event {i}: flow event without id")
+            if "dur" in e:
+                raise ValueError(f"event {i}: flow event carries dur")
         if not e.get("name"):
             raise ValueError(f"event {i}: missing name")
         track = (e["pid"], e["tid"])

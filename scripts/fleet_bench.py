@@ -44,6 +44,8 @@ Usage:
     python scripts/fleet_bench.py --quick            # 2-replica CI smoke
     python scripts/fleet_bench.py                    # full 3-replica proof
     python scripts/fleet_bench.py --replicas 4 --requests 600 --out /tmp/fb
+    python scripts/fleet_bench.py --quick --trace-sample-rate 1.0 \
+        --out /tmp/fb   # request tracing on; then scripts/slo_report.py /tmp/fb
 """
 
 from __future__ import annotations
@@ -51,7 +53,6 @@ from __future__ import annotations
 import argparse
 import importlib.util
 import json
-import math
 import os
 import shutil
 import socket
@@ -87,6 +88,10 @@ _controller_mod = _load_module(
     "_fleet_bench_controller_impl",
     os.path.join("howtotrainyourmamlpytorch_tpu", "serve", "fleet",
                  "controller.py"))
+_tracing_mod = _load_module(
+    "_fleet_bench_tracing_impl",
+    os.path.join("howtotrainyourmamlpytorch_tpu", "utils", "tracing.py"))
+
 
 def bench_bucket(quick: bool):
     """(support, query) bucket: the full profile serves 3-way 5-shot
@@ -99,8 +104,8 @@ def bench_bucket(quick: bool):
 
 class _MiniMetrics:
     """Duck-typed stand-in for the telemetry MetricsRegistry (whose
-    import chain pulls jax — this driver must not): counters and
-    gauges only, snapshot()-able into the artifact."""
+    import chain pulls jax — this driver must not): counters, gauges
+    and exact-value histograms, snapshot()-able into the artifact."""
 
     class _C:
         def __init__(self):
@@ -116,6 +121,27 @@ class _MiniMetrics:
         def set(self, v):
             self.value = float(v)
 
+    class _H:
+        # Exact values (the driver sees hundreds of requests, not
+        # millions), nearest-rank quantiles — no bucket error.
+        def __init__(self):
+            self.values: List[float] = []
+
+        def observe(self, v):
+            self.values.append(float(v))
+
+        def quantile(self, q):
+            if not self.values:
+                return None
+            return _tracing_mod.nearest_rank(sorted(self.values), q)
+
+        @property
+        def value(self):
+            return {"count": len(self.values),
+                    "sum": round(sum(self.values), 6),
+                    "p50": self.quantile(0.50),
+                    "p95": self.quantile(0.95)}
+
     def __init__(self):
         self._m: Dict[str, Any] = {}
 
@@ -124,6 +150,9 @@ class _MiniMetrics:
 
     def gauge(self, name):
         return self._m.setdefault(name, self._G())
+
+    def histogram(self, name):
+        return self._m.setdefault(name, self._H())
 
     def snapshot(self):
         return {k: v.value for k, v in sorted(self._m.items())}
@@ -139,7 +168,7 @@ def _can_bind_localhost() -> bool:
 
 
 def fleet_cfg_dict(out_dir: str, *, quick: bool, l1_capacity: int,
-                   l2_dir: str) -> dict:
+                   l2_dir: str, trace_sample_rate: float = 0.0) -> dict:
     """The serving workload every process in the bench shares.
 
     The full profile runs a REALISTICALLY-priced adaptation (20x20
@@ -193,6 +222,12 @@ def fleet_cfg_dict(out_dir: str, *, quick: bool, l1_capacity: int,
         fleet_replica_dead_s=5.0,
         fleet_vnodes=128,
         fleet_load_factor=2.5,
+        # Request tracing + SLO ledger (telemetry/reqtrace.py): the
+        # replicas read the sample rate from this same json, so driver
+        # and engines make the identical head-based sampling decision.
+        reqtrace_sample_rate=float(trace_sample_rate),
+        fleet_slo_p95_ms=2000.0,
+        fleet_slo_target_frac=0.95,
         aot_store_dir=os.path.join(out_dir, "aot_store"),
         watchdog_serve_timeout_s=600.0)
 
@@ -431,16 +466,26 @@ def build_schedule(num_requests: int, num_tenants: int, seed: int,
 def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
               *, max_outstanding: int, controller=None,
               swap_trigger=None, max_retries: int = 20,
-              stall_timeout_s: float = 300.0) -> dict:
+              stall_timeout_s: float = 300.0, reqtrace=None,
+              sample_rate: float = 0.0, slo=None) -> dict:
     """Push the whole schedule through the fleet as fast as the window
     allows (backlog/throughput mode — the serve_bench rate=0 rule),
     pumping membership refresh, rollout ticks and the optional mid-load
-    swap trigger from the same loop a real frontend would run."""
+    swap trigger from the same loop a real frontend would run.
+
+    ``reqtrace`` (the module ``_router_mod.reqtrace_mod()`` returns —
+    same object the wire protocol records into) + ``sample_rate`` turn
+    on request tracing: each request mints its context ONCE (retries
+    keep the original trace — the root span covers the whole e2e
+    including rejection round-trips) and the root ``request`` span is
+    recorded when the final response lands.  ``slo`` is an optional
+    SLOLedger fed every completed request's e2e latency."""
     lock = threading.Lock()
     cond = threading.Condition(lock)
     results: Dict[int, dict] = {}
     rid_of: Dict[int, int] = {}
     send_ts: Dict[int, float] = {}
+    ctx_of: Dict[int, Any] = {}
     retry_q: deque = deque()
     retry_count: Dict[int, int] = {}
     state = {"outstanding": 0, "retries": 0}
@@ -456,9 +501,17 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
                 state["retries"] += 1
                 retry_q.append(cid)
             else:
-                msg["latency_s_e2e"] = time.monotonic() - send_ts[cid]
+                latency = time.monotonic() - send_ts[cid]
+                msg["latency_s_e2e"] = latency
                 msg["rid"] = rid
                 results[cid] = msg
+                if slo is not None:
+                    slo.observe(by_cid[cid]["tenant"], latency * 1e3)
+                ctx = ctx_of.get(cid)
+                if reqtrace is not None and ctx is not None:
+                    reqtrace.record_root(ctx, send_ts[cid], latency,
+                                         replica=rid,
+                                         error=bool(err))
             state["outstanding"] -= 1
             cond.notify()
 
@@ -511,7 +564,13 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
                     and state["outstanding"] < max_outstanding:
                 cid = retry_q.popleft() if retry_q else pending.popleft()
                 item = by_cid[cid]
-                rid = router.route(item["key"])
+                if reqtrace is not None and cid not in ctx_of:
+                    # Mint ONCE per request id: the head-based decision
+                    # and the trace id survive retries unchanged.
+                    ctx_of[cid] = reqtrace.mint(item["tenant"], cid,
+                                                sample_rate)
+                ctx = ctx_of.get(cid)
+                rid = router.route(item["key"], ctx)
                 if rid is None or rid not in conns:
                     if rid is not None:
                         router.complete(rid)
@@ -524,10 +583,15 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
                 sent_any = True
                 conn = conns[rid]
                 try:
-                    conn.send({"op": "serve", "id": cid,
-                               "support_x": item["sx"],
-                               "support_y": item["sy"],
-                               "query_x": item["qx"]})
+                    msg = {"op": "serve", "id": cid,
+                           "support_x": item["sx"],
+                           "support_y": item["sy"],
+                           "query_x": item["qx"]}
+                    if ctx is not None:
+                        # Unsampled requests carry NO trace key at all
+                        # (rate=0 wire bytes identical to pre-trace).
+                        msg["trace"] = ctx
+                    conn.send(msg)
                 except OSError:
                     # Replica vanished mid-send (SIGKILL class): undo
                     # the accounting and retry elsewhere after refresh.
@@ -550,13 +614,25 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
     ok = [r for r in results.values() if not r.get("error")]
     lat_ms = sorted(r["latency_s_e2e"] * 1e3 for r in ok)
 
-    def pct(q):
+    def pct(q, vals=lat_ms):
         # Nearest-rank, the repo's one pinned quantile definition
-        # (utils/tracing.py § nearest_rank, inlined: jax-free driver).
-        if not lat_ms:
+        # (utils/tracing.py § nearest_rank — file-path loaded, the
+        # jax-free driver rule).
+        if not vals:
             return None
-        rank = max(1, math.ceil(q * len(lat_ms)))
-        return round(lat_ms[rank - 1], 3)
+        return round(_tracing_mod.nearest_rank(vals, q), 3)
+
+    # Per-cache-tier latency split: WHERE a request's latency came from
+    # is tier-shaped (an L1 hit skips adapt entirely, a miss pays it).
+    tier_lat: Dict[str, List[float]] = {"l1": [], "l2": [], "miss": []}
+    for r in ok:
+        tier_lat[r.get("cache_tier") or "miss"].append(
+            r["latency_s_e2e"] * 1e3)
+    tier_latency_ms = {
+        tier: ({"count": len(vals), "p50_ms": pct(0.50, sorted(vals)),
+                "p95_ms": pct(0.95, sorted(vals)),
+                "p99_ms": pct(0.99, sorted(vals))} if vals else None)
+        for tier, vals in tier_lat.items()}
 
     tiers = [r.get("cache_tier") for r in ok]
     return {
@@ -565,7 +641,8 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
         "responses_ok": len(ok),
         "dropped": len(schedule) - len(ok),
         "rejected_retries": state["retries"],
-        "p50_ms": pct(0.50), "p95_ms": pct(0.95),
+        "p50_ms": pct(0.50), "p95_ms": pct(0.95), "p99_ms": pct(0.99),
+        "tier_latency_ms": tier_latency_ms,
         "l1_hit_frac": (round(tiers.count("l1") / len(ok), 4)
                         if ok else None),
         "l2_hit_frac": (round(tiers.count("l2") / len(ok), 4)
@@ -667,6 +744,16 @@ def run_leg(out, cfg_path, ckpt_dir, fleet_dir, ids, schedule, registry,
     dead = max(float(cfg_doc.get("fleet_replica_dead_s") or 0.0)
                or 6.0 * interval, stalled)
     os.makedirs(fleet_dir, exist_ok=True)
+    # Request tracing (telemetry/reqtrace.py): the driver's spans must
+    # land in the SAME module object the wire protocol records into, so
+    # the ring installs into _router_mod.reqtrace_mod() — never a
+    # second file-path copy.
+    rate = float(cfg_doc.get("reqtrace_sample_rate") or 0.0)
+    rt = _router_mod.reqtrace_mod() if rate > 0 else None
+    ring = prev_ring = None
+    if rt is not None:
+        ring = rt.SpanRing(capacity=16384, registry=registry)
+        prev_ring = rt.install(ring)
     procs = start_replicas(out, cfg_path, ckpt_dir, fleet_dir, ids)
     extras: Dict[str, Any] = {}
     conns: Dict[int, ReplicaConn] = {}
@@ -681,7 +768,11 @@ def run_leg(out, cfg_path, ckpt_dir, fleet_dir, ids, schedule, registry,
             stalled_after_s=stalled, dead_after_s=dead,
             registry=registry)
         controller = _controller_mod.FleetController(
-            fleet_dir, router.refresh, registry=registry)
+            fleet_dir, router.refresh, registry=registry,
+            slo_p95_ms=float(cfg_doc.get("fleet_slo_p95_ms")
+                             or 2000.0),
+            slo_target_frac=float(cfg_doc.get("fleet_slo_target_frac")
+                                  or 0.95))
         router.refresh()
 
         swap_trigger = None
@@ -709,7 +800,9 @@ def run_leg(out, cfg_path, ckpt_dir, fleet_dir, ids, schedule, registry,
                           max_outstanding=swap_spec["max_outstanding"]
                           if swap_spec else 4 * len(ids),
                           controller=controller,
-                          swap_trigger=swap_trigger)
+                          swap_trigger=swap_trigger,
+                          reqtrace=rt, sample_rate=rate,
+                          slo=controller.slo)
         if swap_spec is not None:
             # The publish child may still be landing when the load
             # drains (mid-load means it STARTED under load): wait for
@@ -742,9 +835,19 @@ def run_leg(out, cfg_path, ckpt_dir, fleet_dir, ids, schedule, registry,
                 per_replica[str(rid)] = {"error": str(e)}
         extras["advice"] = _controller_mod.advise(
             controller.publish_signals(), live=len(router.routable))
+        extras["slo"] = controller.slo.snapshot()
+        extras["slo_burn_rate"] = controller.slo.burn_rate()
         return stats, per_replica, extras
     finally:
         stop_replicas(conns, procs)
+        if rt is not None:
+            # Driver-side spans (route, wire both directions, roots)
+            # land next to the replicas' events files — slo_report.py
+            # and the linked-trace gate read the whole set.
+            ring.flush(_tracing_mod.JsonlLogger(
+                os.path.join(out, "events_driver.jsonl")),
+                phase="fleet_driver", replica="driver")
+            rt.install(prev_ring)
 
 
 def main(argv=None) -> int:
@@ -759,6 +862,10 @@ def main(argv=None) -> int:
     ap.add_argument("--quick", action="store_true",
                     help="2-replica CI smoke: no single leg, no "
                          "hot-swap leg, small load")
+    ap.add_argument("--trace-sample-rate", type=float, default=0.0,
+                    help="head-based request-trace sampling rate in "
+                         "[0, 1]; 0 (default) = tracing off, bitwise-"
+                         "identical serving")
     ap.add_argument("--skip-single", action="store_true")
     ap.add_argument("--no-swap", action="store_true")
     # jax-side child plumbing (internal)
@@ -785,6 +892,7 @@ def main(argv=None) -> int:
         "status": "failed", "replicas": args.replicas,
         "requests": args.requests, "tenants": args.tenants,
         "l1_capacity": args.l1_capacity, "quick": bool(args.quick),
+        "trace_sample_rate": float(args.trace_sample_rate),
     }
     if not _can_bind_localhost():
         # No localhost sockets, no fleet: record the skip honestly
@@ -802,9 +910,12 @@ def main(argv=None) -> int:
     cfg_fleet = os.path.join(out, "cfg_fleet.json")
     cfg_single = os.path.join(out, "cfg_single.json")
     with open(cfg_fleet, "w") as f:
-        json.dump(fleet_cfg_dict(out, quick=args.quick,
-                                 l1_capacity=args.l1_capacity,
-                                 l2_dir=l2_dir), f)
+        json.dump(fleet_cfg_dict(
+            out, quick=args.quick, l1_capacity=args.l1_capacity,
+            l2_dir=l2_dir,
+            trace_sample_rate=args.trace_sample_rate), f)
+    # The single leg stays untraced: it is the BASELINE — its wire
+    # bytes and engine behavior must match the pre-fleet architecture.
     with open(cfg_single, "w") as f:
         json.dump(fleet_cfg_dict(out, quick=args.quick,
                                  l1_capacity=args.l1_capacity,
@@ -851,10 +962,49 @@ def main(argv=None) -> int:
         rollout = extras.get("rollout") or {}
         zero_dropped = (fleet["dropped"] == 0
                         and (single is None or single["dropped"] == 0))
+
+        # Linked-trace verdict (the FLEET-style proof): every sampled
+        # request must have left a causally-complete span set across
+        # driver + replica events files, and the tier sums name WHERE
+        # the latency went.
+        trace_summary = None
+        if args.trace_sample_rate > 0:
+            rt = _router_mod.reqtrace_mod()
+            rows = []
+            for name in sorted(os.listdir(out)):
+                if name.endswith(".jsonl"):
+                    rows += [r for r in _tracing_mod.read_jsonl(
+                                 os.path.join(out, name))
+                             if r.get("event")
+                             == rt.REQUEST_TRACE_EVENT]
+            traces = rt.assemble(rows)
+            n_linked = sum(1 for t in traces.values() if rt.linked(t))
+            tier_seconds = {tier: 0.0 for tier in rt.TIERS}
+            for t in traces.values():
+                if rt.linked(t):
+                    attr = rt.attribute(t)
+                    for tier in rt.TIERS:
+                        tier_seconds[tier] += attr[tier]
+            trace_summary = {
+                "count": len(traces),
+                "linked": n_linked,
+                "linked_frac": (round(n_linked / len(traces), 4)
+                                if traces else 0.0),
+                "dominant_tier": (max(rt.TIERS,
+                                      key=lambda k: tier_seconds[k])
+                                  if n_linked else None),
+                "tier_seconds": {k: round(v, 4)
+                                 for k, v in tier_seconds.items()},
+            }
+        trace_ok = (trace_summary is None
+                    or (trace_summary["count"] > 0
+                        and trace_summary["linked_frac"] >= 0.95))
+
         ok = bool(fleet["responses_ok"] == args.requests
                   and zero_dropped
                   and migration.get("ok", args.quick)
-                  and (args.no_swap or rollout.get("state") == "done"))
+                  and (args.no_swap or rollout.get("state") == "done")
+                  and trace_ok)
         artifact.update({
             "status": "ok" if ok else "failed",
             "value": fleet["qps"],
@@ -869,6 +1019,16 @@ def main(argv=None) -> int:
                 reg_snap.get(_controller_mod.HALTS_COUNTER, 0)),
             "fleet_router_spills": int(
                 reg_snap.get(_router_mod.SPILLS_COUNTER, 0)),
+            "fleet_trace_count": (trace_summary["count"]
+                                  if trace_summary else None),
+            "fleet_trace_linked_frac": (trace_summary["linked_frac"]
+                                        if trace_summary else None),
+            "fleet_trace_dominant_tier": (trace_summary["dominant_tier"]
+                                          if trace_summary else None),
+            "fleet_trace_tier_seconds": (trace_summary["tier_seconds"]
+                                         if trace_summary else None),
+            "fleet_slo_burn_rate": extras.get("slo_burn_rate"),
+            "fleet_slo_tenants": extras.get("slo"),
             "rollout": rollout or None,
             "migration": migration or None,
             "zero_dropped": zero_dropped,

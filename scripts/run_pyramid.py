@@ -79,6 +79,9 @@ SHARD_SIZE_OVERRIDES = {
     "tests/test_tune.py": 120_000,          # the slow sweep smoke runs
     #                                         real bench --quick children
     #                                         (~80s each) + a resume leg
+    "tests/test_reqtrace.py": 120_000,      # traced 2-replica fleet
+    #                                         smoke + slo_report CLI
+    #                                         subprocesses
 }
 
 

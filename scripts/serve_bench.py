@@ -225,11 +225,22 @@ def main() -> int:
     ok = [r for r in responses if r.error is None]
     lat_ms = sorted(r.latency_seconds * 1e3 for r in ok)
 
-    def pct(q):
+    def pct(q, vals=lat_ms):
         # The repo's one pinned quantile definition (PR-1's p95 fix).
         from howtotrainyourmamlpytorch_tpu.utils.tracing import (
             nearest_rank)
-        return round(nearest_rank(lat_ms, q), 3) if lat_ms else None
+        return round(nearest_rank(vals, q), 3) if vals else None
+
+    # Per-cache-tier latency split (mirrors fleet_bench's leg stats):
+    # tier "miss" = adapted from scratch, the expensive path.
+    tier_lat = {"l1": [], "l2": [], "miss": []}
+    for r in ok:
+        tier_lat[r.cache_tier or "miss"].append(r.latency_seconds * 1e3)
+    tier_latency_ms = {
+        tier: ({"count": len(vals), "p50_ms": pct(0.50, sorted(vals)),
+                "p95_ms": pct(0.95, sorted(vals)),
+                "p99_ms": pct(0.99, sorted(vals))} if vals else None)
+        for tier, vals in tier_lat.items()}
 
     hits = engine.cache.hits
     misses = engine.cache.misses
@@ -243,6 +254,8 @@ def main() -> int:
         "rejected": rejected,
         "serve_latency_p50_ms": pct(0.5),
         "serve_latency_p95_ms": pct(0.95),
+        "serve_latency_p99_ms": pct(0.99),
+        "tier_latency_ms": tier_latency_ms,
         "serve_cache_hit_frac": (round(hits / (hits + misses), 4)
                                  if hits + misses else None),
         "adapt_batches": engine.adapt_invocations,
@@ -265,6 +278,12 @@ def main() -> int:
         "fleet_rolling_swaps": None,
         "fleet_rolling_swap_halts": None,
         "fleet_router_spills": None,
+        "fleet_trace_count": None,
+        "fleet_trace_linked_frac": None,
+        "fleet_trace_dominant_tier": None,
+        "fleet_trace_tier_seconds": None,
+        "fleet_slo_burn_rate": None,
+        "fleet_slo_tenants": None,
     }
     if args.events:
         jsonl = JsonlLogger(args.events)

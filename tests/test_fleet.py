@@ -709,5 +709,8 @@ def test_serve_bench_exposes_fleet_keys_as_null():
             for k in node.keys}
     for key in ("fleet_replicas", "fleet_qps", "fleet_speedup_vs_single",
                 "fleet_l2_hit_frac", "fleet_rolling_swaps",
-                "fleet_rolling_swap_halts", "fleet_router_spills"):
+                "fleet_rolling_swap_halts", "fleet_router_spills",
+                "fleet_trace_count", "fleet_trace_linked_frac",
+                "fleet_trace_dominant_tier", "fleet_trace_tier_seconds",
+                "fleet_slo_burn_rate", "fleet_slo_tenants"):
         assert key in keys, f"serve_bench artifact lost {key}"

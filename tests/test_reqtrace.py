@@ -1,0 +1,579 @@
+"""Request-tracing tests (ISSUE 16): span ring, head-based sampling,
+wire-protocol context round-trip, SLO ledger + burn-rate autoscaling,
+the zero-cost rate=0 pin, and the traced 2-replica subprocess smoke.
+
+Tier-1 keeps to pure/host-side units plus ONE engine parity pair (the
+rate=0 vs rate=1 bitwise pin needs two real ServingEngines) and ONE
+traced fleet_bench subprocess smoke + the jax-free slo_report CLI on
+its output (budgeted ~20s wall; run_pyramid's shard table weights this
+file as subprocess-heavy).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.serve import (
+    FewShotRequest, QueueFullError, RequestBatcher)
+from howtotrainyourmamlpytorch_tpu.serve.fleet import advise
+from howtotrainyourmamlpytorch_tpu.serve.fleet import controller as fc
+from howtotrainyourmamlpytorch_tpu.serve.fleet import router as fleet_router
+from howtotrainyourmamlpytorch_tpu.telemetry import reqtrace
+from howtotrainyourmamlpytorch_tpu.telemetry import trace as trace_mod
+from helpers import _can_bind_localhost
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLEET_BENCH = os.path.join(REPO, "scripts", "fleet_bench.py")
+SLO_REPORT = os.path.join(REPO, "scripts", "slo_report.py")
+
+
+@pytest.fixture(autouse=True)
+def _restore_installed_ring():
+    """Every test leaves the process-global span ring as it found it —
+    a leaked install would silently trace unrelated tests (and break
+    the rate=0 structural pin below)."""
+    prev = reqtrace.get()
+    yield
+    reqtrace.install(prev)
+
+
+class _Registry:
+    """Metrics-registry duck: counter/gauge (locked — SpanRing calls
+    ``inc`` outside its own lock from many threads)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = {}
+        self.gauges = {}
+
+    def counter(self, name):
+        reg = self
+
+        class _C:
+            def inc(self, n=1.0):
+                with reg._lock:
+                    reg.counts[name] = reg.counts.get(name, 0.0) + n
+
+        return _C()
+
+    def gauge(self, name):
+        reg = self
+
+        class _G:
+            def set(self, v):
+                reg.gauges[name] = float(v)
+
+        return _G()
+
+
+class _CaptureJsonl:
+    def __init__(self):
+        self.rows = []
+
+    def log(self, event, **payload):
+        self.rows.append({"event": event, **payload})
+
+
+# ---------------------------------------------------------------------------
+# span ring: bounds, drop accounting, thread safety
+# ---------------------------------------------------------------------------
+
+def test_span_ring_bounds_and_thread_safety():
+    reg = _Registry()
+    ring = reqtrace.SpanRing(capacity=100, registry=reg)
+    threads = [
+        threading.Thread(
+            target=lambda: [ring.record({"trace_id": "t", "i": i})
+                            for i in range(100)])
+        for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ring) == 100          # bounded: oldest rows dropped
+    assert ring.dropped == 700       # loss is counted, never silent
+    assert reg.counts["reqtrace/spans"] == 800
+    assert reg.counts["reqtrace/dropped"] == 700
+    rows = ring.drain()
+    assert len(rows) == 100 and len(ring) == 0
+
+
+def test_span_ring_rejects_bad_capacity():
+    with pytest.raises(ValueError):
+        reqtrace.SpanRing(capacity=0)
+
+
+# ---------------------------------------------------------------------------
+# head-based sampling: deterministic, rate-monotone
+# ---------------------------------------------------------------------------
+
+def test_mint_sampling_determinism_and_subset():
+    pairs = [(f"tenant{t}", s) for t in range(10) for s in range(20)]
+
+    def sampled(rate):
+        return {p for p in pairs
+                if reqtrace.mint(p[0], p[1], rate) is not None}
+
+    assert sampled(0.0) == set()               # rate=0: nothing minted
+    assert sampled(1.0) == set(pairs)          # rate=1: everything
+    # Deterministic: the decision is a pure function of (tenant, seq).
+    assert sampled(0.5) == sampled(0.5)
+    # Rate-monotone: raising the rate only ADDS traces (head-based
+    # modulus test, the property that lets reruns compare samples).
+    assert sampled(0.25) <= sampled(0.5) <= sampled(1.0)
+    # Roughly the configured fraction (sha256 is uniform; wide bounds).
+    assert 60 <= len(sampled(0.5)) <= 140
+    # Same (tenant, seq) -> same trace id, fresh span id per mint.
+    a = reqtrace.mint("tenantX", 7, 1.0)
+    b = reqtrace.mint("tenantX", 7, 1.0)
+    assert a["trace_id"] == b["trace_id"]
+    assert a["span_id"] != b["span_id"]
+    assert a["tenant"] == "tenantX"
+
+
+# ---------------------------------------------------------------------------
+# record hooks: no-op without a ring / a context; row schema; flush
+# ---------------------------------------------------------------------------
+
+def test_record_span_noop_without_ring_or_ctx():
+    reqtrace.install(None)
+    ctx = reqtrace.mint("t", 0, 1.0)
+    assert reqtrace.record_span(ctx, "route", 0.0, 0.01) is None
+    assert reqtrace.record_root(ctx, 0.0, 0.01) is None
+    assert reqtrace.flush(_CaptureJsonl()) == 0  # flush with no ring
+    ring = reqtrace.SpanRing(capacity=8)
+    reqtrace.install(ring)
+    assert reqtrace.record_span(None, "route", 0.0, 0.01) is None
+    assert len(ring) == 0            # unsampled request: nothing exists
+
+
+def test_span_row_schema_and_flush_extras():
+    ring = reqtrace.SpanRing(capacity=8)
+    reqtrace.install(ring)
+    ctx = reqtrace.mint("tenantA", 1, 1.0)
+    t0 = time.monotonic()
+    hop = reqtrace.record_span(ctx, reqtrace.SPAN_ROUTE, t0, 0.01,
+                               frame_bytes=42)
+    root = reqtrace.record_root(ctx, t0, 0.5, replica=1)
+    for key in ("trace_id", "span_id", "parent_id", "name", "t_mono",
+                "ts_start", "dur_s", "host", "pid", "tenant"):
+        assert key in hop, key
+    assert hop["parent_id"] == ctx["span_id"]
+    assert hop["frame_bytes"] == 42
+    assert root["span_id"] == ctx["span_id"]    # root IS the context id
+    assert root["parent_id"] is None
+    assert root["name"] == reqtrace.SPAN_REQUEST
+    # ts_start is the derived epoch instant of t0 (cross-process axis).
+    assert abs(hop["ts_start"] - time.time()) < 5.0
+    jsonl = _CaptureJsonl()
+    # extra fields fill in under the span's own keys: the replica id
+    # lands on the hop row, but a colliding key never clobbers a span.
+    assert ring.flush(jsonl, replica="r9", name="CLOBBER") == 2
+    assert all(r["event"] == reqtrace.REQUEST_TRACE_EVENT
+               for r in jsonl.rows)
+    assert jsonl.rows[0]["replica"] == "r9"
+    assert jsonl.rows[0]["name"] == reqtrace.SPAN_ROUTE  # row key wins
+    assert jsonl.rows[1]["replica"] == 1                 # span's own value
+
+
+# ---------------------------------------------------------------------------
+# wire protocol: context rides the frame, both directions get spans
+# ---------------------------------------------------------------------------
+
+def test_wire_roundtrip_records_spans():
+    # The package module IS the module the router uses (reqtrace_mod
+    # resolves via sys.modules first) — one ring serves both.
+    assert fleet_router.reqtrace_mod() is reqtrace
+    ring = reqtrace.SpanRing(capacity=16)
+    reqtrace.install(ring)
+    ctx = reqtrace.mint("tenantW", 3, 1.0)
+    a, b = socket.socketpair()
+    try:
+        fleet_router.send_msg(a, {"trace": ctx, "x": np.arange(3)})
+        msg = fleet_router.recv_msg(b)
+        # Untraced frames record NOTHING (rate=0 wire parity).
+        fleet_router.send_msg(a, {"x": 1})
+        assert fleet_router.recv_msg(b) == {"x": 1}
+    finally:
+        a.close()
+        b.close()
+    assert msg["trace"]["trace_id"] == ctx["trace_id"]
+    assert np.array_equal(msg["x"], np.arange(3))
+    # recv_msg stamps the receiver-local receipt instant for the
+    # replica's socket_queue span.
+    assert isinstance(msg["trace"]["recv_t"], float)
+    rows = ring.drain()
+    names = [r["name"] for r in rows]
+    assert names.count(reqtrace.SPAN_WIRE_SEND) == 1
+    assert names.count(reqtrace.SPAN_WIRE_RECV) == 1
+    assert all(r["frame_bytes"] > 0 for r in rows)
+    assert all(r["parent_id"] == ctx["span_id"] for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# assembly, linkage, tier attribution
+# ---------------------------------------------------------------------------
+
+def _hop(tid, parent, name, dur, **kw):
+    return {"trace_id": tid, "span_id": reqtrace.next_span_id(),
+            "parent_id": parent, "name": name, "dur_s": dur, **kw}
+
+
+def test_assemble_linked_attribute():
+    root = {"trace_id": "abc", "span_id": "r.1", "parent_id": None,
+            "name": reqtrace.SPAN_REQUEST, "dur_s": 1.0,
+            "tenant": "tenant3"}
+    spans = [
+        _hop("abc", "r.1", reqtrace.SPAN_SOCKET_QUEUE, 0.15),
+        _hop("abc", "r.1", reqtrace.SPAN_ADMIT, 0.05),
+        _hop("abc", "r.1", reqtrace.SPAN_WIRE_SEND, 0.1),
+        _hop("abc", "r.1", reqtrace.SPAN_ADAPT, 0.4),
+        _hop("abc", "r.1", reqtrace.SPAN_PREDICT, 0.1),
+        _hop("abc", "r.1", reqtrace.SPAN_RESPOND, 0.05),
+    ]
+    traces = reqtrace.assemble([root] + spans)
+    tr = traces["abc"]
+    assert tr["root"] is root and len(tr["spans"]) == 6
+    assert tr["tenant"] == "tenant3"
+    assert reqtrace.linked(tr)
+    att = reqtrace.attribute(tr)
+    assert att["queue"] == pytest.approx(0.2)
+    assert att["wire"] == pytest.approx(0.1)
+    assert att["adapt"] == pytest.approx(0.4)
+    assert att["predict"] == pytest.approx(0.1)
+    # respond is unclassified -> residual; floored at 0 elsewhere.
+    assert att["other"] == pytest.approx(1.0 - 0.8)
+    assert att["total"] == pytest.approx(1.0)
+    assert att["dominant"] == "adapt"
+    # One broken parent poisons the causal chain.
+    bad = dict(spans[0], parent_id="elsewhere")
+    assert not reqtrace.linked(
+        reqtrace.assemble([root, bad] + spans[1:])["abc"])
+    # No proof of completion (respond/predict missing) -> unlinked.
+    assert not reqtrace.linked(
+        reqtrace.assemble([root, spans[0]])["abc"])
+    # No root -> unlinked; attribution totals from hops, other floors 0.
+    orphan = reqtrace.assemble(spans)["abc"]
+    assert not reqtrace.linked(orphan)
+    assert reqtrace.attribute(orphan)["other"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# batcher: enqueue_time stamped at ADMISSION, never on rejection
+# ---------------------------------------------------------------------------
+
+def _plain_req():
+    rng = np.random.RandomState(0)
+    return FewShotRequest(
+        support_x=rng.randint(0, 256, (3, 10, 10, 1)).astype(np.uint8),
+        support_y=(np.arange(3) % 3).astype(np.int32),
+        query_x=rng.randint(0, 256, (2, 10, 10, 1)).astype(np.uint8))
+
+
+def test_batcher_stamps_enqueue_time_at_admission():
+    b = RequestBatcher(buckets=[(3, 2)], max_queue_depth=1,
+                       default_deadline_ms=50.0)
+    req = _plain_req()
+    assert req.enqueue_time is None
+    b.submit(req, now=123.0)
+    assert req.enqueue_time == 123.0         # the admission instant
+    assert req.deadline == pytest.approx(123.05)  # same clock read
+    # Backpressure rejection leaves the request UNTOUCHED (the caller
+    # may retry; the deadline clock must not have started).
+    rejected = _plain_req()
+    with pytest.raises(QueueFullError):
+        b.submit(rejected, now=124.0)
+    assert rejected.enqueue_time is None
+    assert rejected.deadline is None
+
+
+# ---------------------------------------------------------------------------
+# SLO ledger: window math, burn rate, advise() gating
+# ---------------------------------------------------------------------------
+
+def test_slo_ledger_math_and_window():
+    reg = _Registry()
+    led = fc.SLOLedger(slo_p95_ms=100.0, target_frac=0.95, window=4,
+                       registry=reg)
+    assert led.burn_rate() is None           # honest "no data", not 0
+    assert led.observe("a", 50.0) is True
+    assert led.observe("a", 150.0) is False
+    # burn = bad_frac / (1 - target) = 0.5 / 0.05
+    assert led.burn_rate() == pytest.approx(10.0)
+    assert led.burn_rate("a") == pytest.approx(10.0)
+    assert led.burn_rate("ghost") is None
+    for ms in (10.0, 20.0, 30.0, 40.0):
+        led.observe("b", ms)
+    snap = led.snapshot()
+    assert set(snap) == {"a", "b"}
+    assert snap["b"]["count"] == 4 and snap["b"]["bad_frac"] == 0.0
+    # Exact nearest-rank over the raw window — no bucket error.
+    assert snap["b"]["p50_ms"] == 20.0
+    assert snap["b"]["p95_ms"] == 40.0
+    assert snap["b"]["p99_ms"] == 40.0
+    assert snap["a"]["burn_rate"] == pytest.approx(10.0)
+    # Rolling window: 4 more good rows evict tenant a's bad one.
+    for _ in range(4):
+        led.observe("a", 1.0)
+    assert led.burn_rate("a") == pytest.approx(0.0)
+    assert led.snapshot()["a"]["count"] == 4
+    assert reg.counts[fc.SLO_GOOD_COUNTER] == 9.0
+    assert reg.counts[fc.SLO_BAD_COUNTER] == 1.0
+    assert reg.gauges[fc.SLO_BURN_GAUGE] == pytest.approx(0.0)
+
+
+def test_slo_ledger_validation():
+    for bad in (dict(slo_p95_ms=0.0), dict(target_frac=1.0),
+                dict(target_frac=0.0), dict(window=0)):
+        kw = dict(slo_p95_ms=100.0, target_frac=0.95, window=4)
+        kw.update(bad)
+        with pytest.raises(ValueError):
+            fc.SLOLedger(**kw)
+
+
+def test_advise_burn_rate_gating():
+    idle = {"queue_depth_total": 0, "p95_ms_max": 50.0}
+    # High burn scales up even with an empty queue (slow replicas hurt
+    # users without queueing).
+    assert advise(dict(idle, slo_burn_rate=2.0), live=2) == "scale_up"
+    assert advise(dict(idle, slo_burn_rate=5.0), live=1) == "scale_up"
+    # Mid burn vetoes the idle scale-down: still spending budget.
+    assert advise(dict(idle, slo_burn_rate=1.0), live=2) == "hold"
+    # Low burn: the error budget has headroom, shrink is safe.
+    assert advise(dict(idle, slo_burn_rate=0.1), live=2) == "scale_down"
+    # No SLO signal (absent or None): exactly the pre-ledger behavior.
+    assert advise(idle, live=2) == "scale_down"
+    assert advise(dict(idle, slo_burn_rate=None), live=2) == "scale_down"
+    assert advise(dict(idle, slo_burn_rate=None), live=1) == "hold"
+
+
+def test_config_validation_rejects_bad_knobs():
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    for bad in (dict(reqtrace_sample_rate=-0.1),
+                dict(reqtrace_sample_rate=1.5),
+                dict(fleet_slo_p95_ms=0.0),
+                dict(fleet_slo_target_frac=0.0),
+                dict(fleet_slo_target_frac=1.0)):
+        with pytest.raises(ValueError):
+            MAMLConfig(dataset_name="reqtrace_cfg", **bad)
+    cfg = MAMLConfig(dataset_name="reqtrace_cfg",
+                     reqtrace_sample_rate=0.25)
+    assert cfg.reqtrace_sample_rate == 0.25
+
+
+# ---------------------------------------------------------------------------
+# trace.py request lane: X spans + cross-process flow arrows
+# ---------------------------------------------------------------------------
+
+def test_trace_request_lane_flow_events():
+    # "ts" is the logger's write-time stamp (ring flush); the span's
+    # own epoch start rides in ts_start — the lane must use the latter.
+    rows = [
+        {"event": "request_trace", "ts": 300.0, "trace_id": "abc",
+         "pid": 11, "name": "wire_send", "ts_start": 100.000,
+         "dur_s": 0.010},
+        {"event": "request_trace", "ts": 300.0, "trace_id": "abc",
+         "pid": 22, "name": "socket_queue", "ts_start": 100.020,
+         "dur_s": 0.005},
+        {"event": "request_trace", "ts": 300.0, "trace_id": "abc",
+         "pid": 22, "name": "predict", "ts_start": 100.030,
+         "dur_s": 0.040},
+        # Same-pid pair: a flow arrow within one process is noise.
+        {"event": "request_trace", "ts": 300.0, "trace_id": "xyz",
+         "pid": 33, "name": "wire_send", "ts_start": 200.000,
+         "dur_s": 0.010},
+        {"event": "request_trace", "ts": 300.0, "trace_id": "xyz",
+         "pid": 33, "name": "socket_queue", "ts_start": 200.020,
+         "dur_s": 0.005},
+    ]
+    trace = trace_mod.build_trace(events=rows)
+    trace_mod.validate_trace(trace)
+    evs = trace["traceEvents"]
+    xs = [e for e in evs if e.get("tid") == trace_mod.REQUEST_TID
+          and e["ph"] == "X"]
+    assert len(xs) == 5 and {e["cat"] for e in xs} == {"request"}
+    assert {e["pid"] for e in xs} == {11, 22, 33}
+    flows = [e for e in evs if e["ph"] in ("s", "f")]
+    # One s/f pair for the cross-pid trace, none for the same-pid one.
+    assert [e["ph"] for e in sorted(flows, key=lambda e: e["ts"])] == \
+        ["s", "f"]
+    assert all(e["id"] == "abc" for e in flows)
+    assert {e["pid"] for e in flows} == {11, 22}
+
+
+# ---------------------------------------------------------------------------
+# engine: rate=0 is structurally zero-cost AND bitwise-identical
+# ---------------------------------------------------------------------------
+
+def _engine_cfg(tmp_path, **kw):
+    from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+    return MAMLConfig(
+        dataset_name="reqtrace_engine", image_height=10, image_width=10,
+        image_channels=1, num_classes_per_set=3, num_samples_per_class=1,
+        num_target_samples=2, batch_size=2, cnn_num_filters=4,
+        num_stages=2, number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2, second_order=False,
+        use_multi_step_loss_optimization=False,
+        serve_buckets=((3, 2),), serve_batch_tasks=2,
+        serve_default_deadline_ms=0.0, serve_cache_capacity=8,
+        serve_l2_dir=os.path.join(str(tmp_path), "l2"), **kw)
+
+
+def test_engine_zero_cost_pin_and_bitwise_parity(tmp_path):
+    """The health/profiler discipline, applied to tracing: at the
+    rate=0 default NO tracing object exists (one ``get() is None``
+    check per hook), and serving output is BITWISE identical to a
+    rate=1 engine — tracing observes, never perturbs."""
+    import jax
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.serve import ServingEngine
+
+    cfg0 = _engine_cfg(tmp_path / "a")                  # rate=0 default
+    cfg1 = _engine_cfg(tmp_path / "b", reqtrace_sample_rate=1.0)
+    assert cfg0.reqtrace_sample_rate == 0.0
+    init, _ = make_model(cfg0)
+    state = init_train_state(cfg0, init, jax.random.PRNGKey(0))
+
+    eng0 = ServingEngine(cfg0, state, devices=jax.devices()[:1])
+    try:
+        # Structural pin: nothing exists, not "exists but unused".
+        assert eng0._reqtrace_ring is None
+        assert reqtrace.get() is None
+        eng0.submit(_plain_req())
+        (r0,) = eng0.drain()
+    finally:
+        eng0.close()
+
+    eng1 = ServingEngine(cfg1, state, devices=jax.devices()[:1])
+    try:
+        assert eng1._reqtrace_ring is not None
+        assert reqtrace.get() is eng1._reqtrace_ring
+        req = _plain_req()
+        req.trace = reqtrace.mint("tenantP", 0, 1.0)
+        eng1.submit(req)
+        (r1,) = eng1.drain()
+        names = {row["name"] for row in eng1._reqtrace_ring.drain()}
+        assert {reqtrace.SPAN_ADMIT, reqtrace.SPAN_BATCH_WAIT,
+                reqtrace.SPAN_CACHE_PROBE, reqtrace.SPAN_ADAPT,
+                reqtrace.SPAN_PREDICT} <= names
+    finally:
+        eng1.close()
+    assert reqtrace.get() is None      # close() restored the prev sink
+
+    assert r0.error is None and r1.error is None
+    assert r0.logits.tobytes() == r1.logits.tobytes()   # bitwise
+    assert np.array_equal(r0.predictions, r1.predictions)
+
+
+# ---------------------------------------------------------------------------
+# subprocess smoke: traced 2-replica fleet + the jax-free slo_report CLI
+# ---------------------------------------------------------------------------
+
+needs_sockets = pytest.mark.skipif(
+    not _can_bind_localhost(),
+    reason="fleet replicas serve over localhost sockets, which this "
+           "sandbox cannot bind")
+
+
+def _run_fleet_bench(args, timeout):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, FLEET_BENCH] + args, cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, f"no artifact line\n{proc.stdout}\n{proc.stderr}"
+    return proc.returncode, json.loads(lines[-1])
+
+
+def _jax_trap_env(tmp_path):
+    """PYTHONPATH booby trap (the ckpt_inspect idiom): any jax import
+    in the child explodes, proving the CLI stays login-node safe."""
+    trap = tmp_path / "trap"
+    trap.mkdir(exist_ok=True)
+    (trap / "jax.py").write_text(
+        "raise ImportError('slo_report must not import jax')\n")
+    return dict(os.environ, PYTHONPATH=str(trap))
+
+
+def _run_slo_report(args, env, timeout=60):
+    proc = subprocess.run(
+        [sys.executable, SLO_REPORT] + args, cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, f"no output\n{proc.stdout}\n{proc.stderr}"
+    return proc.returncode, json.loads(lines[-1])
+
+
+@needs_sockets
+def test_fleet_bench_traced_smoke_and_slo_report(tmp_path):
+    """The ISSUE 16 acceptance smoke: a traced 2-replica run where
+    >=95% of sampled requests assemble into a fully-linked cross-
+    process trace, the artifact names the dominant latency tier, and
+    the jax-free slo_report CLI renders the same events."""
+    out = tmp_path / "fb"
+    rc, art = _run_fleet_bench(
+        ["--quick", "--trace-sample-rate", "1.0", "--out", str(out)],
+        timeout=300)
+    assert art["status"] == "ok", art
+    assert rc == 0
+    assert art["trace_sample_rate"] == 1.0
+    assert art["fleet_trace_count"] > 0
+    assert art["fleet_trace_linked_frac"] >= 0.95
+    assert art["fleet_trace_dominant_tier"] in reqtrace.TIERS
+    tiers = art["fleet_trace_tier_seconds"]
+    assert set(tiers) == set(reqtrace.TIERS)
+    assert all(v >= 0.0 for v in tiers.values())
+    # Satellite 1: p99 + per-cache-tier latency split in the leg stats.
+    assert art["fleet"]["p99_ms"] >= art["fleet"]["p95_ms"]
+    tier_lat = art["fleet"]["tier_latency_ms"]
+    assert set(tier_lat) == {"l1", "l2", "miss"}
+    for split in tier_lat.values():
+        if split is not None:
+            assert split["count"] > 0 and split["p99_ms"] >= split["p50_ms"]
+    # SLO ledger fed the artifact: every tenant has a window.
+    assert isinstance(art["fleet_slo_burn_rate"], float)
+    assert art["fleet_slo_tenants"]
+    for stats in art["fleet_slo_tenants"].values():
+        assert stats["count"] > 0 and stats["p95_ms"] is not None
+
+    # The jax-free CLI agrees with the bench's own gate — same events,
+    # same assemble/linked/attribute definitions.
+    rc, rep = _run_slo_report([str(out)], _jax_trap_env(tmp_path))
+    assert rc == 0
+    assert rep["metric"] == "slo_report"
+    assert rep["traces"] == art["fleet_trace_count"]
+    assert rep["linked_frac"] >= 0.95
+    assert rep["dominant_tier"] == art["fleet_trace_dominant_tier"]
+    assert set(rep["tenants"]) == set(art["fleet_slo_tenants"])
+    assert rep["worst"] and all(
+        w["total_ms"] > 0 for w in rep["worst"])
+
+
+def test_slo_report_error_and_usage_paths(tmp_path):
+    """The CLI's contract without a traced run: empty input is exit 1
+    with an ``error`` JSON line (not a crash), bad knobs are exit 2 —
+    both still jax-free."""
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    env = _jax_trap_env(tmp_path)
+    rc, art = _run_slo_report([str(empty)], env)
+    assert rc == 1 and "error" in art
+    # A jsonl with no request_trace rows: same honest failure.
+    some = tmp_path / "run"
+    some.mkdir()
+    (some / "events.jsonl").write_text(
+        json.dumps({"event": "epoch", "ts": 1.0}) + "\n")
+    rc, art = _run_slo_report([str(some)], env)
+    assert rc == 1 and "error" in art
+    rc, art = _run_slo_report(
+        [str(some), "--slo-target-frac", "1.0"], env)
+    assert rc == 2 and "error" in art

@@ -25,14 +25,14 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # checkpoint-lifecycle section, v8 the pod-fault-domain cluster
 # section, v9 the AOT warm-start section, v10 the elastic-pod section,
 # v11 the serving-fleet section, v12 the perf-lab section, v13 the
-# autotune section).
+# autotune section, v14 the request-tracing + SLO section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
     "watchdog", "health", "checkpoint", "cluster", "warm_start",
-    "elastic", "fleet", "perf", "tune",
+    "elastic", "fleet", "perf", "tune", "requests",
 }
 
 
@@ -687,6 +687,66 @@ def test_tune_section_rejected_sweep_and_row_fallback():
 def test_tune_section_unavailable_without_subsystem():
     s = summarize_events([{"event": "train_epoch", "epoch": 0}])
     assert s["tune"] == UNAVAILABLE
+
+
+def test_summarize_events_requests_section():
+    """Request-tracing section (schema v14): reqtrace/SLO counters
+    accumulate reset-aware and PER SOURCE (one fleet log interleaves
+    the driver's flush with every replica's, each keyed by its
+    `replica` id — a replica's smaller counter must not read as a
+    reset of another's stream); request_trace rows assemble through
+    the SAME linked/attribute definitions as fleet_bench's gate and
+    slo_report; the burn-rate gauge takes the last signal."""
+    events = [
+        # Replica 0's first life, then the driver ring interleaving
+        # with SMALLER counters (a different source, not a reset), then
+        # replica 0 restarted below its own previous value (a reset —
+        # the new segment contributes whole).
+        {"event": "metrics", "replica": 0,
+         "metrics": {"reqtrace/spans": 5.0, "reqtrace/dropped": 0.0,
+                     "fleet/slo_good_total": 4.0,
+                     "fleet/slo_bad_total": 1.0,
+                     "fleet/slo_burn_rate": 4.0}},
+        {"event": "metrics", "replica": "driver",
+         "metrics": {"reqtrace/spans": 3.0,
+                     "fleet/slo_burn_rate": 0.5}},
+        {"event": "metrics", "replica": 0,
+         "metrics": {"reqtrace/spans": 2.0}},
+        # One fully-linked trace (root + hops, queue-dominant) ...
+        {"event": "request_trace", "trace_id": "t1", "span_id": "r.1",
+         "parent_id": None, "name": "request", "dur_s": 1.0,
+         "tenant": "a"},
+        {"event": "request_trace", "trace_id": "t1", "span_id": "r.2",
+         "parent_id": "r.1", "name": "socket_queue", "dur_s": 0.6,
+         "tenant": "a"},
+        {"event": "request_trace", "trace_id": "t1", "span_id": "r.3",
+         "parent_id": "r.1", "name": "predict", "dur_s": 0.1,
+         "tenant": "a"},
+        # ... and one orphan hop whose root never flushed (unlinked).
+        {"event": "request_trace", "trace_id": "t2", "span_id": "x.2",
+         "parent_id": "zzz", "name": "predict", "dur_s": 0.2,
+         "tenant": "b"},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    rq = s["requests"]
+    assert rq["spans_recorded"] == 10   # r0: 5 + 2 (restart); driver: 3
+    assert rq["spans_dropped"] == 0
+    assert rq["trace_rows"] == 4
+    assert rq["traces"] == 2
+    assert rq["linked"] == 1
+    assert rq["linked_frac"] == pytest.approx(0.5)
+    assert rq["dominant_tier"] == "queue"   # over LINKED traces only
+    assert rq["tenants"] == 2
+    assert rq["slo_good"] == 4 and rq["slo_bad"] == 1
+    assert rq["slo_bad_frac"] == pytest.approx(0.2)
+    assert rq["slo_burn_rate"] == 0.5       # gauge: last signal wins
+    assert "requests" in format_table(s)
+
+
+def test_requests_section_unavailable_without_subsystem():
+    s = summarize_events([{"event": "train_epoch", "epoch": 0}])
+    assert s["requests"] == UNAVAILABLE
 
 
 def test_health_section_nonfinite_grad_norm_visible():
