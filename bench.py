@@ -543,6 +543,11 @@ def main() -> int:
         "metric": "meta_tasks_per_sec_per_chip",
         "value": round(per_chip, 3),
         "unit": "tasks/s/chip",
+        # Algorithm identity (meta/algos/ registry): echoed from the
+        # config, never null — a BENCH_* row must say WHICH algorithm's
+        # train step it timed (maml++/fomaml/anil/reptile compile
+        # different executables; docs/ALGORITHMS.md).
+        "meta_algorithm": cfg.meta_algorithm,
         "vs_baseline": (round(per_chip / BASELINE_TASKS_PER_SEC, 3)
                         if is_flagship else None),
         # Observability keys (additive — the metric contract above is

@@ -37,6 +37,35 @@ _IGNORED_REFERENCE_KEYS = {
 }
 
 
+def _meta_algos():
+    """meta/algos/__init__.py — the one definition of the algorithm
+    registry. Resolved lazily (the telemetry/report.py § _reqtrace
+    pattern): the package copy when ``meta`` is already imported, else
+    a file-path load — MAMLConfig validation also runs in the jax-free
+    autotune driver, and importing the ``meta`` package pulls jax."""
+    import sys
+    mod = (sys.modules.get("howtotrainyourmamlpytorch_tpu.meta.algos")
+           or sys.modules.get("_config_meta_algos_impl"))
+    if mod is None:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "meta", "algos", "__init__.py")
+        spec = importlib.util.spec_from_file_location(
+            "_config_meta_algos_impl", path)
+        mod = importlib.util.module_from_spec(spec)
+        # Register BEFORE exec (and as a cache so repeated validation
+        # doesn't re-execute the registry per config construction):
+        # dataclasses resolves string annotations through
+        # sys.modules[cls.__module__] at class-creation time.
+        sys.modules["_config_meta_algos_impl"] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            sys.modules.pop("_config_meta_algos_impl", None)
+            raise
+    return mod
+
+
 @dataclasses.dataclass(frozen=True)
 class MAMLConfig:
     """Full experiment configuration.
@@ -71,6 +100,13 @@ class MAMLConfig:
     num_samples_per_class: int = 1    # K-shot (support)
     num_target_samples: int = 1       # target (query) samples per class
     batch_size: int = 16              # meta-batch: tasks per outer step
+    # Episode target type (docs/ALGORITHMS.md § Sinusoid regression):
+    # 'classification' = int32 class labels + cross-entropy (the
+    # reference protocol); 'regression' = float32 scalar targets + MSE
+    # (the Finn et al. 2017 sinusoid protocol — x points travel as
+    # (rows, 1, 1, 1) float32 "images" so the episode pipeline,
+    # batcher buckets and datastore protocol stay shape-identical).
+    task_type: str = "classification"  # 'classification' | 'regression'
     # Pre-split layout (<dataset>/{train,val,test}/<class>/…) vs one flat
     # class pool split by ``train_val_test_split`` fractions (reference
     # ``data.py § load_dataset`` branches on this flag).
@@ -119,9 +155,16 @@ class MAMLConfig:
     norm_layer: str = "batch_norm"               # 'batch_norm' | 'layer_norm'
     batch_norm_momentum: float = 0.1
     batch_norm_eps: float = 1e-5
-    backbone: str = "vgg"                        # 'vgg' | 'resnet12'
+    backbone: str = "vgg"                        # 'vgg' | 'resnet12' | 'mlp'
 
     # ---- meta-learning (MAML / MAML++) ---------------------------------
+    # Which meta-algorithm the ONE shared trainer/server machinery runs
+    # (meta/algos/ registry; docs/ALGORITHMS.md): 'maml++' (the default
+    # — gates nothing, the flagship second-order MSL/LSLR/DA program),
+    # 'fomaml', 'anil', 'reptile'. A structural field: it participates
+    # in the AOT store fingerprint (parallel/aot.py — each algorithm
+    # prewarns its own executables) and in from_dict's did-you-mean.
+    meta_algorithm: str = "maml++"
     number_of_training_steps_per_iter: int = 5   # K (inner steps, train)
     number_of_evaluation_steps_per_iter: int = 5 # K (inner steps, eval)
     task_learning_rate: float = 0.1              # inner-loop LR init
@@ -588,9 +631,34 @@ class MAMLConfig:
                 "bn_backend='pallas' requires norm_layer='batch_norm' "
                 "(the fused kernel IS a batch-norm; silently running the "
                 "layer-norm composite would measure nothing)")
-        if self.backbone not in ("vgg", "resnet12"):
+        if self.backbone not in ("vgg", "resnet12", "mlp"):
             raise ValueError(f"unknown backbone {self.backbone!r}")
-        if self.num_classes_per_set < 2:
+        # Algorithm-registry validation (meta/algos/): unknown names
+        # raise here with the registry's own did-you-mean — a typo'd
+        # algorithm silently training the default is exactly the
+        # failure mode the meta_algorithm key exists to prevent.
+        _meta_algos().get(self.meta_algorithm)
+        if self.task_type not in ("classification", "regression"):
+            raise ValueError(
+                f"task_type must be 'classification' or 'regression', "
+                f"got {self.task_type!r}")
+        if self.task_type == "regression":
+            # Regression episodes carry float targets AND float inputs:
+            # the uint8 pixel wire has no meaning for (x, y) points, and
+            # every aval/wire-dtype consumer (data/loader.py,
+            # parallel/aot.py, serve/) keys on transfer_images_uint8 —
+            # a mismatch would compile executables real batches never
+            # match.
+            if self.transfer_images_uint8:
+                raise ValueError(
+                    "task_type='regression' requires "
+                    "transfer_images_uint8=false (float inputs have no "
+                    "uint8 wire format)")
+            if self.num_classes_per_set < 1:
+                raise ValueError(
+                    "num_classes_per_set must be >= 1 (tasks per "
+                    "episode for regression)")
+        elif self.num_classes_per_set < 2:
             raise ValueError("num_classes_per_set must be >= 2")
         if self.task_microbatches < 1:
             raise ValueError(
@@ -979,15 +1047,62 @@ class MAMLConfig:
         v = self.fleet_replica_dead_s or 6.0 * self.fleet_lease_interval_s
         return max(v, self.effective_fleet_stalled_s)
 
+    # ---- algorithm resolution (meta/algos/ registry) --------------------
+    # Every algorithm-dependent decision resolves through these
+    # properties, never through ad-hoc spec reads: the default spec
+    # ('maml++') gates nothing, so each property reduces to exactly its
+    # pre-registry expression — the flagship trajectory is bitwise-pinned
+    # (tests/test_algos.py § default-path pin).
+
+    @property
+    def algo(self):
+        """The resolved ``AlgoSpec`` for ``meta_algorithm`` (validated
+        at construction, so this cannot raise)."""
+        return _meta_algos().get(self.meta_algorithm)
+
+    @property
+    def effective_learnable_lslr(self) -> bool:
+        """Learnable per-layer per-step inner LRs, after the algorithm
+        gate: Reptile has no outer gradient to train them with, so its
+        spec freezes them at the ``task_learning_rate`` init."""
+        return bool(
+            self.algo.lslr_learnable
+            and self.learnable_per_layer_per_step_inner_loop_learning_rate)
+
+    @property
+    def num_output_units(self) -> int:
+        """Model head width: N logits for classification, 1 scalar
+        prediction for regression."""
+        return 1 if self.task_type == "regression" else \
+            self.num_classes_per_set
+
+    @property
+    def label_dtype(self) -> str:
+        """Episode label wire dtype name — int32 class ids or float32
+        regression targets (data/sampler.py, data/loader.py §
+        _zero_episodes, parallel/aot.py § episode_aval all resolve
+        through here so the compiled avals can never drift from what
+        the loader ships)."""
+        return "float32" if self.task_type == "regression" else "int32"
+
     def use_second_order(self, epoch: int) -> bool:
         """Derivative-order annealing (reference:
         ``few_shot_learning_system.py § forward`` — second order iff the
-        flag is set and ``epoch > first_order_to_second_order_epoch``)."""
+        flag is set and ``epoch > first_order_to_second_order_epoch``),
+        gated by the algorithm spec: fomaml/reptile force the
+        stop-gradient inner loop regardless of the config schedule."""
+        algo = self.algo
+        if algo.first_order or algo.outer == "interpolate":
+            return False
         return bool(self.second_order
                     and epoch > self.first_order_to_second_order_epoch)
 
     def use_msl(self, epoch: int) -> bool:
-        """Multi-step loss active this epoch (training only)."""
+        """Multi-step loss active this epoch (training only); off for
+        algorithms whose spec gates it (reptile — there is no outer
+        loss to weight per step)."""
+        if not self.algo.msl:
+            return False
         return bool(self.use_multi_step_loss_optimization
                     and epoch < self.multi_step_loss_num_epochs)
 

@@ -155,11 +155,12 @@ class MetaLearningDataLoader:
         cfg = self.cfg
         h, w, c = cfg.image_shape
         img = np.uint8 if cfg.transfer_images_uint8 else np.float32
+        lbl = np.dtype(cfg.label_dtype)
         return Episode(
             np.zeros((n, cfg.num_support_per_task, h, w, c), img),
-            np.zeros((n, cfg.num_support_per_task), np.int32),
+            np.zeros((n, cfg.num_support_per_task), lbl),
             np.zeros((n, cfg.num_target_per_task, h, w, c), img),
-            np.zeros((n, cfg.num_target_per_task), np.int32))
+            np.zeros((n, cfg.num_target_per_task), lbl))
 
     @staticmethod
     def _concat_episodes(parts) -> Episode:

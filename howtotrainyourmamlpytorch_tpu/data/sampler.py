@@ -21,6 +21,7 @@ from typing import Optional, Tuple
 import numpy as np
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.data.sources import source_kind
 from howtotrainyourmamlpytorch_tpu.meta.inner import Episode
 
 _ROTATIONS = 4
@@ -42,6 +43,15 @@ class EpisodeSampler:
         # falls back to the host-f32 path otherwise.
         self.emit_uint8 = (cfg.transfer_images_uint8
                            and hasattr(source, "get_images_raw"))
+        # Regression episodes carry per-sample float targets from the
+        # source (SinusoidSource.get_targets) instead of the 0..N-1
+        # class relabeling; everything else (class choice, index picks,
+        # shapes) is the same deterministic stream.
+        self.regression = cfg.task_type == "regression"
+        if self.regression and not hasattr(source, "get_targets"):
+            raise ValueError(
+                f"task_type='regression' needs a source with "
+                f"get_targets(); {source_kind(source)!r} has none")
         # Per-dataset normalization constants, config-resolved (defaults
         # documented at MAMLConfig.image_norm_constants / MOUNT-AUDIT.md).
         mean, inv_std, self._norm_identity = cfg.image_norm_resolved
@@ -88,6 +98,9 @@ class EpisodeSampler:
         dtype = np.uint8 if self.emit_uint8 else np.float32
         sx = np.empty((n, k, h, w, c), dtype)
         tx = np.empty((n, t, h, w, c), dtype)
+        if self.regression:
+            sy_f = np.empty((n, k), np.float32)
+            ty_f = np.empty((n, t), np.float32)
         for slot, class_id in enumerate(chosen):
             name, rot = self.classes[class_id]
             avail = self.source.num_images(name)
@@ -101,6 +114,11 @@ class EpisodeSampler:
                 imgs = np.rot90(imgs, rot, axes=(1, 2)).copy()
             sx[slot] = imgs[:k]
             tx[slot] = imgs[k:]
+            if self.regression:
+                targets = np.asarray(
+                    self.source.get_targets(name, picks), np.float32)
+                sy_f[slot] = targets[:k]
+                ty_f[slot] = targets[k:]
 
         sx = sx.reshape(n * k, h, w, c)
         tx = tx.reshape(n * t, h, w, c)
@@ -109,8 +127,14 @@ class EpisodeSampler:
             # the device — ops.episode.normalize_episode).
             sx = self._normalize(sx)
             tx = self._normalize(tx)
-        sy = np.repeat(np.arange(n, dtype=np.int32), k)
-        ty = np.repeat(np.arange(n, dtype=np.int32), t)
+        if self.regression:
+            # Labels ARE the targets: float y values aligned row-for-row
+            # with sx/tx, same layout as the classification relabeling.
+            sy = sy_f.reshape(n * k)
+            ty = ty_f.reshape(n * t)
+        else:
+            sy = np.repeat(np.arange(n, dtype=np.int32), k)
+            ty = np.repeat(np.arange(n, dtype=np.int32), t)
         return Episode(sx, sy, tx, ty)
 
     def sample_batch(self, indices) -> Episode:

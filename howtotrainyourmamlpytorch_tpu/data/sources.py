@@ -336,6 +336,68 @@ class SyntheticSource(ArraySource):
         super().__init__(classes)
 
 
+class SinusoidSource:
+    """Few-shot sinusoid regression tasks (Finn et al. 2017 §5.1,
+    arXiv:1703.03400).
+
+    Each "class" is ONE sinusoid task ``y = A·sin(x − φ)`` with
+    amplitude ``A ∈ [0.1, 5.0]`` and phase ``φ ∈ [0, π]``; its "images"
+    are a fixed pool of x points drawn uniformly from ``[-5, 5]``,
+    stored in the episode pipeline's ``(n, 1, 1, 1)`` float32 NHWC
+    layout so every downstream shape contract (sampler, loader buckets,
+    serve batcher) holds unchanged, and :meth:`get_targets` returns the
+    matching float32 y values (the regression counterpart of the
+    sampler's 0..N-1 relabeling). Deliberately NO ``get_images_raw``:
+    x points are real-valued, so the uint8 wire does not apply (config
+    validation rejects ``transfer_images_uint8`` for regression) and
+    the sampler's float32 path engages naturally.
+
+    Seeding matches :class:`SyntheticSource`: an int, or a tuple fed to
+    ``np.random.SeedSequence`` as entropy words so ``(split_id, seed)``
+    streams are disjoint with no arithmetic collisions.
+    """
+
+    kind = "sinusoid"
+
+    AMP_RANGE = (0.1, 5.0)
+    PHASE_RANGE = (0.0, np.pi)
+    X_RANGE = (-5.0, 5.0)
+
+    def __init__(self, num_tasks: int, points_per_task: int, seed=0):
+        if num_tasks < 1 or points_per_task < 1:
+            raise ValueError("SinusoidSource needs >=1 task and point")
+        rng = np.random.default_rng(
+            np.random.SeedSequence(seed) if isinstance(seed, tuple)
+            else seed)
+        self._x: Dict[str, np.ndarray] = {}
+        self._y: Dict[str, np.ndarray] = {}
+        for i in range(num_tasks):
+            name = f"task_{i:05d}"
+            amp = rng.uniform(*self.AMP_RANGE)
+            phase = rng.uniform(*self.PHASE_RANGE)
+            x = rng.uniform(*self.X_RANGE,
+                            points_per_task).astype(np.float32)
+            self._x[name] = x.reshape(-1, 1, 1, 1)
+            self._y[name] = (amp * np.sin(x - phase)).astype(np.float32)
+
+    @property
+    def class_names(self) -> List[str]:
+        return sorted(self._x)
+
+    def num_images(self, class_name: str) -> int:
+        return len(self._y[class_name])
+
+    def get_images(self, class_name: str,
+                   indices: np.ndarray) -> np.ndarray:
+        """(len(indices), 1, 1, 1) float32 x points ("images")."""
+        return self._x[class_name][indices]
+
+    def get_targets(self, class_name: str,
+                    indices: np.ndarray) -> np.ndarray:
+        """(len(indices),) float32 regression targets."""
+        return self._y[class_name][indices]
+
+
 _SPLIT_SEEDS = {"train": 0, "val": 1, "test": 2}
 
 
@@ -443,6 +505,18 @@ def build_source(cfg, split: str):
 
 
 def _resolve_source(cfg, split: str):
+    if cfg.task_type == "regression":
+        # Regression tasks are procedurally generated — there is no
+        # disk/pack layout to probe, and the task distribution is the
+        # dataset (Finn 2017 samples fresh sinusoids forever; a large
+        # fixed per-split pool keeps the deterministic-episode contract
+        # the samplers and eval seeds rely on).
+        return SinusoidSource(
+            num_tasks=max(40 * cfg.num_classes_per_set, 200),
+            points_per_task=max(
+                2 * (cfg.num_samples_per_class + cfg.num_target_samples),
+                50),
+            seed=(_SPLIT_SEEDS[split], cfg.seed))
     packed = _try_packed_source(cfg, split)
     if packed is not None:
         return packed

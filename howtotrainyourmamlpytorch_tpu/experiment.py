@@ -35,6 +35,7 @@ import numpy as np
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.data.loader import MetaLearningDataLoader
+from howtotrainyourmamlpytorch_tpu.meta.inner import adapted_param_counts
 from howtotrainyourmamlpytorch_tpu.meta.outer import (
     MetaTrainState, init_train_state, migrate_lslr_rows,
     reconcile_loaded_shapes, state_leaf_shapes)
@@ -1270,8 +1271,23 @@ class ExperimentBuilder:
                        aot_fingerprint=self._aot_stats["fingerprint"][:16])
         self.jsonl.log("warm_start", **row)
 
+    def _emit_algo_row(self) -> None:
+        """One ``algo`` row per session (+ matching gauges on every
+        metrics row): which meta-algorithm this run trains and how many
+        parameters its inner loop actually adapts — the telemetry
+        report's "algo" section source (telemetry/report.py v15).
+        ANIL is the case the counts exist for: adapted ≪ total."""
+        cfg = self.cfg
+        adapted, total = adapted_param_counts(cfg, self.state.params)
+        self.registry.gauge("algo/adapted_params").set(adapted)
+        self.registry.gauge("algo/total_params").set(total)
+        self.jsonl.log("algo", meta_algorithm=cfg.meta_algorithm,
+                       task_type=cfg.task_type, adapted_params=adapted,
+                       total_params=total)
+
     def _run_experiment(self) -> Dict[str, Any]:
         cfg = self.cfg
+        self._emit_algo_row()
         if cfg.evaluate_on_test_set_only:
             return self.run_test_protocol()
 
@@ -1688,14 +1704,31 @@ class ExperimentBuilder:
             per_model_logits.append(res["logits"])
             per_model_acc[f"epoch_{epoch}"] = res["accuracy"]
 
-        # Ensemble: sum of softmax probabilities over models, then argmax.
-        probs = sum(jax.nn.softmax(jnp.asarray(lg), axis=-1)
-                    for lg in per_model_logits)
-        preds = np.asarray(jnp.argmax(probs, axis=-1))  # (E, N*T)
-        n, t = cfg.num_classes_per_set, cfg.num_target_samples
-        labels = np.tile(np.repeat(np.arange(n), t)[None],
-                         (preds.shape[0], 1))
-        per_episode_acc = (preds == labels).mean(axis=1)
+        if cfg.task_type == "regression":
+            # A regression head has one output unit, so the softmax/argmax
+            # vote below would report accuracy 1.0 unconditionally. The
+            # regression ensemble is the mean of per-model predictions,
+            # scored as per-episode MSE against the episodes' float
+            # targets; "accuracy" stays −MSE, the epoch loop's convention.
+            preds = np.mean([np.asarray(lg)[..., 0]
+                             for lg in per_model_logits], axis=0)  # (E, N*T)
+            targets, n_left = [], cfg.num_evaluation_tasks
+            for batch in self._eval_batches("test"):
+                y = np.asarray(jax.device_get(batch.target_y))
+                take = min(n_left, y.shape[0])
+                targets.append(y[:take])
+                n_left -= take
+            labels = np.concatenate(targets)  # (E, N*T) float
+            per_episode_acc = -((preds - labels) ** 2).mean(axis=1)
+        else:
+            # Ensemble: sum of softmax probabilities over models, argmax.
+            probs = sum(jax.nn.softmax(jnp.asarray(lg), axis=-1)
+                        for lg in per_model_logits)
+            preds = np.asarray(jnp.argmax(probs, axis=-1))  # (E, N*T)
+            n, t = cfg.num_classes_per_set, cfg.num_target_samples
+            labels = np.tile(np.repeat(np.arange(n), t)[None],
+                             (preds.shape[0], 1))
+            per_episode_acc = (preds == labels).mean(axis=1)
         result = {
             "test_accuracy_mean": float(per_episode_acc.mean()),
             "test_accuracy_std": float(per_episode_acc.std()),
@@ -1703,6 +1736,8 @@ class ExperimentBuilder:
             "num_episodes": int(per_episode_acc.shape[0]),
             "per_model_accuracy": per_model_acc,
         }
+        if cfg.task_type == "regression":
+            result["test_mse_mean"] = -result["test_accuracy_mean"]
         # CSV schema must be stable across re-runs (the ensemble member set
         # changes), so per-model accuracies go in one packed column.
         if self.is_main_process:
@@ -1726,8 +1761,14 @@ class ExperimentBuilder:
         if self.is_main_process:
             self.registry.write_prometheus(
                 f"{self.paths['logs']}/metrics.prom")
-        print(f"test: {result['test_accuracy_mean']:.4f} "
-              f"± {result['test_accuracy_std']:.4f} "
-              f"({result['num_models']}-model ensemble, "
-              f"{result['num_episodes']} episodes)")
+        if cfg.task_type == "regression":
+            print(f"test: mse {result['test_mse_mean']:.4f} "
+                  f"± {result['test_accuracy_std']:.4f} "
+                  f"({result['num_models']}-model ensemble, "
+                  f"{result['num_episodes']} episodes)")
+        else:
+            print(f"test: {result['test_accuracy_mean']:.4f} "
+                  f"± {result['test_accuracy_std']:.4f} "
+                  f"({result['num_models']}-model ensemble, "
+                  f"{result['num_episodes']} episodes)")
         return result

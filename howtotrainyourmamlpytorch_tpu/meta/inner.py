@@ -37,8 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
-from howtotrainyourmamlpytorch_tpu.ops.losses import (
-    accuracy, cross_entropy, weighted_cross_entropy)
+from howtotrainyourmamlpytorch_tpu.meta.algos import HEAD_PARAM_KEYS
+from howtotrainyourmamlpytorch_tpu.ops.losses import task_loss_fns
 
 Params = Dict[str, Any]
 State = Dict[str, Any]
@@ -49,9 +49,10 @@ class Episode(NamedTuple):
     leading task axis (reference ``data.py`` yields (B,N,K,C,H,W) — we
     flatten the (N,K) set dims since labels carry the class structure)."""
     support_x: jax.Array  # (N*K, H, W, C)
-    support_y: jax.Array  # (N*K,) int32 in [0, N)
+    support_y: jax.Array  # (N*K,) int32 in [0, N) — or float32
+    #                       regression targets (cfg.label_dtype)
     target_x: jax.Array   # (N*T, H, W, C)
-    target_y: jax.Array   # (N*T,) int32
+    target_y: jax.Array   # (N*T,) int32 (or float32, see support_y)
 
 
 class TaskResult(NamedTuple):
@@ -73,10 +74,21 @@ def split_fast_slow(cfg: MAMLConfig,
     """Partition top-level layers into inner-adapted ("fast") vs meta-only
     ("slow"). Convention: top-level keys containing ``norm`` are slow unless
     ``enable_inner_loop_optimizable_bn_params`` (reference §
-    get_inner_loop_parameter_dict)."""
+    get_inner_loop_parameter_dict).
+
+    The algorithm spec's trainable mask (meta/algos/) narrows the fast
+    set further: under ANIL (``trainable == 'head'``) only the head
+    projection adapts — everything downstream sizes itself off this
+    split (LSLR vectors, the serve adapt executable, AdaptedTask cache
+    entries), so the ANIL shrink needs no other wiring. The body still
+    meta-trains: outer gradients flow through the full param tree."""
+    head_only = cfg.algo.trainable == "head"
     fast, slow = {}, {}
     for name, sub in params.items():
-        if "norm" in name and not cfg.enable_inner_loop_optimizable_bn_params:
+        if head_only and name not in HEAD_PARAM_KEYS:
+            slow[name] = sub
+        elif ("norm" in name
+                and not cfg.enable_inner_loop_optimizable_bn_params):
             slow[name] = sub
         else:
             fast[name] = sub
@@ -85,6 +97,17 @@ def split_fast_slow(cfg: MAMLConfig,
 
 def merge_fast_slow(fast: Params, slow: Params) -> Params:
     return {**slow, **fast}
+
+
+def adapted_param_counts(cfg: MAMLConfig,
+                         params: Params) -> Tuple[int, int]:
+    """``(adapted, total)`` parameter counts under the config's
+    algorithm — the ONE definition the telemetry "algo" section and the
+    serve-bench artifact both report (ANIL's head-only mask is the
+    interesting case: adapted ≪ total)."""
+    fast, _ = split_fast_slow(cfg, params)
+    count = lambda t: sum(int(np.size(x)) for x in jax.tree.leaves(t))
+    return count(fast), count(params)
 
 
 def lslr_init(cfg: MAMLConfig, fast_params: Params) -> Params:
@@ -177,14 +200,19 @@ def support_adapt_step(cfg: MAMLConfig, apply_fn, slow: Params,
     documented bucket-fit trade — serve/batcher.py.)
     """
 
+    # Trace-time loss dispatch (ops/losses.py § task_loss_fns):
+    # classification resolves to the very same cross_entropy /
+    # weighted_cross_entropy objects as always — identical jaxpr.
+    loss_fn, weighted_loss_fn, _ = task_loss_fns(cfg)
+
     def support_loss_fn(f):
         with jax.named_scope("inner_support_forward"):
             logits, bn2 = apply_fn(merge_fast_slow(f, slow), bn,
                                    support_x, step, True)
             if support_w is None:
-                return cross_entropy(logits, support_y), bn2
-            return weighted_cross_entropy(logits, support_y,
-                                          support_w), bn2
+                return loss_fn(logits, support_y), bn2
+            return weighted_loss_fn(logits, support_y,
+                                    support_w), bn2
 
     with jax.named_scope("inner_support_grad"):
         (s_loss, bn), grads = jax.value_and_grad(
@@ -210,6 +238,8 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
     executables over a whole run).
     """
     fast0, slow = split_fast_slow(cfg, params)
+    # Trace-time loss/metric dispatch — see support_adapt_step.
+    loss_fn, _, metric_fn = task_loss_fns(cfg)
 
     # MSL execution strategy: with per-step BN the K target forwards are
     # independent of each other AND off the serial support-adaptation chain
@@ -265,10 +295,10 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
             with jax.named_scope("inner_msl_target_forward"):
                 t_logits, bn = apply_fn(merge_fast_slow(fast, slow), bn,
                                         episode.target_x, step, True)
-                t_loss = cross_entropy(t_logits, episode.target_y)
+                t_loss = loss_fn(t_logits, episode.target_y)
         else:
             t_logits = jnp.zeros(
-                (episode.target_y.shape[0], cfg.num_classes_per_set),
+                (episode.target_y.shape[0], cfg.num_output_units),
                 jnp.float32)
             t_loss = jnp.float32(0.0)
         return (fast, bn), (s_loss, t_loss, t_logits)
@@ -286,7 +316,7 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
         def target_fwd(fast_s, step):
             logits, bn_s = apply_fn(merge_fast_slow(fast_s, slow), bn,
                                     episode.target_x, step, True)
-            return logits, cross_entropy(logits, episode.target_y), bn_s
+            return logits, loss_fn(logits, episode.target_y), bn_s
 
         t_logits_steps, t_losses, bn_steps = jax.vmap(target_fwd)(
             fast_steps, steps)
@@ -315,14 +345,66 @@ def task_forward(cfg: MAMLConfig, apply_fn, params: Params, lslr: Params,
                 final_logits, bn = apply_fn(merge_fast_slow(fast, slow), bn,
                                             episode.target_x,
                                             jnp.int32(num_steps - 1), True)
-                loss = cross_entropy(final_logits, episode.target_y)
+                loss = loss_fn(final_logits, episode.target_y)
 
     return TaskResult(
         loss=loss,
         target_logits=final_logits,
-        target_accuracy=accuracy(final_logits, episode.target_y),
+        target_accuracy=metric_fn(final_logits, episode.target_y),
         support_loss=jnp.mean(s_losses),
         bn_state=bn,
         per_step_target_losses=t_losses,
         per_step_support_losses=s_losses,
     )
+
+
+def reptile_task_forward(cfg: MAMLConfig, apply_fn, params: Params,
+                         lslr: Params, bn_state: State, episode: Episode,
+                         *, num_steps: int
+                         ) -> Tuple[TaskResult, Params]:
+    """Adapt to one task and return ``(TaskResult, delta)`` where
+    ``delta = θ − φ`` over the fast leaves — Reptile's interpolation
+    "gradient" (Nichol et al. 2018, arXiv:1803.02999: moving θ toward
+    the adapted φ descends the expected-loss-after-adaptation surrogate;
+    feeding θ − φ to the meta-optimizer is the paper's
+    Adam/momentum-composable formulation).
+
+    Reuses :func:`support_adapt_step` — the SAME inner update every
+    other algorithm scans — with ``second_order=False``; nothing here is
+    ever differentiated (the delta IS the outer gradient), so the inner
+    scan skips the remat wrapper: rematerialization only pays off in a
+    backward pass this executable doesn't have. The target forward is
+    reporting only: it produces the TaskResult loss/accuracy metrics the
+    shared trainer logs, on the post-adaptation weights.
+    """
+    fast0, slow = split_fast_slow(cfg, params)
+    loss_fn, _, metric_fn = task_loss_fns(cfg)
+
+    def inner_step(carry, step):
+        fast, bn = carry
+        fast, bn, s_loss = support_adapt_step(
+            cfg, apply_fn, slow, lslr, episode.support_x,
+            episode.support_y, fast, bn, step, second_order=False)
+        return (fast, bn), s_loss
+
+    (fast, bn), s_losses = jax.lax.scan(
+        inner_step, (fast0, bn_state), jnp.arange(num_steps),
+        unroll=cfg.inner_unroll)
+
+    with jax.named_scope("final_target_forward"):
+        final_logits, bn = apply_fn(merge_fast_slow(fast, slow), bn,
+                                    episode.target_x,
+                                    jnp.int32(num_steps - 1), True)
+        loss = loss_fn(final_logits, episode.target_y)
+
+    delta = jax.tree.map(lambda a, b: a - b, fast0, fast)
+    result = TaskResult(
+        loss=loss,
+        target_logits=final_logits,
+        target_accuracy=metric_fn(final_logits, episode.target_y),
+        support_loss=jnp.mean(s_losses),
+        bn_state=bn,
+        per_step_target_losses=jnp.zeros((num_steps,), jnp.float32),
+        per_step_support_losses=s_losses,
+    )
+    return result, delta

@@ -27,7 +27,7 @@ from flax import struct
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.meta.inner import (
     Episode, TaskResult, lslr_init, per_step_loss_importance,
-    split_fast_slow, task_forward)
+    reptile_task_forward, split_fast_slow, task_forward)
 from howtotrainyourmamlpytorch_tpu.ops.episode import normalize_episode
 
 Params = Dict[str, Any]
@@ -208,7 +208,16 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
     optimizer = make_optimizer(cfg)
     schedule = meta_lr_schedule(cfg)
     num_steps = cfg.number_of_training_steps_per_iter
-    learnable_lslr = cfg.learnable_per_layer_per_step_inner_loop_learning_rate
+    # Algorithm-gated (meta/algos/): reptile's spec freezes the LSLR
+    # vectors (no outer gradient reaches them); for every other
+    # algorithm this is exactly the raw config field.
+    learnable_lslr = cfg.effective_learnable_lslr
+    # Outer-loop coupling: "backprop" differentiates batch_loss (the
+    # MAML family); "interpolate" (reptile) builds the SAME
+    # ((loss, aux), grads) structure from per-task adaptation deltas —
+    # everything downstream (microbatch accumulation, the mesh pmean,
+    # grad zeroing/clamp, the Adam update, health) is shared verbatim.
+    interpolate = cfg.algo.outer == "interpolate"
     # Health diagnostics are a STATIC build decision (the watchdog
     # zero-cost discipline): off means the step's traced graph and
     # compiled HLO are exactly the pre-health ones — no extra aux, no
@@ -294,14 +303,62 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
                              jnp.mean(res.per_step_target_losses, axis=0))
             return loss, aux
 
+        if interpolate:
+            def value_and_grads(trainable, bn_state, chunk, scale=None):
+                def one_task(ep: Episode):
+                    with jax.named_scope("task_adapt"):
+                        return reptile_task_forward(
+                            cfg, apply_fn, trainable["params"],
+                            trainable["lslr"], bn_state, ep,
+                            num_steps=num_steps)
+                res, deltas = jax.vmap(one_task)(chunk)
+                if scale is not None:
+                    # The elastic pad-and-mask contract (batch_loss
+                    # below): scaled per-task leaves make every
+                    # mean-over-padded-tasks equal the exact real-task
+                    # mean — deltas included, so pad tasks contribute
+                    # zero interpolation movement.
+                    def scaled(a):
+                        return a * scale.reshape(
+                            scale.shape[:1] + (1,) * (a.ndim - 1))
+                    res = jax.tree.map(scaled, res)
+                    deltas = jax.tree.map(scaled, deltas)
+                loss = jnp.mean(res.loss)
+                new_bn = jax.tree.map(lambda a: jnp.mean(a, axis=0),
+                                      res.bn_state)
+                aux = (jnp.mean(res.target_accuracy),
+                       jnp.mean(res.support_loss), new_bn)
+                if with_health:
+                    aux = aux + (
+                        jnp.mean(res.per_step_support_losses, axis=0),
+                        jnp.mean(res.per_step_target_losses, axis=0))
+                # The interpolation delta θ − φ, task-shard-meaned, is
+                # the "gradient" on fast leaves; slow leaves and the
+                # LSLR vectors have no outer gradient — zeros keep
+                # their Adam moments (and the grads pytree structure)
+                # identical to the backprop path's.
+                fast0, slow = split_fast_slow(cfg, trainable["params"])
+                mean_deltas = jax.tree.map(
+                    lambda d: jnp.mean(d, axis=0), deltas)
+                grads = {
+                    "params": {**jax.tree.map(jnp.zeros_like, slow),
+                               **mean_deltas},
+                    "lslr": jax.tree.map(jnp.zeros_like,
+                                         trainable["lslr"]),
+                }
+                return (loss, aux), grads
+        else:
+            def value_and_grads(trainable, bn_state, chunk, scale=None):
+                return jax.value_and_grad(batch_loss, has_aux=True)(
+                    trainable, bn_state, chunk, scale)
+
         trainable = {"params": state.params, "lslr": state.lslr}
         # Per-shard pad scale (None when pad == 0 — the default; the
         # trace is then byte-identical to the pre-elastic step).
         scale = _pad_scale(batch.support_y.shape[0]) if pad else None
         if num_micro <= 1:
-            (loss, aux), grads = jax.value_and_grad(
-                batch_loss, has_aux=True)(trainable, state.bn_state, batch,
-                                          scale)
+            (loss, aux), grads = value_and_grads(
+                trainable, state.bn_state, batch, scale)
         else:
             # Gradient accumulation over task micro-batches: the memory
             # lever for pod-scale meta-batches (SURVEY.md §2.2). The mean
@@ -319,9 +376,8 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
 
             def one_chunk(carry, xs):
                 chunk, s_c = xs if pad else (xs, None)
-                (loss_c, aux_c), grads_c = jax.value_and_grad(
-                    batch_loss, has_aux=True)(trainable, state.bn_state,
-                                              chunk, s_c)
+                (loss_c, aux_c), grads_c = value_and_grads(
+                    trainable, state.bn_state, chunk, s_c)
                 carry = jax.tree.map(jnp.add, carry,
                                      ((loss_c, aux_c), grads_c))
                 return carry, None
@@ -329,8 +385,7 @@ def make_train_step(cfg: MAMLConfig, apply_fn, *,
             zero = jax.tree.map(
                 jnp.zeros_like,
                 jax.eval_shape(
-                    lambda t, b: jax.value_and_grad(
-                        batch_loss, has_aux=True)(
+                    lambda t, b: value_and_grads(
                         t, b, jax.tree.map(lambda x: x[0], chunked),
                         s_chunked[0] if pad else None),
                     trainable, state.bn_state))

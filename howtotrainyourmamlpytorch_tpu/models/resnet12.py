@@ -98,7 +98,7 @@ def make_resnet12(cfg: MAMLConfig):
                 width, num_steps)
             in_ch = width
         params["linear"] = layers.linear_init(
-            next(keys), widths[-1], cfg.num_classes_per_set)
+            next(keys), widths[-1], cfg.num_output_units)
         return params, state
 
     def apply(params: Params, state: State, x: jax.Array, step: jax.Array,

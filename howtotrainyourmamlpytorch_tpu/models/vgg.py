@@ -108,7 +108,7 @@ def make_vgg(cfg: MAMLConfig) -> Tuple[InitFn, ApplyFn]:
                                          jnp.int32(0), True)[0],
             params, state)
         params["linear"] = layers.linear_init(
-            keys[-1], feat_shape.shape[-1], cfg.num_classes_per_set)
+            keys[-1], feat_shape.shape[-1], cfg.num_output_units)
         return params, state
 
     def apply(params: Params, state: State, x: jax.Array, step: jax.Array,
@@ -132,4 +132,7 @@ def make_model(cfg: MAMLConfig) -> Tuple[InitFn, ApplyFn]:
     if cfg.backbone == "resnet12":
         from howtotrainyourmamlpytorch_tpu.models import resnet12
         return resnet12.make_resnet12(cfg)
+    if cfg.backbone == "mlp":
+        from howtotrainyourmamlpytorch_tpu.models import mlp
+        return mlp.make_mlp(cfg)
     raise ValueError(f"unknown backbone {cfg.backbone!r}")

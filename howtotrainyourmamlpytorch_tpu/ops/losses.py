@@ -42,3 +42,54 @@ def weighted_cross_entropy(logits: jax.Array, labels: jax.Array,
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels)
                     .astype(jnp.float32))
+
+
+def _se_per_row(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-row squared error, summed over the output dim: predictions
+    are ``(rows, D)`` model outputs (D=1 for scalar regression),
+    targets ``(rows,)`` or ``(rows, D)`` floats. At least f32, like the
+    cross-entropy path."""
+    preds = preds.astype(jnp.promote_types(preds.dtype, jnp.float32))
+    targets = targets.astype(preds.dtype)
+    if targets.ndim == preds.ndim - 1:
+        targets = targets[..., None]
+    return jnp.sum(jnp.square(preds - targets), axis=-1)
+
+
+def mse(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean squared error (torch ``F.mse_loss`` mean-reduction
+    semantics) — the regression counterpart of :func:`cross_entropy`."""
+    return jnp.mean(_se_per_row(preds, targets))
+
+
+def weighted_mse(preds: jax.Array, targets: jax.Array,
+                 weights: jax.Array) -> jax.Array:
+    """Weighted-mean squared error: ``sum(w·l) / sum(w)`` — the exact
+    :func:`weighted_cross_entropy` padding contract (all-ones weights
+    == plain :func:`mse`; zero-weight pad rows contribute nothing to
+    the loss), so regression episodes ride the serving batcher's
+    static buckets unchanged."""
+    per_example = _se_per_row(preds, targets)
+    weights = weights.astype(per_example.dtype)
+    return jnp.sum(weights * per_example) / jnp.sum(weights)
+
+
+def regression_score(preds: jax.Array, targets: jax.Array) -> jax.Array:
+    """Negative MSE — the regression stand-in for :func:`accuracy`.
+
+    Negated so every 'accuracy' consumer (checkpoint top-k ranking,
+    best-val selection, smoke bars) keeps its higher-is-better
+    ordering without a task_type branch (docs/ALGORITHMS.md §
+    Sinusoid regression)."""
+    return -mse(preds, targets)
+
+
+def task_loss_fns(cfg):
+    """(loss, weighted_loss, metric) for the config's task type — the
+    ONE dispatch point meta/inner.py and serve/adapt.py resolve their
+    loss calls through, at trace time. Classification returns the very
+    same function objects as before the registry existed (identical
+    jaxpr — the default-path bitwise pin rides on this)."""
+    if cfg.task_type == "regression":
+        return mse, weighted_mse, regression_score
+    return cross_entropy, weighted_cross_entropy, accuracy

@@ -234,19 +234,21 @@ def state_avals(state, mesh):
 
 def episode_aval(cfg: MAMLConfig, mesh, batch_size: int) -> Episode:
     """The task-sharded Episode signature the loader ships (wire dtype
-    from ``transfer_images_uint8``, labels int32)."""
+    from ``transfer_images_uint8``, labels ``cfg.label_dtype`` — int32
+    class ids, or float32 regression targets)."""
     bsh = batch_sharding(mesh)
     h, w, c = cfg.image_shape
     img = np.uint8 if cfg.transfer_images_uint8 else np.float32
+    lbl = np.dtype(cfg.label_dtype)
 
     def a(shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype, sharding=bsh)
 
     return Episode(
         support_x=a((batch_size, cfg.num_support_per_task, h, w, c), img),
-        support_y=a((batch_size, cfg.num_support_per_task), np.int32),
+        support_y=a((batch_size, cfg.num_support_per_task), lbl),
         target_x=a((batch_size, cfg.num_target_per_task, h, w, c), img),
-        target_y=a((batch_size, cfg.num_target_per_task), np.int32))
+        target_y=a((batch_size, cfg.num_target_per_task), lbl))
 
 
 def epoch_aval() -> jax.ShapeDtypeStruct:
@@ -274,7 +276,7 @@ def serve_adapt_avals(cfg: MAMLConfig, mesh, params, lslr, bn_state,
 
     return (params, lslr, bn_state,
             a((b, support_rows, h, w, c), wire),
-            a((b, support_rows), np.int32),
+            a((b, support_rows), np.dtype(cfg.label_dtype)),
             a((b, support_rows), np.float32))
 
 

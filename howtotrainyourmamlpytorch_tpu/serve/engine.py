@@ -57,6 +57,7 @@ from howtotrainyourmamlpytorch_tpu import resilience
 from howtotrainyourmamlpytorch_tpu.ckpt.registry import ModelRegistry
 from howtotrainyourmamlpytorch_tpu.resilience import flightrec, watchdog
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.meta.inner import adapted_param_counts
 from howtotrainyourmamlpytorch_tpu.meta.outer import (
     MetaTrainState, init_train_state, migrate_lslr_rows,
     reconcile_loaded_shapes, state_leaf_shapes)
@@ -123,8 +124,12 @@ class ServingEngine:
         self.state = replicate_state(state, self.mesh)
         # Cache entries must die with the weights that produced them:
         # the fingerprint folds in this context (checkpoint fingerprint
-        # when loaded via from_checkpoint).
-        self._fp_context = state_context
+        # when loaded via from_checkpoint) — prefixed with the meta-
+        # algorithm, because entry VALUE SHAPES are algorithm-dependent
+        # (ANIL caches head-only fast leaves; MAML++ the full fast set,
+        # meta/algos/) and a key collision across algorithms on the same
+        # checkpoint geometry would hand predict a wrong-shaped entry.
+        self._fp_context = f"algo={cfg.meta_algorithm};{state_context}"
         self.batcher = RequestBatcher(
             cfg.serve_bucket_shapes,
             max_queue_depth=cfg.serve_max_queue_depth,
@@ -141,6 +146,13 @@ class ServingEngine:
         self.cache = AdaptedParamsLRU(cfg.serve_cache_capacity)
         self.registry = registry if registry is not None else (
             MetricsRegistry())
+        # Algorithm identity gauges (telemetry report "algo" section):
+        # how many parameters the adapt executable actually updates —
+        # under ANIL's head-only mask the adapted count (and with it
+        # every cache entry and the adapt program itself) shrinks.
+        adapted, total = adapted_param_counts(cfg, state.params)
+        self.registry.gauge("algo/adapted_params").set(adapted)
+        self.registry.gauge("algo/total_params").set(total)
         # Shared L2 adapted-params tier (serve/fleet/l2cache.py): on an
         # L1 miss the engine probes it before paying the adapt
         # executable, and publishes fresh adaptations into it — so a
@@ -697,7 +709,8 @@ class ServingEngine:
         does the fleet replica's startup rollback away from a
         fleet-rejected version (serve/fleet/replica.py)."""
         self.state = state
-        self._fp_context = (f"ckpt:{rec['tag']}:"
+        self._fp_context = (f"algo={self.cfg.meta_algorithm};"
+                            f"ckpt:{rec['tag']}:"
                             f"{rec.get('fingerprint')}")
         self._state_fingerprint = rec.get("fingerprint")
         self._model_version = int(rec.get("version") or 0)
@@ -999,4 +1012,7 @@ class ServingEngine:
         self.registry.gauge("serve/queue_depth").set(self.batcher.depth)
         if self._reqtrace_ring is not None:
             self._reqtrace_ring.flush(jsonl, **extra)
+        # Stamp the algorithm onto the row so the report can attribute
+        # serve/adapt_seconds per variant (telemetry "algo" section).
+        extra.setdefault("meta_algorithm", self.cfg.meta_algorithm)
         return self.registry.flush_jsonl(jsonl, **extra)

@@ -47,8 +47,15 @@ from typing import Any, Dict, List, Optional, Union
 # cross-checked against the explicit request_trace rows, which are
 # assembled into traces for the linked fraction, dominant latency
 # tier and tenant count; SLO good/bad totals reset-aware, burn-rate
-# gauge last-wins)
-SCHEMA = "maml_tpu_telemetry_report_v14"
+# gauge last-wins);
+# v15: + "algo" (meta-algorithm registry, meta/algos/: which algorithm
+# the run trains/serves and how many parameters its inner loop adapts
+# — identity/counts last-signal from the explicit "algo" rows and the
+# algo/* gauges; serve adapt-seconds p50 last-signal PER VARIANT from
+# the meta_algorithm-stamped serving metrics rows, whose adapt-batch
+# counters accumulate reset-aware per (replica source, variant) like
+# the fleet section)
+SCHEMA = "maml_tpu_telemetry_report_v15"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -842,6 +849,76 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "slo_burn_rate": rq_burn,
         }
 
+    # Algo section (meta/algos/ registry, schema v15): identity and
+    # adapted/total parameter counts take the most recent signal in log
+    # order — a restart or hot-swap legitimately re-emits them (and an
+    # ANIL swap CHANGES the adapted count; last wins is the live truth).
+    # Serving metrics rows are stamped with their engine's
+    # meta_algorithm, so adapt-seconds p50 is tracked per variant
+    # (last-signal) and adapt-batch counters accumulate reset-aware per
+    # (replica source, variant) — one log interleaves several replicas'
+    # flushes across restarts. Logs predating the registry summarize to
+    # "unavailable".
+    al_seen = False
+    al_name: Metric = UNAVAILABLE
+    al_task: Metric = UNAVAILABLE
+    al_adapted: Metric = UNAVAILABLE
+    al_total: Metric = UNAVAILABLE
+    al_adapt_p50: Dict[str, Any] = {}
+    al_totals: Dict[str, float] = {}
+    al_prev: Dict[str, float] = {}
+    for e in events:
+        if e.get("event") == "algo":
+            al_seen = True
+            if e.get("meta_algorithm") is not None:
+                al_name = str(e["meta_algorithm"])
+            if e.get("task_type") is not None:
+                al_task = str(e["task_type"])
+            if e.get("adapted_params") is not None:
+                al_adapted = int(e["adapted_params"])
+            if e.get("total_params") is not None:
+                al_total = int(e["total_params"])
+        elif e.get("event") == "metrics":
+            m = e.get("metrics") or {}
+            if m.get("algo/adapted_params") is not None:
+                al_seen = True
+                al_adapted = int(m["algo/adapted_params"])
+            if m.get("algo/total_params") is not None:
+                al_seen = True
+                al_total = int(m["algo/total_params"])
+            algo = e.get("meta_algorithm")
+            if algo is None:
+                continue
+            al_seen = True
+            al_name = str(algo)
+            hist = m.get("serve/adapt_seconds")
+            if isinstance(hist, dict) and hist.get("p50") is not None:
+                al_adapt_p50[str(algo)] = round(float(hist["p50"]), 6)
+            if m.get("serve/adapt_batches") is not None:
+                source = str(e.get("replica", ""))
+                _accumulate_counter(al_totals, al_prev,
+                                    f"{source}:{algo}",
+                                    float(m["serve/adapt_batches"]))
+    algo_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if al_seen:
+        al_batches = {
+            variant: int(sum(v for k, v in al_totals.items()
+                             if k.split(":", 1)[1] == variant))
+            for variant in {k.split(":", 1)[1] for k in al_totals}}
+        algo_sec = {
+            "meta_algorithm": al_name,
+            "task_type": al_task,
+            "adapted_params": al_adapted,
+            "total_params": al_total,
+            "adapted_frac": (
+                round(al_adapted / al_total, 4)
+                if isinstance(al_adapted, int)
+                and isinstance(al_total, int) and al_total
+                else UNAVAILABLE),
+            "adapt_seconds_p50": al_adapt_p50 or UNAVAILABLE,
+            "adapt_batches": al_batches or UNAVAILABLE,
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -883,6 +960,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "perf": perf_sec,
         "tune": tune_sec,
         "requests": requests_sec,
+        "algo": algo_sec,
     }
 
 
@@ -922,6 +1000,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("perf", summary["perf"]),
         ("tune", summary["tune"]),
         ("requests", summary["requests"]),
+        ("algo", summary["algo"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

@@ -82,6 +82,11 @@ SHARD_SIZE_OVERRIDES = {
     "tests/test_reqtrace.py": 120_000,      # traced 2-replica fleet
     #                                         smoke + slo_report CLI
     #                                         subprocesses
+    "tests/test_algos.py": 60_000,          # slow half compiles the
+    #                                         flagship train step twice
+    #                                         (bitwise pin) + two
+    #                                         serving engines (ANIL
+    #                                         serve comparison)
 }
 
 

@@ -259,6 +259,23 @@ def main() -> int:
         "serve_cache_hit_frac": (round(hits / (hits + misses), 4)
                                  if hits + misses else None),
         "adapt_batches": engine.adapt_invocations,
+        # Algorithm identity + adapted-footprint keys (meta/algos/):
+        # the ANIL serve proof reads THESE — under the head-only mask
+        # the adapted-param count, the mean cache entry and the adapt
+        # p50 all shrink vs maml++ on the same checkpoint geometry
+        # (docs/PERF.md § Meta-algorithm zoo; tests/test_algos.py pins
+        # the structural halves).
+        "meta_algorithm": cfg.meta_algorithm,
+        "adapted_params": int(
+            engine.registry.gauge("algo/adapted_params").value or 0),
+        "total_params": int(
+            engine.registry.gauge("algo/total_params").value or 0),
+        "cache_entries": len(engine.cache),
+        "cache_entry_bytes_mean": (
+            round(engine.cache.approx_bytes / len(engine.cache), 1)
+            if len(engine.cache) else None),
+        "adapt_seconds_p50": engine.registry.histogram(
+            "serve/adapt_seconds").quantile(0.5),
         "warmup_seconds": round(warmup_seconds, 3),
         "compile_count_warmup": compiles_after_warmup,
         # The steady-state no-recompile guarantee, in the artifact: any

@@ -103,12 +103,23 @@ def test_gate_threshold_is_mean_minus_ci():
         dataset_name = "mini_imagenet_full_size"
         num_classes_per_set = 5
         num_samples_per_class = 5
+        meta_algorithm = "maml++"
     mean, ci = accuracy_gate.paper_gate(_C)
     assert (mean, ci) == (0.6832, 0.0044)
     _C.num_samples_per_class = 1
     assert accuracy_gate.paper_gate(_C) == (0.5215, 0.0026)
     _C.dataset_name = "omniglot_dataset"
     assert accuracy_gate.paper_gate(_C) == (0.9947, 0.0)
+    # The algorithm picks the table: fomaml rows come from the MAML
+    # paper's first-order entries (BASELINE.md § FOMAML), and the
+    # no-paper-row algorithms resolve None (the gate then demands an
+    # explicit --min-accuracy).
+    _C.meta_algorithm = "fomaml"
+    assert accuracy_gate.paper_gate(_C) == (0.987, 0.004)
+    _C.dataset_name = "mini_imagenet_full_size"
+    assert accuracy_gate.paper_gate(_C) == (0.4807, 0.0175)
+    _C.meta_algorithm = "reptile"
+    assert accuracy_gate.paper_gate(_C) is None
 
 
 @pytest.mark.slow

@@ -51,6 +51,31 @@ def test_full_experiment_end_to_end(tmp_path):
                                        "config.json"))
 
 
+def test_regression_experiment_ensemble_reports_mse(tmp_path):
+    """The test protocol must score a regression workload by the MSE of
+    the ensemble-averaged predictions: the classification softmax/argmax
+    vote over a 1-unit head would report accuracy 1.0 unconditionally
+    (found driving the sinusoid config end-to-end)."""
+    cfg = _cfg(tmp_path, dataset_name="sinusoid_synthetic",
+               backbone="mlp", task_type="regression",
+               image_height=1, image_width=1, image_channels=1,
+               num_classes_per_set=1, num_samples_per_class=5,
+               num_target_samples=5, cnn_num_filters=16,
+               use_multi_step_loss_optimization=False,
+               transfer_images_uint8=False)
+    result = ExperimentBuilder(cfg).run_experiment()
+    assert result["num_models"] == 2
+    # −MSE, the epoch loop's "accuracy" convention — strictly negative on
+    # noise-fit sinusoids, never the degenerate argmax 1.0.
+    assert result["test_accuracy_mean"] < 0.0
+    assert result["test_mse_mean"] == pytest.approx(
+        -result["test_accuracy_mean"])
+    assert np.isfinite(result["test_mse_mean"])
+    test_stats = load_statistics(
+        ExperimentBuilder(cfg).paths["logs"], "test_summary.csv")
+    assert "test_mse_mean" in test_stats
+
+
 def test_full_experiment_from_disk_dataset(tmp_path):
     """The real-data user's first path: a reference-layout on-disk PNG
     tree (datasets/<name>/{train,val,test}/<class>/*.png) must drive the

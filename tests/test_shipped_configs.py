@@ -21,7 +21,8 @@ import pytest
 
 from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
 from howtotrainyourmamlpytorch_tpu.data.sampler import EpisodeSampler
-from howtotrainyourmamlpytorch_tpu.data.sources import SyntheticSource
+from howtotrainyourmamlpytorch_tpu.data.sources import (
+    SinusoidSource, SyntheticSource)
 from howtotrainyourmamlpytorch_tpu.meta import init_train_state
 from howtotrainyourmamlpytorch_tpu.models import make_model
 from howtotrainyourmamlpytorch_tpu.parallel import (
@@ -49,8 +50,7 @@ def test_shipped_config_trains_one_step(path):
     # all stay non-empty — max_pool2d raises on anything smaller, and
     # before that check a 12px VGG silently trained on EMPTY feature maps
     # (flatten of a 0-sized spatial dim -> all-zero logits, finite loss).
-    cfg = cfg.replace(
-        image_height=16, image_width=16,
+    shrink = dict(
         cnn_num_filters=4, batch_size=2,
         mesh_shape=(1, 1),
         total_epochs=2, total_iter_per_epoch=2,
@@ -59,12 +59,26 @@ def test_shipped_config_trains_one_step(path):
         # the gcd with the scaled batch (2) still exercises mb=2
         # chunked accumulation with each config's exact toggle set.
         task_microbatches=math.gcd(2, cfg.task_microbatches))
+    if cfg.task_type != "regression":
+        # 16px: the smallest size whose four pooling stages stay
+        # non-empty (see module comment above). Regression ships 1x1x1
+        # scalar "images" already — nothing to shrink, and resizing
+        # would change the MLP's input contract.
+        shrink.update(image_height=16, image_width=16)
+    cfg = cfg.replace(**shrink)
 
-    src = SyntheticSource(
-        num_classes=max(2 * cfg.num_classes_per_set, 8),
-        images_per_class=2 * (cfg.num_samples_per_class
-                              + cfg.num_target_samples),
-        image_size=cfg.image_shape, seed=5)
+    if cfg.task_type == "regression":
+        src = SinusoidSource(
+            num_tasks=max(2 * cfg.num_classes_per_set, 8),
+            points_per_task=2 * (cfg.num_samples_per_class
+                                 + cfg.num_target_samples),
+            seed=5)
+    else:
+        src = SyntheticSource(
+            num_classes=max(2 * cfg.num_classes_per_set, 8),
+            images_per_class=2 * (cfg.num_samples_per_class
+                                  + cfg.num_target_samples),
+            image_size=cfg.image_shape, seed=5)
     sampler = EpisodeSampler(src, cfg, split_seed=1)
 
     init, apply = make_model(cfg)

@@ -25,14 +25,15 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # checkpoint-lifecycle section, v8 the pod-fault-domain cluster
 # section, v9 the AOT warm-start section, v10 the elastic-pod section,
 # v11 the serving-fleet section, v12 the perf-lab section, v13 the
-# autotune section, v14 the request-tracing + SLO section).
+# autotune section, v14 the request-tracing + SLO section, v15 the
+# meta-algorithm zoo section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
     "watchdog", "health", "checkpoint", "cluster", "warm_start",
-    "elastic", "fleet", "perf", "tune", "requests",
+    "elastic", "fleet", "perf", "tune", "requests", "algo",
 }
 
 
@@ -747,6 +748,69 @@ def test_summarize_events_requests_section():
 def test_requests_section_unavailable_without_subsystem():
     s = summarize_events([{"event": "train_epoch", "epoch": 0}])
     assert s["requests"] == UNAVAILABLE
+
+
+def test_summarize_events_algo_section():
+    """Algo section (schema v15): identity/counts are last-signal (an
+    ANIL hot-swap legitimately changes the adapted count mid-log),
+    adapt p50 is tracked PER VARIANT from the meta_algorithm-stamped
+    serving rows, and adapt-batch counters accumulate reset-aware per
+    (replica, variant)."""
+    events = [
+        {"event": "algo", "meta_algorithm": "maml++",
+         "task_type": "classification", "adapted_params": 1000,
+         "total_params": 1000},
+        {"event": "metrics", "meta_algorithm": "maml++",
+         "replica": "r1",
+         "metrics": {"serve/adapt_seconds": {"count": 4, "sum": 0.8,
+                                             "p50": 0.2, "p95": 0.3},
+                     "serve/adapt_batches": 10.0}},
+        # Replica restart: the counter RESETS to 4 — accumulated total
+        # must read 14, not max(10, 4).
+        {"event": "metrics", "meta_algorithm": "maml++",
+         "replica": "r1",
+         "metrics": {"serve/adapt_batches": 4.0}},
+        # Hot-swap onto the ANIL variant: last signal wins for identity
+        # and counts; its adapt p50 lands under its own variant key.
+        {"event": "algo", "meta_algorithm": "anil",
+         "task_type": "classification", "adapted_params": 100,
+         "total_params": 1000},
+        {"event": "metrics", "meta_algorithm": "anil",
+         "replica": "r2",
+         "metrics": {"serve/adapt_seconds": {"count": 2, "sum": 0.1,
+                                             "p50": 0.05, "p95": 0.06},
+                     "serve/adapt_batches": 6.0}},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    al = s["algo"]
+    assert al["meta_algorithm"] == "anil"
+    assert al["task_type"] == "classification"
+    assert al["adapted_params"] == 100
+    assert al["total_params"] == 1000
+    assert al["adapted_frac"] == pytest.approx(0.1)
+    assert al["adapt_seconds_p50"] == {"maml++": 0.2, "anil": 0.05}
+    assert al["adapt_batches"] == {"maml++": 14, "anil": 6}
+    assert "algo" in format_table(s)
+
+
+def test_algo_section_gauge_rows_without_algo_event():
+    """A serving-only log (no trainer 'algo' row) still summarizes from
+    the algo/* gauges ServingEngine mirrors into its flushes."""
+    events = [{"event": "metrics", "meta_algorithm": "anil",
+               "metrics": {"algo/adapted_params": 55.0,
+                           "algo/total_params": 550.0}}]
+    al = summarize_events(events)["algo"]
+    assert al["meta_algorithm"] == "anil"
+    assert al["adapted_params"] == 55 and al["total_params"] == 550
+    assert al["adapted_frac"] == pytest.approx(0.1)
+    assert al["adapt_seconds_p50"] == UNAVAILABLE
+    assert al["adapt_batches"] == UNAVAILABLE
+
+
+def test_algo_section_unavailable_without_subsystem():
+    s = summarize_events([{"event": "train_epoch", "epoch": 0}])
+    assert s["algo"] == UNAVAILABLE
 
 
 def test_health_section_nonfinite_grad_norm_visible():
