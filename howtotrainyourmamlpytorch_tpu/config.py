@@ -419,6 +419,37 @@ class MAMLConfig:
                                            # (1 - target): 1.0 = burning
                                            # the error budget exactly at
                                            # the sustainable rate
+    fleet_supervisor: int = 0              # 1 = a ReplicaSupervisor owns
+                                           # the fleet: spawns replicas,
+                                           # restarts crashes with backoff,
+                                           # acts on advise() verdicts.
+                                           # 0 (default): NOTHING is
+                                           # installed — replicas are
+                                           # launched externally and
+                                           # serving is bitwise identical
+    fleet_max_restarts: int = 3            # crash-loop breaker: restarts
+                                           # of one slot tolerated inside
+                                           # fleet_restart_window_s before
+                                           # the slot is marked failed
+                                           # (never an infinite respawn of
+                                           # a poisoned checkpoint)
+    fleet_restart_window_s: float = 60.0   # sliding window the crash-loop
+                                           # breaker counts restarts over
+    fleet_scale_min: int = 1               # autoscale floor: scale_down
+                                           # verdicts never drain below
+                                           # this many live replicas
+    fleet_scale_max: int = 4               # autoscale ceiling: scale_up
+                                           # verdicts never spawn beyond
+                                           # this many slots
+    fleet_shed_policy: str = "off"         # overload admission policy:
+                                           # 'off' (default) installs no
+                                           # estimator — admission is
+                                           # bitwise pre-shedding;
+                                           # 'deadline' sheds requests the
+                                           # queue-wait estimate already
+                                           # dooms; 'fair' adds per-tenant
+                                           # fairness (the hottest tenant
+                                           # sheds first under pressure)
 
     # ---- checkpoint lifecycle (ckpt/ subsystem, docs/CHECKPOINT.md) ----
     ckpt_async: int = 0                    # 1 = epoch saves snapshot host-
@@ -783,6 +814,27 @@ class MAMLConfig:
                 f"fleet_slo_target_frac must be in (0, 1) — 1.0 leaves "
                 f"zero error budget and the burn rate divides by it, "
                 f"got {self.fleet_slo_target_frac}")
+        if self.fleet_supervisor not in (0, 1):
+            raise ValueError(
+                f"fleet_supervisor must be 0 (replicas launched "
+                f"externally, nothing installed) or 1 (supervisor owns "
+                f"the fleet), got {self.fleet_supervisor}")
+        if self.fleet_max_restarts < 1:
+            raise ValueError("fleet_max_restarts must be >= 1")
+        if self.fleet_restart_window_s <= 0:
+            raise ValueError("fleet_restart_window_s must be > 0")
+        if self.fleet_scale_min < 1:
+            raise ValueError("fleet_scale_min must be >= 1")
+        if self.fleet_scale_max < self.fleet_scale_min:
+            raise ValueError(
+                f"fleet_scale_max {self.fleet_scale_max} < fleet_scale_min "
+                f"{self.fleet_scale_min}: the autoscale ceiling cannot sit "
+                f"below the floor")
+        if self.fleet_shed_policy not in ("off", "deadline", "fair"):
+            raise ValueError(
+                f"fleet_shed_policy must be 'off' (no estimator "
+                f"installed), 'deadline', or 'fair', got "
+                f"{self.fleet_shed_policy!r}")
         if self.flight_recorder_events < 1:
             raise ValueError("flight_recorder_events must be >= 1")
         if self.require_mesh not in (0, 1):

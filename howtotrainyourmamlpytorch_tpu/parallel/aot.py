@@ -152,7 +152,14 @@ _RUNTIME_ONLY_KEYS = frozenset({
     # the same store dir whatever its L2/lease wiring is.
     "serve_l2_dir", "serve_l2_max_entries", "fleet_lease_interval_s",
     "fleet_replica_stalled_s", "fleet_replica_dead_s", "fleet_vnodes",
-    "fleet_load_factor", "health_grad_norm_warn_factor",
+    "fleet_load_factor",
+    # Fleet supervision is pure process lifecycle + admission POLICY:
+    # spawning/draining replicas and shedding at admission can never
+    # change a compiled program, and a supervised fleet must hit the
+    # same store an unsupervised run prewarmed.
+    "fleet_supervisor", "fleet_max_restarts", "fleet_restart_window_s",
+    "fleet_scale_min", "fleet_scale_max", "fleet_shed_policy",
+    "health_grad_norm_warn_factor",
     "dispatch_sync_every", "live_progress", "use_tensorboard",
     "profile_dir", "profile_epoch", "profile_num_steps",
     # The perf sampler is pure host-side observation on a cadence: the

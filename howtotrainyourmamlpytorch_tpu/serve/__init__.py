@@ -32,10 +32,13 @@ Pieces (docs/SERVING.md has the full lifecycle):
 """
 
 from howtotrainyourmamlpytorch_tpu.serve.batcher import (
+    AdmissionController,
     BucketError,
     FewShotRequest,
     QueueFullError,
     RequestBatcher,
+    ShedError,
+    estimate_queue_wait,
 )
 from howtotrainyourmamlpytorch_tpu.serve.cache import (
     AdaptedParamsLRU,
@@ -47,7 +50,8 @@ from howtotrainyourmamlpytorch_tpu.serve.engine import (
 )
 
 __all__ = [
-    "AdaptedParamsLRU", "BucketError", "FewShotRequest",
-    "FewShotResponse", "QueueFullError", "RequestBatcher",
-    "ServingEngine", "support_fingerprint",
+    "AdaptedParamsLRU", "AdmissionController", "BucketError",
+    "FewShotRequest", "FewShotResponse", "QueueFullError",
+    "RequestBatcher", "ServingEngine", "ShedError",
+    "estimate_queue_wait", "support_fingerprint",
 ]

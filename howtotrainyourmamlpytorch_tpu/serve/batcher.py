@@ -39,12 +39,26 @@ deadline passes while queued is dropped at dequeue time and answered
 with a ``deadline_exceeded`` error response (adapting for a caller
 that already gave up wastes a batch slot someone else could use).
 
+**Deadline-aware shedding** (``cfg.fleet_shed_policy``) moves that
+drop to the DOOR: an :class:`AdmissionController` — installed on the
+batcher only when the policy is on, the structural zero-cost pin
+discipline — estimates the new request's queue wait from a rolling
+per-bucket batch service time (:func:`estimate_queue_wait`, pure) and
+raises :class:`ShedError` when the estimate already dooms the
+deadline. A shed request is refused before any queueing side effect
+(distinct ``shed`` response status), never timed out after the engine
+spent a batch slot on it. The ``fair`` policy adds per-tenant
+fairness: under queue pressure a tenant holding more than its fair
+share of the queue sheds first, so one hot tenant cannot starve the
+rest (docs/SERVING.md § Self-healing fleet).
+
 Pure host-side code (numpy only) — unit-testable without compiles.
 """
 
 from __future__ import annotations
 
 import itertools
+import math
 import threading
 import time
 from collections import deque
@@ -63,7 +77,159 @@ class BucketError(ValueError):
     deployment's wire dtype)."""
 
 
+class ShedError(RuntimeError):
+    """Deadline-aware admission shed: the queue-wait estimate already
+    dooms this request's deadline (or the tenant is over its fair
+    share under pressure), so it is refused at the DOOR — before any
+    queueing side effect — instead of timing out after the engine
+    spent work on it. Distinct from :class:`QueueFullError`: a shed is
+    a policy decision the caller must not blindly retry."""
+
+
 _ids = itertools.count()
+
+
+def estimate_queue_wait(queued_ahead: int, batch_tasks: int,
+                        service_time_s: float) -> float:
+    """Expected seconds until a newly admitted request's OWN batch
+    completes: the engine drains the queue in groups of up to
+    ``batch_tasks`` at ``service_time_s`` per batch, so a request with
+    ``queued_ahead`` requests in front of it rides batch
+    ``queued_ahead // batch_tasks`` and completes when that batch does.
+    Pure (pinned in tier-1 tests); deliberately simple — a rolling
+    mean feeds it, and admission only needs the estimate to be honest
+    about ORDER of magnitude, not scheduling-exact."""
+    if queued_ahead < 0:
+        raise ValueError(f"queued_ahead must be >= 0, got {queued_ahead}")
+    if batch_tasks < 1:
+        raise ValueError(f"batch_tasks must be >= 1, got {batch_tasks}")
+    if service_time_s < 0:
+        raise ValueError(
+            f"service_time_s must be >= 0, got {service_time_s}")
+    return (queued_ahead // batch_tasks + 1) * service_time_s
+
+
+class AdmissionController:
+    """Shed-at-admission policy state (``cfg.fleet_shed_policy``).
+
+    Installed on a :class:`RequestBatcher` ONLY when the policy is on
+    (``"deadline"`` or ``"fair"``); the default ``"off"`` installs
+    nothing and every submit pays one ``is None`` check — the
+    reqtrace/watchdog structural zero-cost discipline, pinned in
+    tests. Thread-safe: the engine loop records service times while
+    frontend threads admit.
+
+    * ``record_service(bucket, seconds)`` — rolling per-bucket EWMA of
+      batch service time (dequeue -> responses ready), fed by the
+      engine after every served group, normalized by the caller to
+      FULL-batch cost (adapts are serial, so a small batch's raw time
+      understates the loaded drain rate). Until a bucket has a sample,
+      deadline admission for it is permissive (no estimate, no shed —
+      never guess).
+    * ``admit(...)`` — raises :class:`ShedError` when the queue-wait
+      estimate says the deadline cannot be met, or (``fair``) when the
+      queue is under pressure and this tenant already holds more than
+      its fair share ``ceil(depth / distinct queued tenants)``.
+    """
+
+    def __init__(self, batch_tasks: int, max_queue_depth: int,
+                 policy: str = "deadline", *, ewma_alpha: float = 0.3,
+                 pressure_frac: float = 0.5, headroom: float = 1.5):
+        if policy not in ("deadline", "fair"):
+            raise ValueError(
+                f"policy must be 'deadline' or 'fair' (use no controller "
+                f"at all for 'off'), got {policy!r}")
+        if not 0.0 < ewma_alpha <= 1.0:
+            raise ValueError(f"ewma_alpha must be in (0, 1], got "
+                             f"{ewma_alpha}")
+        if headroom < 1.0:
+            raise ValueError(
+                f"headroom must be >= 1.0, got {headroom}")
+        self.batch_tasks = int(batch_tasks)
+        self.max_queue_depth = int(max_queue_depth)
+        self.policy = policy
+        self.ewma_alpha = float(ewma_alpha)
+        self.headroom = float(headroom)
+        self.pressure_depth = max(1, int(pressure_frac * max_queue_depth))
+        self.sheds = 0
+        self._service_s: Dict[Tuple[int, int], float] = {}
+        self._tenant_queued: Dict[object, int] = {}
+        self._lock = threading.Lock()
+
+    def record_service(self, bucket: Tuple[int, int],
+                       seconds: float) -> None:
+        if seconds < 0:
+            return  # a clock anomaly must not poison the estimate
+        with self._lock:
+            prev = self._service_s.get(bucket)
+            self._service_s[bucket] = (
+                seconds if prev is None
+                else prev + self.ewma_alpha * (seconds - prev))
+
+    def service_time_s(self, bucket: Tuple[int, int]) -> Optional[float]:
+        with self._lock:
+            return self._service_s.get(bucket)
+
+    def admit(self, bucket: Tuple[int, int], deadline: Optional[float],
+              now: float, depth: int, tenant: object = None) -> None:
+        """Shed verdict for one request about to enqueue (raises
+        :class:`ShedError`; returns None on admit). Called by the
+        batcher under its queue lock, so ``depth`` and the tenant
+        counts are consistent with the queue state."""
+        with self._lock:
+            svc = self._service_s.get(bucket)
+            # Liveness floor: never deadline-shed into an (almost) idle
+            # engine. With fewer than one full batch queued the engine
+            # starts this request's batch next, and serving it is the
+            # ONLY way the EWMA refreshes — shedding at depth 0 on a
+            # stale-high estimate (one slow batch, e.g. a compile)
+            # would starve the estimator forever.
+            if (svc is not None and deadline is not None
+                    and depth >= self.batch_tasks
+                    and math.isfinite(deadline)):
+                # ``headroom`` inflates the estimate: a request whose
+                # PREDICTED completion sits exactly on the deadline
+                # would miss it on any positive variance, and a miss
+                # after queueing is the failure shedding exists to
+                # prevent — shed the boundary, not just the excess.
+                eta = now + self.headroom * estimate_queue_wait(
+                    depth, self.batch_tasks, svc)
+                if eta > deadline:
+                    self.sheds += 1
+                    raise ShedError(
+                        f"queue-wait estimate {eta - now:.3f}s puts "
+                        f"completion past the deadline "
+                        f"({deadline - now:.3f}s away) at depth {depth}")
+            if (self.policy == "fair" and tenant is not None
+                    and depth + 1 > self.pressure_depth):
+                active = len(self._tenant_queued)
+                if tenant not in self._tenant_queued:
+                    active += 1
+                share = max(1, math.ceil((depth + 1) / max(active, 1)))
+                held = self._tenant_queued.get(tenant, 0)
+                if held + 1 > share:
+                    self.sheds += 1
+                    raise ShedError(
+                        f"tenant {tenant!r} holds {held} of {depth} "
+                        f"queued requests (fair share {share} across "
+                        f"{active} tenants) under queue pressure")
+
+    def note_enqueued(self, tenant: object) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            self._tenant_queued[tenant] = (
+                self._tenant_queued.get(tenant, 0) + 1)
+
+    def note_removed(self, tenant: object) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            n = self._tenant_queued.get(tenant, 0)
+            if n <= 1:
+                self._tenant_queued.pop(tenant, None)
+            else:
+                self._tenant_queued[tenant] = n - 1
 
 
 @dataclass
@@ -78,6 +244,8 @@ class FewShotRequest:
     batcher at ADMISSION (None until then) — bucket wait is measured
     from there, not from dequeue. ``trace`` is the optional request-
     trace context (telemetry/reqtrace.py); None = unsampled.
+    ``tenant`` is an opaque caller identity used ONLY by fair shedding
+    (``fleet_shed_policy='fair'``); None opts out of fairness.
     """
     support_x: np.ndarray
     support_y: np.ndarray
@@ -87,6 +255,7 @@ class FewShotRequest:
     arrival_time: float = field(default_factory=time.monotonic)
     enqueue_time: Optional[float] = None
     trace: Optional[dict] = None
+    tenant: Optional[object] = None
 
     def __post_init__(self) -> None:
         self.support_x = np.asarray(self.support_x)
@@ -151,6 +320,11 @@ class RequestBatcher:
         self.image_shape = (None if image_shape is None
                             else tuple(int(v) for v in image_shape))
         self.num_classes = None if num_classes is None else int(num_classes)
+        # Shed-at-admission policy (fleet_shed_policy): None — the
+        # default — installs NOTHING; submit pays one `is None` check
+        # (the structural zero-cost pin). The engine installs an
+        # AdmissionController when the policy is on.
+        self.admission: Optional[AdmissionController] = None
         self._queue: Deque[Tuple[FewShotRequest, Tuple[int, int]]] = deque()
         self._lock = threading.Lock()
 
@@ -205,6 +379,16 @@ class RequestBatcher:
             if len(self._queue) >= self.max_queue_depth:
                 raise QueueFullError(
                     f"serve queue at max depth {self.max_queue_depth}")
+            now = time.monotonic() if now is None else now
+            if self.admission is not None:
+                # Shed verdict BEFORE any side effect (same contract as
+                # the rejections above): the deadline judged is the one
+                # the request would carry once stamped.
+                deadline = req.deadline
+                if deadline is None and stamp_deadline:
+                    deadline = now + self.default_deadline_ms / 1e3
+                self.admission.admit(bucket, deadline, now,
+                                     len(self._queue), tenant=req.tenant)
             # Stamped only once admission is certain: a rejected submit
             # must leave the request untouched (the caller may retry it
             # later, and the deadline clock must not have been running
@@ -212,11 +396,12 @@ class RequestBatcher:
             # instant — queue wait is measured from ADMISSION, not from
             # dequeue, or bucket wait would be invisibly attributed to
             # whatever phase dequeues the request.
-            now = time.monotonic() if now is None else now
             if stamp_deadline:
                 req.deadline = now + self.default_deadline_ms / 1e3
             req.enqueue_time = now
             self._queue.append((req, bucket))
+            if self.admission is not None:
+                self.admission.note_enqueued(req.tenant)
         return bucket
 
     def next_group(self, max_tasks: int, now: Optional[float] = None
@@ -248,6 +433,11 @@ class RequestBatcher:
                 else:
                     kept.append((req, b))
             self._queue = kept
+            if self.admission is not None:
+                for req in group:
+                    self.admission.note_removed(req.tenant)
+                for req in expired:
+                    self.admission.note_removed(req.tenant)
         return (bucket or self.buckets[0]), group, expired
 
 

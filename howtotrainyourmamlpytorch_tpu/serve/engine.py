@@ -68,7 +68,8 @@ from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
 from howtotrainyourmamlpytorch_tpu.serve.adapt import (
     AdaptedTask, make_serve_steps)
 from howtotrainyourmamlpytorch_tpu.serve.batcher import (
-    FewShotRequest, QueueFullError, RequestBatcher, pad_group)
+    AdmissionController, FewShotRequest, QueueFullError, RequestBatcher,
+    ShedError, pad_group)
 from howtotrainyourmamlpytorch_tpu.serve.cache import (
     AdaptedParamsLRU, support_fingerprint)
 from howtotrainyourmamlpytorch_tpu.serve.fleet.l2cache import (
@@ -93,7 +94,12 @@ class FewShotResponse:
     deadline misses. ``cache_tier`` names WHERE the adaptation came
     from — ``"l1"`` (in-proc LRU), ``"l2"`` (shared fleet tier), or
     None (freshly adapted / errored) — the fleet bench asserts tenant
-    migration on it."""
+    migration on it. ``status`` is the coarse outcome the fleet wire
+    protocol and benches classify on: ``"ok"`` (served), ``"shed"``
+    (refused at admission by the shed policy — a deliberate overload
+    drop, never retried blindly), ``"rejected"`` (queue
+    full / malformed — retryable), ``"failed"`` (accepted but not
+    served: deadline miss after queueing, failover exhaustion)."""
     request_id: int
     predictions: Optional[np.ndarray]
     logits: Optional[np.ndarray]
@@ -101,6 +107,7 @@ class FewShotResponse:
     latency_seconds: float
     error: Optional[str] = None
     cache_tier: Optional[str] = None
+    status: str = "ok"
 
 
 class ServingEngine:
@@ -143,9 +150,24 @@ class ServingEngine:
                         else np.float32),
             image_shape=cfg.image_shape,
             num_classes=cfg.num_classes_per_set)
+        # Deadline-aware shed-at-admission (serve/batcher.py §
+        # AdmissionController): installed ONLY when the policy is on —
+        # the default "off" leaves batcher.admission None (one falsy
+        # check per submit) and registers no counter, so serving is
+        # structurally identical (pinned in tests/test_fleet_supervisor).
+        if cfg.fleet_shed_policy != "off":
+            self.batcher.admission = AdmissionController(
+                cfg.serve_batch_tasks,
+                cfg.serve_max_queue_depth,
+                policy=cfg.fleet_shed_policy)
         self.cache = AdaptedParamsLRU(cfg.serve_cache_capacity)
         self.registry = registry if registry is not None else (
             MetricsRegistry())
+        if self.batcher.admission is not None:
+            # Eager registration (a flush row shows "0 sheds", not an
+            # absent key) — gated on the policy so the default-off
+            # registry snapshot stays byte-identical to pre-shedding.
+            self.registry.counter("serve/shed_total")
         # Algorithm identity gauges (telemetry report "algo" section):
         # how many parameters the adapt executable actually updates —
         # under ANIL's head-only mask the adapted count (and with it
@@ -377,6 +399,11 @@ class ServingEngine:
         t0 = time.monotonic() if trace is not None else 0.0
         try:
             bucket = self.batcher.submit(req, now=now)
+        except ShedError:
+            # Deliberate overload drop, distinct from the retryable
+            # rejections below — the caller answers with status "shed".
+            reg.counter("serve/shed_total").inc()
+            raise
         except (QueueFullError, ValueError):
             reg.counter("serve/rejected_total").inc()
             raise
@@ -488,7 +515,7 @@ class ServingEngine:
                 request_id=req.request_id, predictions=None, logits=None,
                 cache_hit=False,
                 latency_seconds=t_now - req.arrival_time,
-                error="deadline_exceeded"))
+                error="deadline_exceeded", status="failed"))
         reg.gauge("serve/queue_depth").set(self.batcher.depth)
         if not group:
             return responses
@@ -621,6 +648,29 @@ class ServingEngine:
                 cache_hit=hit_flags[i],
                 latency_seconds=t_done - req.arrival_time,
                 cache_tier=tiers[i]))
+        if self.batcher.admission is not None:
+            # Feed the shed policy's queue-wait estimator. Two honesty
+            # corrections over the naive dequeue->done duration:
+            # (1) Under backlog, the cost a queued request pays per
+            #     batch is the COMPLETION INTERVAL (previous batch done
+            #     -> this batch done), which includes the inter-batch
+            #     overhead — response sends, queue scans, heartbeats —
+            #     that the in-batch duration never sees. Measured
+            #     intervals run ~2x the in-batch time; estimating from
+            #     the latter admits requests the drain rate can't save.
+            # (2) Normalize to FULL-batch cost: adapts run serially
+            #     inside a batch, so a half-full batch's time
+            #     understates what a saturated queue pays per batch.
+            adm = self.batcher.admission
+            raw = t_done - t_deq
+            prev_done = getattr(self, "_adm_last_done", None)
+            if prev_done is not None and getattr(
+                    self, "_adm_backlog_at_done", False):
+                raw = t_done - prev_done
+            self._adm_last_done = t_done
+            self._adm_backlog_at_done = self.batcher.depth > 0
+            adm.record_service(bucket,
+                               raw * adm.batch_tasks / len(group))
         self._mirror_cache_counters()
         return responses
 

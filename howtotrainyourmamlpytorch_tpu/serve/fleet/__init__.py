@@ -9,12 +9,14 @@ hot-swap a one-replica-at-a-time rolling swap with fleet-wide canary
 pinning (``fleet/controller.py``), and the replica worker process the
 router routes to (``fleet/replica.py``).
 
-Import discipline: router/l2cache/controller have NO package imports
-(stdlib + numpy only) so a frontend process can load them by file path
-and stay jax-free — ``scripts/fleet_bench.py`` does. Importing them
-through THIS package is the convenient path for code that already pays
-the jax import (tests, the engine). ``replica`` is deliberately not
-imported here: it is a worker entrypoint that builds a full engine.
+Import discipline: router/l2cache/controller/supervisor have NO
+package imports (stdlib + numpy only; supervisor is pure stdlib) so a
+frontend process can load them by file path and stay jax-free —
+``scripts/fleet_bench.py`` and ``scripts/chaos_fleet.py`` do.
+Importing them through THIS package is the convenient path for code
+that already pays the jax import (tests, the engine). ``replica`` is
+deliberately not imported here: it is a worker entrypoint that builds
+a full engine.
 """
 
 from howtotrainyourmamlpytorch_tpu.serve.fleet.controller import (
@@ -25,14 +27,22 @@ from howtotrainyourmamlpytorch_tpu.serve.fleet.l2cache import (
     L2AdaptedParamsCache,
 )
 from howtotrainyourmamlpytorch_tpu.serve.fleet.router import (
+    FailoverPolicy,
     FleetRouter,
     HashRing,
+    ReplicaBreaker,
     ReplicaLease,
     read_members,
     routing_key,
 )
+from howtotrainyourmamlpytorch_tpu.serve.fleet.supervisor import (
+    CrashLoopBreaker,
+    ReplicaSupervisor,
+)
 
 __all__ = [
-    "FleetController", "FleetRouter", "HashRing", "L2AdaptedParamsCache",
-    "ReplicaLease", "advise", "read_members", "routing_key",
+    "CrashLoopBreaker", "FailoverPolicy", "FleetController",
+    "FleetRouter", "HashRing", "L2AdaptedParamsCache", "ReplicaBreaker",
+    "ReplicaLease", "ReplicaSupervisor", "advise", "read_members",
+    "routing_key",
 ]

@@ -164,14 +164,15 @@ class ReplicaServer:
             return
 
     def _submit(self, conn, msg: Dict[str, Any]) -> None:
-        from howtotrainyourmamlpytorch_tpu.serve import FewShotRequest
+        from howtotrainyourmamlpytorch_tpu.serve import (
+            FewShotRequest, ShedError)
         caller_id = msg.get("id")
         trace = msg.get("trace")
         try:
             req = FewShotRequest(
                 support_x=msg["support_x"], support_y=msg["support_y"],
                 query_x=msg["query_x"], deadline=msg.get("deadline"),
-                trace=trace)
+                trace=trace, tenant=msg.get("tenant"))
             with self._pending_lock:
                 self._pending[req.request_id] = (conn, caller_id, trace)
             try:
@@ -190,11 +191,17 @@ class ReplicaServer:
                     self._pending.pop(req.request_id, None)
                 raise e
         except Exception as e:  # noqa: BLE001 — a bad/overflow request
-            # answers THAT caller; the serve loop never sees it.
+            # answers THAT caller; the serve loop never sees it. A shed
+            # gets its DISTINCT status (the overload contract: refused
+            # at the door, not retryable like "rejected" — the driver's
+            # retry loop keys on the error prefix).
+            shed = isinstance(e, ShedError)
             resp = {
                 "op": "response", "id": caller_id, "predictions": None,
                 "cache_hit": False, "cache_tier": None, "latency_s": 0.0,
-                "error": f"rejected: {type(e).__name__}",
+                "error": (f"shed: {e}" if shed
+                          else f"rejected: {type(e).__name__}"),
+                "status": ("shed" if shed else "rejected"),
                 "replica": self.replica_id}
             if trace is not None:
                 resp["trace"] = trace
@@ -230,6 +237,12 @@ class ReplicaServer:
                 "l2_hits": (l2.hits if l2 is not None else 0),
                 "l2_misses": (l2.misses if l2 is not None else 0),
                 "l2_errors": (l2.errors if l2 is not None else 0),
+                # Guarded read: shedding off must stay structurally
+                # zero-cost — reading via reg.counter() would CREATE
+                # the counter and change the registry snapshot.
+                "sheds": (reg.counter("serve/shed_total").value
+                          if getattr(eng.batcher, "admission", None)
+                          is not None else 0),
             },
         }
 
@@ -334,7 +347,9 @@ class ReplicaServer:
                     "cache_hit": resp.cache_hit,
                     "cache_tier": resp.cache_tier,
                     "latency_s": resp.latency_seconds,
-                    "error": resp.error, "replica": self.replica_id}
+                    "error": resp.error,
+                    "status": getattr(resp, "status", "ok"),
+                    "replica": self.replica_id}
                 if trace is not None:
                     # The context rides the response too: the send
                     # itself records wire_send here, the driver's

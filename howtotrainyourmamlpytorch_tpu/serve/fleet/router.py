@@ -37,6 +37,15 @@ Three pieces, all host-side and deliberately **jax-free**:
   ``replica_<i>.drain`` file marks a replica draining — it keeps its
   lease fresh (the process is alive) but leaves the ring, so its keys
   spill to their next ring position while in-flight work completes.
+* **Failure feedback** (:class:`ReplicaBreaker` +
+  :class:`FailoverPolicy`) — lease ages only prove the process is
+  alive; requests can still fail. A per-replica consecutive-failure
+  circuit breaker (closed/open/half-open with single-probe recovery)
+  removes a request-failing replica from the candidate set before its
+  lease ever goes stale, and the failover policy resubmits a dead
+  connection's orphaned requests to the next ring candidate — bounded
+  attempts, each counted ``fleet/failovers``, idempotent because
+  serving is read-only over an immutable checkpoint.
 
 The module is stdlib-only (numpy arrays are accepted where they appear
 — ``routing_key`` needs only ``.tobytes()`` — but never imported) so a
@@ -100,11 +109,20 @@ LIVE = "live"
 STALLED = "stalled"
 DEAD = "dead"
 
+# Per-replica circuit-breaker states (wire/serve failures, NOT lease
+# liveness — a replica can heartbeat perfectly while failing every
+# request, e.g. a poisoned checkpoint or a wedged accept loop).
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+
 # Eagerly-registered router metrics (telemetry satellite): a flush row
 # must show "0 spills", not an absent key.
 REQUESTS_COUNTER = "fleet/router_requests"
 SPILLS_COUNTER = "fleet/router_spills"
 NO_REPLICA_COUNTER = "fleet/router_no_replica"
+FAILOVERS_COUNTER = "fleet/failovers"
+BREAKER_TRIPS_COUNTER = "fleet/breaker_trips"
 LIVE_GAUGE = "fleet/replicas_live"
 DRAINING_GAUGE = "fleet/replicas_draining"
 
@@ -308,6 +326,104 @@ def classify(age: float, stalled_after_s: float, dead_after_s: float) -> str:
     return DEAD
 
 
+class ReplicaBreaker:
+    """Per-replica consecutive-failure circuit breaker (pure, clock-in).
+
+    Lease liveness (classify above) catches replicas that stop
+    heartbeating; this catches the other failure shape — a replica
+    whose PROCESS is fine but whose requests fail (connection reset
+    mid-serve, poisoned state after a bad swap). Classic three-state
+    machine, time passed in so every transition is unit-testable:
+
+    * CLOSED — healthy; requests flow. ``threshold`` consecutive
+      failures trip it OPEN.
+    * OPEN — no requests until ``cooldown_s`` elapses, then the record
+      reads HALF_OPEN.
+    * HALF_OPEN — exactly ONE probe request allowed through
+      (``begin_probe``); its success closes the breaker fully, its
+      failure reopens (fresh cooldown, NOT a new trip).
+
+    Replicas with no record are trivially CLOSED and cost nothing —
+    the healthy-fleet fast path in ``FleetRouter.route`` checks
+    ``bool(self._records)`` before touching per-candidate state.
+    """
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 2.0):
+        if threshold < 1:
+            raise ValueError(f"threshold must be >= 1, got {threshold}")
+        if cooldown_s <= 0:
+            raise ValueError(f"cooldown_s must be > 0, got {cooldown_s}")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._records: Dict[int, Dict[str, Any]] = {}
+
+    def _record(self, replica_id: int) -> Dict[str, Any]:
+        return self._records.setdefault(int(replica_id), {
+            "failures": 0, "state": BREAKER_CLOSED,
+            "opened_at": 0.0, "probe_out": False})
+
+    def state(self, replica_id: int, now: Optional[float] = None) -> str:
+        """Resolved state (OPEN past its cooldown reads HALF_OPEN)."""
+        rec = self._records.get(int(replica_id))
+        if rec is None:
+            return BREAKER_CLOSED
+        now = time.monotonic() if now is None else now
+        if (rec["state"] == BREAKER_OPEN
+                and now - rec["opened_at"] >= self.cooldown_s):
+            rec["state"] = BREAKER_HALF_OPEN
+            rec["probe_out"] = False
+        return rec["state"]
+
+    def allows(self, replica_id: int, now: Optional[float] = None) -> bool:
+        """Whether a request may be routed to this replica right now.
+        HALF_OPEN admits only while no probe is outstanding — the
+        caller marks the probe with ``begin_probe`` on pick."""
+        st = self.state(replica_id, now)
+        if st == BREAKER_CLOSED:
+            return True
+        if st == BREAKER_OPEN:
+            return False
+        return not self._records[int(replica_id)]["probe_out"]
+
+    def begin_probe(self, replica_id: int) -> None:
+        rec = self._records.get(int(replica_id))
+        if rec is not None and rec["state"] == BREAKER_HALF_OPEN:
+            rec["probe_out"] = True
+
+    def record_failure(self, replica_id: int,
+                       now: Optional[float] = None) -> bool:
+        """One request against this replica failed. Returns True only
+        on a fresh CLOSED -> OPEN trip (the countable event); a
+        HALF_OPEN probe failure re-opens silently."""
+        now = time.monotonic() if now is None else now
+        rec = self._record(replica_id)
+        st = self.state(replica_id, now)
+        if st == BREAKER_HALF_OPEN:
+            rec["state"] = BREAKER_OPEN
+            rec["opened_at"] = now
+            rec["probe_out"] = False
+            return False
+        if st == BREAKER_OPEN:
+            return False
+        rec["failures"] += 1
+        if rec["failures"] >= self.threshold:
+            rec["state"] = BREAKER_OPEN
+            rec["opened_at"] = now
+            return True
+        return False
+
+    def record_success(self, replica_id: int) -> None:
+        """A served response closes the breaker and clears all history
+        — consecutive-failure semantics, not a failure-rate window."""
+        self._records.pop(int(replica_id), None)
+
+    def snapshot(self) -> Dict[int, str]:
+        """{replica_id: state} for every replica with a record, for
+        telemetry last-signal rows. Does not resolve cooldowns (pure
+        read)."""
+        return {r: rec["state"] for r, rec in self._records.items()}
+
+
 class FleetRouter:
     """Membership + ring + bounded-load pick, with in-flight accounting.
 
@@ -326,6 +442,8 @@ class FleetRouter:
                  load_factor: float = 1.25,
                  stalled_after_s: float = 1.5,
                  dead_after_s: float = 3.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 2.0,
                  registry: Optional[Any] = None):
         if load_factor < 1.0:
             raise ValueError(
@@ -345,9 +463,12 @@ class FleetRouter:
         self._in_flight: Dict[int, int] = {}
         self._last_pid: Dict[int, Any] = {}
         self._lock = threading.Lock()
+        self.breaker = ReplicaBreaker(threshold=breaker_threshold,
+                                      cooldown_s=breaker_cooldown_s)
         if registry is not None:
             for name in (REQUESTS_COUNTER, SPILLS_COUNTER,
-                         NO_REPLICA_COUNTER):
+                         NO_REPLICA_COUNTER, FAILOVERS_COUNTER,
+                         BREAKER_TRIPS_COUNTER):
                 registry.counter(name)
 
     # -- membership -------------------------------------------------------
@@ -410,6 +531,11 @@ class FleetRouter:
         t0 = time.monotonic() if ctx is not None else 0.0
         with self._lock:
             cands = self.ring.candidates(key)
+            if cands and self.breaker._records:
+                # Slow path only while some breaker record exists: a
+                # healthy fleet never pays per-candidate state checks.
+                now = time.monotonic()
+                cands = [r for r in cands if self.breaker.allows(r, now)]
             if not cands:
                 if reg is not None:
                     reg.counter(NO_REPLICA_COUNTER).inc()
@@ -432,6 +558,7 @@ class FleetRouter:
                              key=lambda r: (self._in_flight.get(r, 0), r))
                 spilled = chosen != cands[0]
             self._in_flight[chosen] = self._in_flight.get(chosen, 0) + 1
+            self.breaker.begin_probe(chosen)
         if reg is not None:
             reg.counter(REQUESTS_COUNTER).inc()
             if spilled:
@@ -450,6 +577,82 @@ class FleetRouter:
                 self._in_flight.pop(int(replica_id), None)
             else:
                 self._in_flight[int(replica_id)] = n - 1
+
+    # -- failure feedback (circuit breaker) -------------------------------
+    def record_failure(self, replica_id: int,
+                       now: Optional[float] = None) -> bool:
+        """A request against ``replica_id`` failed at the wire/serve
+        layer. Feeds the per-replica breaker; a fresh CLOSED -> OPEN
+        trip is counted (``fleet/breaker_trips``) and returned."""
+        with self._lock:
+            tripped = self.breaker.record_failure(replica_id, now)
+        if tripped and self.registry is not None:
+            self.registry.counter(BREAKER_TRIPS_COUNTER).inc()
+        return tripped
+
+    def record_success(self, replica_id: int) -> None:
+        """A served response from ``replica_id`` — closes its breaker
+        (half-open probe success included) and clears failure history."""
+        with self._lock:
+            self.breaker.record_success(replica_id)
+
+
+class FailoverPolicy:
+    """Idempotent resubmission of a dead replica's orphaned requests.
+
+    When a replica connection dies mid-load, every request routed to it
+    and not yet answered is orphaned — known lost, safe to resubmit
+    (serving is read-only over an immutable checkpoint: re-adapting the
+    same support set is idempotent, at worst a duplicate cache fill).
+    ``replica_failed`` turns that event into two lists:
+
+    * ``requeue`` — request ids to resubmit; the caller re-routes each
+      (the breaker has already removed the dead replica from the
+      candidate set, so they land on the next ring position). Each is
+      one counted ``fleet/failovers``.
+    * ``gave_up`` — ids that already failed over ``max_attempts`` times
+      (a request chasing a cascading outage must eventually surface an
+      error to ITS caller rather than orbit the ring forever).
+
+    The policy also settles the router's books for the dead replica —
+    one ``complete()`` per orphan (their responses will never arrive)
+    and one breaker failure per orphan, so a crash with >= threshold
+    requests in flight trips the breaker in a single event instead of
+    needing ``threshold`` separate crashes.
+    """
+
+    def __init__(self, router: FleetRouter, max_attempts: int = 3):
+        if max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {max_attempts}")
+        self.router = router
+        self.max_attempts = int(max_attempts)
+        self._attempts: Dict[Any, int] = {}
+
+    def replica_failed(self, replica_id: int, orphaned_ids: Sequence[Any],
+                       now: Optional[float] = None) -> tuple:
+        """-> (requeue, gave_up) — see class docstring."""
+        reg = self.router.registry
+        requeue: List[Any] = []
+        gave_up: List[Any] = []
+        for rid in orphaned_ids:
+            self.router.record_failure(replica_id, now)
+            self.router.complete(replica_id)
+            n = self._attempts.get(rid, 0) + 1
+            if n > self.max_attempts:
+                gave_up.append(rid)
+                self._attempts.pop(rid, None)
+                continue
+            self._attempts[rid] = n
+            requeue.append(rid)
+            if reg is not None:
+                reg.counter(FAILOVERS_COUNTER).inc()
+        return requeue, gave_up
+
+    def request_done(self, request_id: Any) -> None:
+        """Forget a request's failover history once it completes (or
+        terminally errors) — ids are caller-scoped and may be reused."""
+        self._attempts.pop(request_id, None)
 
 
 # ---------------------------------------------------------------------------

@@ -54,8 +54,15 @@ from typing import Any, Dict, List, Optional, Union
 # algo/* gauges; serve adapt-seconds p50 last-signal PER VARIANT from
 # the meta_algorithm-stamped serving metrics rows, whose adapt-batch
 # counters accumulate reset-aware per (replica source, variant) like
-# the fleet section)
-SCHEMA = "maml_tpu_telemetry_report_v15"
+# the fleet section);
+# v16: + "fleet_health" (self-healing fleet, serve/fleet/supervisor.py
+# + router breaker + shed-at-admission: restart/crash-loop/scale
+# counters from the supervisor's flushes, failover/breaker-trip
+# counters from the router's driver, shed counts from replica flushes
+# — all reset-aware per (source, metric) like the fleet section;
+# replicas_desired gauge last-wins; supervisor lifecycle events
+# tallied by kind)
+SCHEMA = "maml_tpu_telemetry_report_v16"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -647,6 +654,67 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                             if hits + misses > 0 else UNAVAILABLE),
         }
 
+    # Fleet-health section (serve/fleet/supervisor.py + router breaker
+    # + shed-at-admission, schema v16): the self-healing loop's ledger.
+    # Counters ride the same interleaved "metrics" rows as the fleet
+    # section — the supervisor flushes under replica="supervisor", the
+    # router's driver under its own source, replicas carry
+    # serve/shed_total — so accumulation is reset-aware per
+    # (source, metric). replicas_desired is a gauge (last signal).
+    # Supervisor lifecycle rows ("fleet_supervisor" events) tally by
+    # kind so a report shows WHICH healing paths fired (spawn /
+    # restart_scheduled / crash_loop / draining / reaped), not just how
+    # often counters moved. Runs without the supervisor, breaker, or
+    # shed policy summarize to "unavailable".
+    _FLEET_HEALTH_COUNTERS = {
+        "restarts": "fleet/restarts",
+        "crash_loops": "fleet/crash_loops",
+        "scale_ups": "fleet/scale_ups",
+        "scale_downs": "fleet/scale_downs",
+        "failovers": "fleet/failovers",
+        "breaker_trips": "fleet/breaker_trips",
+        "sheds": "serve/shed_total",
+    }
+    fh_totals: Dict[str, float] = {}
+    fh_prev: Dict[str, float] = {}
+    fh_seen = False
+    fh_desired: Metric = UNAVAILABLE
+    fh_kinds: Dict[str, int] = {}
+    for e in events:
+        if e.get("event") == "fleet_supervisor":
+            fh_seen = True
+            kind = str(e.get("kind", "unknown"))
+            fh_kinds[kind] = fh_kinds.get(kind, 0) + 1
+            continue
+        if e.get("event") != "metrics":
+            continue
+        m = e.get("metrics") or {}
+        relevant = [key for key in _FLEET_HEALTH_COUNTERS.values()
+                    if m.get(key) is not None]
+        if not relevant and m.get("fleet/replicas_desired") is None:
+            continue
+        fh_seen = True
+        source = str(e.get("replica", ""))
+        for key in relevant:
+            _accumulate_counter(fh_totals, fh_prev,
+                                f"{source}:{key}", float(m[key]))
+        if m.get("fleet/replicas_desired") is not None:
+            fh_desired = int(m["fleet/replicas_desired"])
+    fleet_health_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if fh_seen:
+        def _fh_total(key: str) -> int:
+            # Totals are per (source, metric); the section reports the
+            # fleet-wide sum over sources.
+            return int(sum(v for k, v in fh_totals.items()
+                           if k.split(":", 1)[1] == key))
+
+        fleet_health_sec = {
+            "replicas_desired": fh_desired,
+            **{label: _fh_total(key)
+               for label, key in _FLEET_HEALTH_COUNTERS.items()},
+            "supervisor_events": fh_kinds or UNAVAILABLE,
+        }
+
     # Perf section (telemetry/profiler.py, schema v12): each
     # "perf_profile" row is one sampled dispatch-sync window — the
     # window-split fractions and top device-time executable take the
@@ -957,6 +1025,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "warm_start": warm_start_sec,
         "elastic": elastic_sec,
         "fleet": fleet_sec,
+        "fleet_health": fleet_health_sec,
         "perf": perf_sec,
         "tune": tune_sec,
         "requests": requests_sec,
@@ -997,6 +1066,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("warm start", summary["warm_start"]),
         ("elastic", summary["elastic"]),
         ("fleet", summary["fleet"]),
+        ("fleet health", summary["fleet_health"]),
         ("perf", summary["perf"]),
         ("tune", summary["tune"]),
         ("requests", summary["requests"]),

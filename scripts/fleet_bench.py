@@ -466,8 +466,9 @@ def build_schedule(num_requests: int, num_tenants: int, seed: int,
 def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
               *, max_outstanding: int, controller=None,
               swap_trigger=None, max_retries: int = 20,
+              failover_max_attempts: int = 3,
               stall_timeout_s: float = 300.0, reqtrace=None,
-              sample_rate: float = 0.0, slo=None) -> dict:
+              sample_rate: float = 0.0, slo=None, on_tick=None) -> dict:
     """Push the whole schedule through the fleet as fast as the window
     allows (backlog/throughput mode — the serve_bench rate=0 rule),
     pumping membership refresh, rollout ticks and the optional mid-load
@@ -479,7 +480,18 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
     keep the original trace — the root span covers the whole e2e
     including rejection round-trips) and the root ``request`` span is
     recorded when the final response lands.  ``slo`` is an optional
-    SLOLedger fed every completed request's e2e latency."""
+    SLOLedger fed every completed request's e2e latency.
+
+    Failover is the ROUTER's job now (router.py § FailoverPolicy): a
+    dead connection's orphans are resubmitted through the policy
+    (bounded ``failover_max_attempts``, counted ``fleet/failovers``,
+    breaker-fed so the dead replica leaves the candidate set before
+    its lease ages out); a request that exhausts its attempts lands as
+    a terminal ``failover_exhausted`` result instead of orbiting the
+    ring. A ``shed:`` error is TERMINAL by construction — admission
+    refused it at the door, retrying would defeat overload protection.
+    ``on_tick(now)`` (optional) runs on the refresh cadence — the
+    chaos driver pumps its supervisor and reconnects from it."""
     lock = threading.Lock()
     cond = threading.Condition(lock)
     results: Dict[int, dict] = {}
@@ -488,13 +500,19 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
     ctx_of: Dict[int, Any] = {}
     retry_q: deque = deque()
     retry_count: Dict[int, int] = {}
-    state = {"outstanding": 0, "retries": 0}
+    state = {"outstanding": 0, "retries": 0, "gave_up": 0}
+    failover = _router_mod.FailoverPolicy(
+        router, max_attempts=failover_max_attempts)
 
     def on_response(rid: int, msg: dict) -> None:
         cid = msg.get("id")
         with cond:
             router.complete(rid_of.get(cid, rid))
             err = msg.get("error")
+            if not err:
+                # A served answer closes the replica's breaker (the
+                # half-open probe success path included).
+                router.record_success(rid)
             if err and str(err).startswith("rejected") \
                     and retry_count.get(cid, 0) < max_retries:
                 retry_count[cid] = retry_count.get(cid, 0) + 1
@@ -505,6 +523,7 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
                 msg["latency_s_e2e"] = latency
                 msg["rid"] = rid
                 results[cid] = msg
+                failover.request_done(cid)
                 if slo is not None:
                     slo.observe(by_cid[cid]["tenant"], latency * 1e3)
                 ctx = ctx_of.get(cid)
@@ -515,13 +534,29 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
             state["outstanding"] -= 1
             cond.notify()
 
+    def give_up(cid: int) -> None:
+        # Caller holds ``cond``. Terminal synthetic result: the request
+        # chased failovers past the bound; surface the error rather
+        # than stall the window (zero-dropped accounting still sees
+        # it — "dropped" counts non-ok results).
+        latency = time.monotonic() - send_ts.get(cid, time.monotonic())
+        results[cid] = {"id": cid, "error": "failover_exhausted",
+                        "status": "failed", "latency_s_e2e": latency,
+                        "rid": None}
+        state["gave_up"] += 1
+        ctx = ctx_of.get(cid)
+        if reqtrace is not None and ctx is not None:
+            reqtrace.record_root(ctx, send_ts.get(cid, 0.0), latency,
+                                 replica=None, error=True)
+
     for conn in conns.values():
         conn._on_response = on_response
 
     by_cid = {item["cid"]: item for item in schedule}
     pending = deque(item["cid"] for item in schedule)
     swap_fired = False
-    dead_conns: set = set()
+    dead_conns: set = set()  # conn OBJECT ids — a restarted replica's
+    #                          fresh conn under the same rid is new.
     t0 = time.monotonic()
     last_progress = time.monotonic()
     last_refresh = 0.0
@@ -532,27 +567,38 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
             router.refresh()
             if controller is not None:
                 controller.tick()
+            if on_tick is not None:
+                on_tick(now)
             last_refresh = now
             # Dead-socket recovery (the failure-table contract): a
             # replica whose connection died mid-flight never answers
-            # its outstanding requests — requeue them through the
-            # router (which has dropped the dead replica from the
-            # ring) instead of stalling the window shut.
-            for rid, conn in conns.items():
-                if rid in dead_conns or not conn._stopped_evt.is_set():
+            # its outstanding requests — hand them to the failover
+            # policy, which settles the router's books, feeds the
+            # breaker, and bounds per-request attempts.
+            for rid, conn in list(conns.items()):
+                if conn._on_response is not on_response:
+                    # A conn swapped in mid-leg (chaos reconnect after
+                    # a supervisor restart) joins the response path.
+                    conn._on_response = on_response
+                if id(conn) in dead_conns \
+                        or not conn._stopped_evt.is_set():
                     continue
-                dead_conns.add(rid)
+                dead_conns.add(id(conn))
                 with cond:
-                    for cid, r in list(rid_of.items()):
-                        if (r == rid and cid not in results
-                                and cid not in retry_q
-                                and cid not in pending):
-                            retry_count[cid] = retry_count.get(cid,
-                                                               0) + 1
-                            state["retries"] += 1
-                            retry_q.append(cid)
-                            state["outstanding"] -= 1
-                            router.complete(rid)
+                    orphans = [cid for cid, r in rid_of.items()
+                               if r == rid and cid not in results
+                               and cid not in retry_q
+                               and cid not in pending]
+                    requeue, gave_up = failover.replica_failed(
+                        rid, orphans)
+                    for cid in requeue:
+                        retry_count[cid] = retry_count.get(cid, 0) + 1
+                        state["retries"] += 1
+                        retry_q.append(cid)
+                        state["outstanding"] -= 1
+                    for cid in gave_up:
+                        give_up(cid)
+                        state["outstanding"] -= 1
                     cond.notify()
         if (swap_trigger is not None and not swap_fired
                 and len(results) >= swap_trigger["at_completed"]):
@@ -593,12 +639,18 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
                         msg["trace"] = ctx
                     conn.send(msg)
                 except OSError:
-                    # Replica vanished mid-send (SIGKILL class): undo
-                    # the accounting and retry elsewhere after refresh.
+                    # Replica vanished mid-send (SIGKILL class): the
+                    # failover policy settles the books (complete +
+                    # breaker failure) and decides requeue vs give-up.
                     state["outstanding"] -= 1
-                    router.complete(rid)
-                    retry_count[cid] = retry_count.get(cid, 0) + 1
-                    retry_q.append(cid)
+                    requeue, gave_up = failover.replica_failed(
+                        rid, [cid])
+                    if requeue:
+                        retry_count[cid] = retry_count.get(cid, 0) + 1
+                        state["retries"] += 1
+                        retry_q.append(cid)
+                    else:
+                        give_up(cid)
                     break
             completed = len(results)
             if completed > completed_prev:
@@ -635,11 +687,21 @@ def drive_leg(router, conns: Dict[int, ReplicaConn], schedule,
         for tier, vals in tier_lat.items()}
 
     tiers = [r.get("cache_tier") for r in ok]
+    shed = sum(1 for r in results.values()
+               if r.get("status") == "shed"
+               or str(r.get("error") or "").startswith("shed"))
+    status_counts: Dict[str, int] = {}
+    for r in results.values():
+        st = r.get("status") or ("ok" if not r.get("error") else "failed")
+        status_counts[st] = status_counts.get(st, 0) + 1
     return {
+        "status_counts": status_counts,
         "wall_seconds": round(wall, 3),
         "qps": round(len(ok) / wall, 3) if wall > 0 else None,
         "responses_ok": len(ok),
-        "dropped": len(schedule) - len(ok),
+        "dropped": len(schedule) - len(ok) - shed,
+        "shed": shed,
+        "failover_gave_up": state["gave_up"],
         "rejected_retries": state["retries"],
         "p50_ms": pct(0.50), "p95_ms": pct(0.95), "p99_ms": pct(0.99),
         "tier_latency_ms": tier_latency_ms,
@@ -1019,6 +1081,14 @@ def main(argv=None) -> int:
                 reg_snap.get(_controller_mod.HALTS_COUNTER, 0)),
             "fleet_router_spills": int(
                 reg_snap.get(_router_mod.SPILLS_COUNTER, 0)),
+            # Schema-stable robustness keys (chaos_fleet.py fills the
+            # same names from its own legs): failovers come from the
+            # router's counter; this bench runs no supervisor and no
+            # shed policy, so those two are honestly null, not 0.
+            "fleet_failover_count": int(
+                reg_snap.get(_router_mod.FAILOVERS_COUNTER, 0)),
+            "fleet_shed_count": None,
+            "fleet_restarts": None,
             "fleet_trace_count": (trace_summary["count"]
                                   if trace_summary else None),
             "fleet_trace_linked_frac": (trace_summary["linked_frac"]

@@ -82,6 +82,10 @@ SHARD_SIZE_OVERRIDES = {
     "tests/test_reqtrace.py": 120_000,      # traced 2-replica fleet
     #                                         smoke + slo_report CLI
     #                                         subprocesses
+    "tests/test_fleet_supervisor.py": 120_000,  # slow chaos_fleet
+    #                                         --quick proof: three
+    #                                         real-replica phases,
+    #                                         several minutes
     "tests/test_algos.py": 60_000,          # slow half compiles the
     #                                         flagship train step twice
     #                                         (bitwise pin) + two

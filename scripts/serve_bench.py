@@ -301,6 +301,9 @@ def main() -> int:
         "fleet_trace_tier_seconds": None,
         "fleet_slo_burn_rate": None,
         "fleet_slo_tenants": None,
+        "fleet_shed_count": None,
+        "fleet_failover_count": None,
+        "fleet_restarts": None,
     }
     if args.events:
         jsonl = JsonlLogger(args.events)

@@ -177,3 +177,28 @@ def test_effective_task_microbatches_geometry():
     # Degenerate mesh size guards.
     assert cfg.effective_task_microbatches(0) == 16
     assert cfg.effective_task_microbatches(32) == 1
+
+
+def test_fleet_supervisor_keys_validated():
+    """Self-healing fleet knobs (ISSUE 18): defaults are off/safe, and
+    every bound the supervisor/admission layer assumes is enforced at
+    config construction, not discovered at serve time."""
+    cfg = MAMLConfig()
+    assert cfg.fleet_supervisor == 0
+    assert cfg.fleet_shed_policy == "off"
+    MAMLConfig(fleet_supervisor=1, fleet_shed_policy="deadline",
+               fleet_max_restarts=1, fleet_restart_window_s=5.0,
+               fleet_scale_min=2, fleet_scale_max=2)
+    MAMLConfig(fleet_shed_policy="fair")
+    with pytest.raises(ValueError, match="fleet_supervisor"):
+        MAMLConfig(fleet_supervisor=2)
+    with pytest.raises(ValueError, match="fleet_max_restarts"):
+        MAMLConfig(fleet_max_restarts=0)
+    with pytest.raises(ValueError, match="fleet_restart_window_s"):
+        MAMLConfig(fleet_restart_window_s=0.0)
+    with pytest.raises(ValueError, match="fleet_scale_min"):
+        MAMLConfig(fleet_scale_min=0)
+    with pytest.raises(ValueError, match="fleet_scale_max"):
+        MAMLConfig(fleet_scale_min=3, fleet_scale_max=2)
+    with pytest.raises(ValueError, match="fleet_shed_policy"):
+        MAMLConfig(fleet_shed_policy="lifo")

@@ -712,5 +712,7 @@ def test_serve_bench_exposes_fleet_keys_as_null():
                 "fleet_rolling_swap_halts", "fleet_router_spills",
                 "fleet_trace_count", "fleet_trace_linked_frac",
                 "fleet_trace_dominant_tier", "fleet_trace_tier_seconds",
-                "fleet_slo_burn_rate", "fleet_slo_tenants"):
+                "fleet_slo_burn_rate", "fleet_slo_tenants",
+                "fleet_shed_count", "fleet_failover_count",
+                "fleet_restarts"):
         assert key in keys, f"serve_bench artifact lost {key}"

@@ -1,0 +1,638 @@
+"""Self-healing fleet units: supervisor, breakers, shedding, failover.
+
+Everything the chaos suite (scripts/chaos_fleet.py) proves end-to-end
+is pinned here at unit granularity, clock-in and process-free: the
+crash-loop window math, every supervisor slot transition (spawn ->
+running -> crash/backoff -> FAILED -> spare backfill, scale up/down
+drain -> reap, lease-dead kill, start-timeout kill), the per-replica
+circuit breaker's three-state cycle, bounded failover, and the
+admission controller's shed policies (deadline + liveness floor,
+fair share). ``spawn_fn`` injection means no sockets and no real
+processes — the whole file runs in milliseconds, so it is tier-1.
+
+The two exceptions: a subprocess proof that supervisor.py stays
+loadable with ZERO third-party imports (the jax-free driver
+discipline), and the ``slow``-marked chaos --quick acceptance run
+(real replicas over localhost, several minutes — tier-1 sits at ~660s
+of the 870s driver budget and must not grow past it).
+"""
+
+import itertools
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.serve.batcher import (
+    AdmissionController, ShedError, estimate_queue_wait)
+from howtotrainyourmamlpytorch_tpu.serve.fleet import router as fr
+from howtotrainyourmamlpytorch_tpu.serve.fleet import (
+    supervisor as fsup)
+from howtotrainyourmamlpytorch_tpu.serve.fleet.router import (
+    FailoverPolicy, FleetRouter, ReplicaBreaker, ReplicaLease)
+from howtotrainyourmamlpytorch_tpu.serve.fleet.supervisor import (
+    CrashLoopBreaker, ReplicaSupervisor)
+from helpers import _can_bind_localhost
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS_FLEET = os.path.join(REPO, "scripts", "chaos_fleet.py")
+SUPERVISOR_PY = os.path.join(
+    REPO, "howtotrainyourmamlpytorch_tpu", "serve", "fleet",
+    "supervisor.py")
+
+
+# ---------------------------------------------------------------------------
+# test doubles
+# ---------------------------------------------------------------------------
+
+class _Counter:
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+
+class _Gauge:
+    def __init__(self):
+        self.value = 0
+
+    def set(self, v):
+        self.value = v
+
+
+class _Reg:
+    """Duck-typed MetricsRegistry (counter/gauge get-or-create) — the
+    supervisor/router contract, without importing telemetry."""
+
+    def __init__(self):
+        self.counters = {}
+        self.gauges = {}
+
+    def counter(self, name):
+        return self.counters.setdefault(name, _Counter())
+
+    def gauge(self, name):
+        return self.gauges.setdefault(name, _Gauge())
+
+
+class FakeProc:
+    """The injectable spawn_fn contract: poll/pid/terminate/kill."""
+
+    _pids = itertools.count(4000)
+
+    def __init__(self):
+        self.pid = next(FakeProc._pids)
+        self.exit_code = None
+        self.terminated = False
+        self.killed = False
+
+    def poll(self):
+        return self.exit_code
+
+    def terminate(self):
+        self.terminated = True
+        self.exit_code = 0
+
+    def kill(self):
+        self.killed = True
+        self.exit_code = -9
+
+    def exit(self, code):
+        self.exit_code = code
+
+
+def _touch_lease(fleet_dir, slot, *, queue_depth=0, age_s=0.0, pid=None):
+    """Write slot's lease as a live replica would, optionally aged."""
+    lease = ReplicaLease(str(fleet_dir), slot, 0.0)
+    assert lease.touch(payload={
+        "port": 7000 + slot, "pid": pid if pid is not None else 4000,
+        "stats": {"queue_depth": queue_depth}}, force=True)
+    if age_s:
+        past = time.time() - age_s
+        os.utime(lease.path, (past, past))
+    return lease.path
+
+
+def _mk_sup(fleet_dir, spawned, registry=None, events_path=None, **kw):
+    def spawn(slot):
+        proc = FakeProc()
+        spawned.setdefault(slot, []).append(proc)
+        return proc
+    kw.setdefault("rng", random.Random(0))
+    return ReplicaSupervisor(str(fleet_dir), spawn, registry=registry,
+                             events_path=events_path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# CrashLoopBreaker
+# ---------------------------------------------------------------------------
+
+def test_crash_loop_breaker_window_math():
+    br = CrashLoopBreaker(max_restarts=3, window_s=10.0)
+    assert br.record_restart(0, 0.0) is False
+    assert br.record_restart(0, 1.0) is False
+    # Third restart inside the window exhausts the budget.
+    assert br.record_restart(0, 2.0) is True
+    assert br.restarts_in_window(0, 2.0) == 3
+    # The deque prunes itself: 11s later only the t=2 entry survives,
+    # so a fresh restart is the second in window — no trip.
+    assert br.restarts_in_window(0, 11.5) == 1
+    assert br.record_restart(0, 11.5) is False
+    # Slots are independent; reset clears one slot's history only.
+    assert br.record_restart(1, 11.5) is False
+    br.reset(0)
+    assert br.restarts_in_window(0, 11.5) == 0
+    assert br.restarts_in_window(1, 11.5) == 1
+
+
+def test_crash_loop_breaker_validation():
+    with pytest.raises(ValueError):
+        CrashLoopBreaker(max_restarts=0)
+    with pytest.raises(ValueError):
+        CrashLoopBreaker(window_s=0.0)
+
+
+# ---------------------------------------------------------------------------
+# ReplicaSupervisor slot lifecycle
+# ---------------------------------------------------------------------------
+
+def test_supervisor_spawn_to_running(tmp_path):
+    spawned, reg = {}, _Reg()
+    events = tmp_path / "events.jsonl"
+    sup = _mk_sup(tmp_path / "fleet", spawned, registry=reg,
+                  events_path=str(events), desired=2, scale_max=4)
+    t0 = time.time()
+    states = sup.tick(t0)
+    assert states[0] == fsup.STARTING and states[1] == fsup.STARTING
+    assert states[2] == fsup.EMPTY and states[3] == fsup.EMPTY
+    assert set(spawned) == {0, 1}
+    # Replicas announce (live lease with a port) -> RUNNING.
+    _touch_lease(tmp_path / "fleet", 0)
+    _touch_lease(tmp_path / "fleet", 1)
+    states = sup.tick(t0 + 0.1)
+    assert states[0] == fsup.RUNNING and states[1] == fsup.RUNNING
+    assert sup.count(fsup.RUNNING) == 2
+    assert reg.gauges[fsup.DESIRED_GAUGE].value == 2
+    kinds = [json.loads(ln)["kind"]
+             for ln in events.read_text().splitlines()]
+    assert kinds.count("spawn") == 2 and kinds.count("running") == 2
+
+
+def test_supervisor_crash_restarts_same_slot_after_backoff(tmp_path):
+    spawned, reg = {}, _Reg()
+    sup = _mk_sup(tmp_path / "fleet", spawned, registry=reg,
+                  desired=2, scale_max=4, backoff_base_s=0.05,
+                  backoff_cap_s=2.0)
+    t0 = time.time()
+    sup.tick(t0)
+    lease0 = _touch_lease(tmp_path / "fleet", 0)
+    _touch_lease(tmp_path / "fleet", 1)
+    sup.tick(t0 + 0.1)
+    spawned[0][0].exit(1)
+    states = sup.tick(t0 + 0.2)
+    assert states[0] == fsup.EMPTY
+    assert reg.counters[fsup.RESTARTS_COUNTER].value == 1
+    # The stale lease is removed NOW (the router must stop routing to
+    # the dead port immediately, not when the lease ages out).
+    assert not os.path.exists(lease0)
+    delay = sup.slots[0]["next_spawn_at"] - (t0 + 0.2)
+    assert 0.05 <= delay <= 0.075  # base * U[1, 1.5] jitter, attempt 0
+    # Inside the backoff the slot is RESERVED capacity: no spare slot
+    # is spawned over it (identity churn on every crash otherwise).
+    states = sup.tick(t0 + 0.21)
+    assert states[0] == fsup.EMPTY and states[2] == fsup.EMPTY
+    assert len(spawned[0]) == 1 and 2 not in spawned
+    # Past the backoff the SAME slot respawns.
+    states = sup.tick(t0 + 0.2 + delay + 0.01)
+    assert states[0] == fsup.STARTING
+    assert len(spawned[0]) == 2 and 2 not in spawned
+
+
+def test_supervisor_crash_loop_fails_slot_and_backfills_spare(tmp_path):
+    spawned, reg = {}, _Reg()
+    events = tmp_path / "events.jsonl"
+    sup = _mk_sup(tmp_path / "fleet", spawned, registry=reg,
+                  events_path=str(events), desired=1, scale_max=2,
+                  max_restarts=2, restart_window_s=60.0,
+                  backoff_base_s=0.01, backoff_cap_s=0.02)
+    t0 = time.time()
+    sup.tick(t0)
+    spawned[0][0].exit(1)
+    sup.tick(t0 + 1.0)  # restart scheduled (1st in window)
+    sup.tick(t0 + 2.0)  # past backoff: respawn slot 0
+    assert len(spawned[0]) == 2
+    spawned[0][1].exit(1)
+    # Second crash in window == max_restarts: the slot trips FAILED,
+    # and — same tick — the spare slot backfills (FAILED is not
+    # reserved capacity; a poisoned slot earns a replacement).
+    states = sup.tick(t0 + 3.0)
+    assert states[0] == fsup.FAILED
+    assert states[1] == fsup.STARTING
+    assert reg.counters[fsup.CRASH_LOOPS_COUNTER].value == 1
+    assert reg.counters[fsup.RESTARTS_COUNTER].value == 1
+    # FAILED is sticky across ticks until an operator re-arms it.
+    assert sup.tick(t0 + 4.0)[0] == fsup.FAILED
+    sup.reset_slot(0)
+    assert sup.slots[0]["state"] == fsup.EMPTY
+    kinds = [json.loads(ln)["kind"]
+             for ln in events.read_text().splitlines()]
+    assert "crash_loop" in kinds
+
+
+def test_supervisor_scale_up_then_drain_scale_down(tmp_path):
+    spawned, reg = {}, _Reg()
+    fleet = tmp_path / "fleet"
+    sup = _mk_sup(fleet, spawned, registry=reg, desired=1,
+                  scale_max=3, drain_grace_s=0.0)
+    t0 = time.time()
+    sup.tick(t0)
+    _touch_lease(fleet, 0)
+    sup.tick(t0 + 0.1)
+    # advise() says scale_up: desired moves, the next slot spawns.
+    states = sup.tick(t0 + 0.2, advice="scale_up")
+    assert sup.desired == 2 and states[1] == fsup.STARTING
+    assert reg.counters[fsup.SCALE_UPS_COUNTER].value == 1
+    _touch_lease(fleet, 1)
+    sup.tick(t0 + 0.3)
+    # scale_down drains the HIGHEST running slot: tombstone written,
+    # slot leaves active immediately (the router stops routing to it).
+    states = sup.tick(t0 + 0.4, advice="scale_down")
+    assert sup.desired == 1 and states[1] == fsup.DRAINING
+    assert reg.counters[fsup.SCALE_DOWNS_COUNTER].value == 1
+    drain = fr.drain_path(str(fleet), 1)
+    assert os.path.exists(drain)
+    # Queue empty + grace over -> SIGTERM -> reaped (files removed).
+    _touch_lease(fleet, 1, queue_depth=0)
+    sup.tick(t0 + 0.5)
+    assert spawned[1][0].terminated
+    states = sup.tick(t0 + 0.6)
+    assert states[1] == fsup.EMPTY
+    assert not os.path.exists(drain)
+    assert not os.path.exists(fr.lease_path(str(fleet), 1))
+    # Desired is clamped: scale_down at scale_min is a no-op.
+    sup.tick(t0 + 0.7, advice="scale_down")
+    assert sup.desired == 1
+    assert reg.counters[fsup.SCALE_DOWNS_COUNTER].value == 1
+
+
+def test_supervisor_kills_lease_dead_replica(tmp_path):
+    spawned, reg = {}, _Reg()
+    fleet = tmp_path / "fleet"
+    sup = _mk_sup(fleet, spawned, registry=reg, desired=1, scale_max=2,
+                  stalled_after_s=1.5, dead_after_s=3.0)
+    t0 = time.time()
+    sup.tick(t0)
+    _touch_lease(fleet, 0)
+    assert sup.tick(t0 + 0.1)[0] == fsup.RUNNING
+    # Process alive, heartbeat gone 10s: the one failure poll() cannot
+    # see. The supervisor kills it; the exit surfaces as a crash.
+    _touch_lease(fleet, 0, age_s=10.0)
+    sup.tick(t0 + 0.2)
+    assert spawned[0][0].killed
+    states = sup.tick(t0 + 0.3)
+    assert states[0] == fsup.EMPTY
+    assert reg.counters[fsup.RESTARTS_COUNTER].value == 1
+
+
+def test_supervisor_start_timeout_kill(tmp_path):
+    spawned = {}
+    sup = _mk_sup(tmp_path / "fleet", spawned, desired=1, scale_max=2,
+                  start_timeout_s=0.5)
+    t0 = time.time()
+    sup.tick(t0)
+    # Never announces a lease: wedged before serving.
+    sup.tick(t0 + 1.0)
+    assert spawned[0][0].killed
+    assert sup.tick(t0 + 1.1)[0] == fsup.EMPTY
+
+
+def test_supervisor_spawn_failure_counts_as_crash(tmp_path):
+    calls = []
+
+    def bad_spawn(slot):
+        calls.append(slot)
+        raise OSError("fork bomb averted")
+
+    reg = _Reg()
+    sup = ReplicaSupervisor(str(tmp_path / "fleet"), bad_spawn,
+                            registry=reg, desired=1, scale_max=2,
+                            max_restarts=2, restart_window_s=60.0,
+                            rng=random.Random(0))
+    t0 = time.time()
+    states = sup.tick(t0)
+    assert calls == [0]
+    assert states[0] == fsup.EMPTY
+    assert sup.slots[0]["next_spawn_at"] > t0
+    assert reg.counters[fsup.RESTARTS_COUNTER].value == 1
+
+
+def test_supervisor_flush_metrics_row_shape(tmp_path):
+    spawned, reg = {}, _Reg()
+    events = tmp_path / "events.jsonl"
+    sup = _mk_sup(tmp_path / "fleet", spawned, registry=reg,
+                  events_path=str(events), desired=1, scale_max=2)
+    t0 = time.time()
+    sup.tick(t0)
+    sup.flush_metrics(t0 + 1.0)
+    rows = [json.loads(ln) for ln in events.read_text().splitlines()]
+    metrics = [r for r in rows if r["event"] == "metrics"]
+    assert len(metrics) == 1
+    row = metrics[0]
+    # The registry.flush_jsonl shape: snapshot nested under "metrics",
+    # source identity under "replica" — telemetry/report.py's
+    # fleet-health section folds this row like any replica's flush.
+    assert row["replica"] == "supervisor"
+    snap = row["metrics"]
+    for name in (fsup.RESTARTS_COUNTER, fsup.CRASH_LOOPS_COUNTER,
+                 fsup.SCALE_UPS_COUNTER, fsup.SCALE_DOWNS_COUNTER,
+                 fsup.DESIRED_GAUGE):
+        assert name in snap
+    assert snap[fsup.RESTARTS_COUNTER] == 0
+    assert snap[fsup.DESIRED_GAUGE] == 1
+
+
+def test_supervisor_stop_terminates_and_cleans(tmp_path):
+    spawned = {}
+    fleet = tmp_path / "fleet"
+    sup = _mk_sup(fleet, spawned, desired=2, scale_max=2)
+    t0 = time.time()
+    sup.tick(t0)
+    lease0 = _touch_lease(fleet, 0)
+    lease1 = _touch_lease(fleet, 1)
+    sup.tick(t0 + 0.1)
+    sup.stop(kill_after_s=1.0)
+    assert spawned[0][0].terminated and spawned[1][0].terminated
+    assert sup.count(fsup.EMPTY) == 2
+    assert not os.path.exists(lease0) and not os.path.exists(lease1)
+
+
+def test_supervisor_validation():
+    with pytest.raises(ValueError):
+        ReplicaSupervisor("/tmp/x", lambda s: None, scale_min=0)
+    with pytest.raises(ValueError):
+        ReplicaSupervisor("/tmp/x", lambda s: None, scale_min=2,
+                          scale_max=1)
+    # desired clamps into [scale_min, scale_max] rather than raising.
+    sup = ReplicaSupervisor("/tmp/x", lambda s: None, desired=9,
+                            scale_min=1, scale_max=3)
+    assert sup.desired == 3
+
+
+# ---------------------------------------------------------------------------
+# ReplicaBreaker + router integration + failover
+# ---------------------------------------------------------------------------
+
+def test_replica_breaker_full_cycle():
+    br = ReplicaBreaker(threshold=2, cooldown_s=1.0)
+    assert br.state(7, 0.0) == fr.BREAKER_CLOSED
+    assert br.record_failure(7, 0.0) is False
+    assert br.record_failure(7, 0.1) is True  # the countable trip
+    assert br.state(7, 0.5) == fr.BREAKER_OPEN
+    assert not br.allows(7, 0.5)
+    # Cooldown elapsed: OPEN reads HALF_OPEN, ONE probe allowed.
+    assert br.state(7, 1.2) == fr.BREAKER_HALF_OPEN
+    assert br.allows(7, 1.2)
+    br.begin_probe(7)
+    assert not br.allows(7, 1.2)  # probe outstanding
+    # Probe fails: re-open with a fresh cooldown, NOT a new trip.
+    assert br.record_failure(7, 1.3) is False
+    assert br.state(7, 1.5) == fr.BREAKER_OPEN
+    # Next half-open probe succeeds: record cleared, fully CLOSED.
+    br.begin_probe(7)
+    assert br.state(7, 2.4) == fr.BREAKER_HALF_OPEN
+    br.begin_probe(7)
+    br.record_success(7)
+    assert br.snapshot() == {}
+    assert br.state(7, 2.5) == fr.BREAKER_CLOSED
+
+
+def test_replica_breaker_validation():
+    with pytest.raises(ValueError):
+        ReplicaBreaker(threshold=0)
+    with pytest.raises(ValueError):
+        ReplicaBreaker(cooldown_s=0.0)
+
+
+def _routable_router(tmp_path, reg, **kw):
+    fleet = str(tmp_path / "fleet")
+    for slot in (0, 1):
+        _touch_lease(tmp_path / "fleet", slot, pid=5000 + slot)
+    router = FleetRouter(fleet, registry=reg, **kw)
+    router.refresh()
+    return router
+
+
+def test_router_excludes_tripped_replica_until_success(tmp_path):
+    reg = _Reg()
+    router = _routable_router(tmp_path, reg, breaker_threshold=1,
+                              breaker_cooldown_s=60.0)
+    assert sorted(router.routable) == [0, 1]
+    assert router.record_failure(0) is True
+    assert reg.counters[fr.BREAKER_TRIPS_COUNTER].value == 1
+    # With replica 0 OPEN, every key lands on 1 (failover routing).
+    picks = set()
+    for i in range(20):
+        r = router.route(f"key-{i}")
+        picks.add(r)
+        router.complete(r)
+    assert picks == {1}
+    # A served response closes the breaker; 0 becomes routable again.
+    router.record_success(0)
+    picks = set()
+    for i in range(50):
+        r = router.route(f"key-{i}")
+        picks.add(r)
+        router.complete(r)
+    assert picks == {0, 1}
+
+
+def test_failover_policy_bounded_attempts_and_books(tmp_path):
+    reg = _Reg()
+    router = _routable_router(tmp_path, reg, breaker_threshold=3,
+                              breaker_cooldown_s=60.0)
+    policy = FailoverPolicy(router, max_attempts=2)
+    with pytest.raises(ValueError):
+        FailoverPolicy(router, max_attempts=0)
+    # Route two requests onto replica 0's books, then it dies.
+    routed = [router.route("k0"), router.route("k0")]
+    victim = routed[0]
+    assert router.in_flight(victim) >= 1
+    requeue, gave_up = policy.replica_failed(victim, [101, 102])
+    assert requeue == [101, 102] and gave_up == []
+    assert reg.counters[fr.FAILOVERS_COUNTER].value == 2
+    # The dead replica's books are settled: one complete() per orphan.
+    assert router.in_flight(victim) == 0
+    # Second failover for 101 still inside the budget...
+    requeue, gave_up = policy.replica_failed(victim, [101])
+    assert requeue == [101] and gave_up == []
+    # ...the third exceeds max_attempts=2: surface the error upward.
+    requeue, gave_up = policy.replica_failed(victim, [101])
+    assert requeue == [] and gave_up == [101]
+    assert reg.counters[fr.FAILOVERS_COUNTER].value == 3
+    # Completion forgets history — a reused id starts a fresh budget.
+    policy.request_done(102)
+    requeue, _ = policy.replica_failed(victim, [102])
+    assert requeue == [102]
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController (shed-at-admission)
+# ---------------------------------------------------------------------------
+
+def test_estimate_queue_wait_math_and_validation():
+    # A request with < batch_tasks ahead rides the very next batch.
+    assert estimate_queue_wait(0, 4, 0.2) == pytest.approx(0.2)
+    assert estimate_queue_wait(3, 4, 0.2) == pytest.approx(0.2)
+    # A full batch ahead means waiting out that batch first.
+    assert estimate_queue_wait(4, 4, 0.2) == pytest.approx(0.4)
+    assert estimate_queue_wait(9, 2, 0.5) == pytest.approx(2.5)
+    with pytest.raises(ValueError):
+        estimate_queue_wait(-1, 4, 0.2)
+    with pytest.raises(ValueError):
+        estimate_queue_wait(0, 0, 0.2)
+    with pytest.raises(ValueError):
+        estimate_queue_wait(0, 4, -0.1)
+
+
+def test_admission_deadline_shed_and_liveness_floor():
+    adm = AdmissionController(2, 16, policy="deadline", headroom=1.5)
+    bucket = (5, 10)
+    now = 100.0
+    # No service sample yet: permissive (never guess).
+    adm.admit(bucket, now + 0.001, now, depth=10)
+    adm.record_service(bucket, 1.0)
+    # Liveness floor: below one full batch queued, NEVER deadline-shed
+    # — serving is the only way the EWMA refreshes, so shedding at
+    # depth 0 on a stale-high estimate would starve the estimator.
+    adm.admit(bucket, now + 0.001, now, depth=1)
+    # At depth >= batch_tasks the estimate applies: 2 ahead -> own
+    # batch completes at 2.0s, x1.5 headroom = 3.0s.
+    with pytest.raises(ShedError):
+        adm.admit(bucket, now + 1.0, now, depth=2)
+    assert adm.sheds == 1
+    adm.admit(bucket, now + 10.0, now, depth=2)  # generous deadline
+    adm.admit(bucket, float("inf"), now, depth=2)  # no deadline
+    adm.admit(bucket, None, now, depth=2)
+    assert adm.sheds == 1
+
+
+def test_admission_fair_share_under_pressure():
+    adm = AdmissionController(1, 8, policy="fair", pressure_frac=0.5)
+    assert adm.pressure_depth == 4
+    now = 0.0
+    # Tenant A fills the queue below the pressure line unchallenged.
+    for depth in range(4):
+        adm.admit((5, 10), None, now, depth=depth, tenant="A")
+        adm.note_enqueued("A")
+    # Past pressure, a NEW tenant still gets in (share is computed
+    # over distinct queued tenants including the newcomer)...
+    adm.admit((5, 10), None, now, depth=4, tenant="B")
+    adm.note_enqueued("B")
+    # ...but A, already holding 4 of 5, is over ceil(6/2)=3: shed.
+    with pytest.raises(ShedError):
+        adm.admit((5, 10), None, now, depth=5, tenant="A")
+    assert adm.sheds == 1
+    # B under its share admits; tenant=None opts out of fairness.
+    adm.admit((5, 10), None, now, depth=5, tenant="B")
+    adm.admit((5, 10), None, now, depth=5, tenant=None)
+    # Dequeues release A's held count and re-admit it.
+    for _ in range(3):
+        adm.note_removed("A")
+    adm.admit((5, 10), None, now, depth=2, tenant="A")
+
+
+def test_admission_ewma_and_validation():
+    adm = AdmissionController(4, 16, policy="deadline", ewma_alpha=0.5)
+    b = (5, 10)
+    assert adm.service_time_s(b) is None
+    adm.record_service(b, 1.0)
+    assert adm.service_time_s(b) == pytest.approx(1.0)
+    adm.record_service(b, 2.0)
+    assert adm.service_time_s(b) == pytest.approx(1.5)
+    adm.record_service(b, -5.0)  # clock anomaly: ignored
+    assert adm.service_time_s(b) == pytest.approx(1.5)
+    with pytest.raises(ValueError):
+        AdmissionController(4, 16, policy="off")
+    with pytest.raises(ValueError):
+        AdmissionController(4, 16, ewma_alpha=0.0)
+    with pytest.raises(ValueError):
+        AdmissionController(4, 16, headroom=0.9)
+
+
+# ---------------------------------------------------------------------------
+# import discipline + chaos acceptance
+# ---------------------------------------------------------------------------
+
+def test_supervisor_module_is_dependency_free(tmp_path):
+    """The supervisor must survive exactly the failures it supervises:
+    file-path loadable and fully operable with ZERO third-party
+    imports (not even numpy) — the chaos/fleet driver discipline."""
+    code = f"""
+import importlib.util, sys
+spec = importlib.util.spec_from_file_location(
+    "_sup_probe", {SUPERVISOR_PY!r})
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+class P:
+    pid = 1
+    def poll(self): return None
+    def terminate(self): pass
+    def kill(self): pass
+sup = mod.ReplicaSupervisor({str(tmp_path / "fleet")!r}, lambda s: P(),
+                            desired=1, scale_max=2)
+states = sup.tick(1000.0)
+assert states[0] == mod.STARTING, states
+assert mod.backoff_delay(0, base=0.05, cap=2.0) == 0.05
+for name in ("jax", "numpy"):
+    assert name not in sys.modules, f"{{name}} leaked into the driver"
+print("DEP_FREE_OK")
+"""
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "DEP_FREE_OK" in proc.stdout
+
+
+needs_sockets = pytest.mark.skipif(
+    not _can_bind_localhost(),
+    reason="chaos phases drive real replicas over localhost sockets "
+           "(the chaos_fleet skip-artifact path covers the CLI side)")
+
+
+@pytest.mark.slow
+@needs_sockets
+def test_chaos_fleet_quick_proof(tmp_path):
+    """The ISSUE 18 acceptance run (slow: several minutes): all three
+    chaos phases — replica SIGKILL with zero lost requests, crash
+    loop tripping the breaker while serving at N-1, and an overload
+    burst shed at admission with zero deadline misses — green from
+    one real ``chaos_fleet.py --quick`` invocation."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [sys.executable, CHAOS_FLEET, "--quick",
+         "--out", str(tmp_path / "chaos")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=570)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, f"no artifact line\n{proc.stdout}\n{proc.stderr}"
+    art = json.loads(lines[-1])
+    assert art["metric"] == "chaos_fleet"
+    assert art["status"] == "ok", art
+    assert proc.returncode == 0
+    assert art["value"] == 3 and art["unit"] == "phases_ok"
+    phases = art["phases"]
+    assert phases["kill"]["ok"] and phases["kill"]["restarts"] >= 1
+    assert phases["kill"]["stats"]["dropped"] == 0
+    assert phases["crash_loop"]["ok"]
+    assert phases["crash_loop"]["crash_loops"] >= 1
+    assert phases["burst"]["ok"] and phases["burst"]["shed"] > 0
+    assert phases["burst"]["deadline_misses"] == 0
+    # Schema-stable robustness keys (serve_bench/fleet_bench parity).
+    for key in ("fleet_restarts", "fleet_crash_loops",
+                "fleet_failover_count", "fleet_shed_count"):
+        assert art[key] is not None

@@ -348,6 +348,19 @@ def test_engine_serves_and_cache_hit_skips_adapt(engine):
     assert engine.adapt_invocations == adapt_before + 1
 
 
+def test_engine_default_has_no_admission_controller(engine):
+    """Structural zero-cost pin for shed-at-admission: the default
+    ``fleet_shed_policy="off"`` installs NO controller (submit pays
+    one ``is None`` check) and registers NO shed counter — the
+    default-off registry snapshot stays byte-identical to
+    pre-shedding (the reqtrace/watchdog discipline; the on-path is
+    unit-tested in tests/test_fleet_supervisor.py and proven
+    end-to-end by scripts/chaos_fleet.py's burst phase)."""
+    assert engine.cfg.fleet_shed_policy == "off"
+    assert engine.batcher.admission is None
+    assert "serve/shed_total" not in engine.registry.snapshot()
+
+
 def test_engine_batch_neighbors_do_not_affect_results(engine):
     """A request predicts identically whether it shares the batch with
     another task or runs alone (tasks are vmapped: batch-slot padding

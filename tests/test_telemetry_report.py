@@ -26,14 +26,15 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # section, v9 the AOT warm-start section, v10 the elastic-pod section,
 # v11 the serving-fleet section, v12 the perf-lab section, v13 the
 # autotune section, v14 the request-tracing + SLO section, v15 the
-# meta-algorithm zoo section).
+# meta-algorithm zoo section, v16 the fleet-health section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
     "watchdog", "health", "checkpoint", "cluster", "warm_start",
-    "elastic", "fleet", "perf", "tune", "requests", "algo",
+    "elastic", "fleet", "fleet_health", "perf", "tune", "requests",
+    "algo",
 }
 
 
@@ -616,6 +617,71 @@ def test_summarize_events_fleet_section():
 def test_fleet_section_unavailable_without_subsystem():
     s = summarize_events([{"event": "train_epoch", "epoch": 0}])
     assert s["fleet"] == UNAVAILABLE
+
+
+def test_summarize_events_fleet_health_section():
+    """v16: self-healing counters (supervisor restarts/crash-loops/
+    scaling, router failovers/breaker trips, replica sheds) accumulate
+    reset-aware PER SOURCE — the supervisor flushes under
+    replica="supervisor", each replica under its id — and the
+    supervisor's lifecycle rows tally by kind, so the report names
+    WHICH healing paths fired. replicas_desired is a gauge
+    (last signal wins)."""
+    events = [
+        {"event": "fleet_supervisor", "kind": "spawn", "slot": 0},
+        {"event": "fleet_supervisor", "kind": "running", "slot": 0},
+        {"event": "fleet_supervisor", "kind": "restart_scheduled",
+         "slot": 0},
+        {"event": "fleet_supervisor", "kind": "crash_loop", "slot": 0},
+        # Supervisor flush: its own counters + the desired gauge.
+        {"event": "metrics", "replica": "supervisor",
+         "metrics": {"fleet/restarts": 2.0, "fleet/crash_loops": 1.0,
+                     "fleet/scale_ups": 1.0, "fleet/scale_downs": 0.0,
+                     "fleet/replicas_desired": 3.0}},
+        # A replica's engine flush carries its shed counter; a SECOND
+        # replica's smaller value must add, not read as a reset.
+        {"event": "metrics", "replica": 0,
+         "metrics": {"serve/shed_total": 7.0}},
+        {"event": "metrics", "replica": 1,
+         "metrics": {"serve/shed_total": 2.0}},
+        # The router driver's flush (no replica id): failovers +
+        # breaker trips.
+        {"event": "metrics",
+         "metrics": {"fleet/failovers": 4.0,
+                     "fleet/breaker_trips": 1.0}},
+        # Replica 0 restarted: its shed counter resets below its own
+        # previous value — the new segment contributes whole.
+        {"event": "metrics", "replica": 0,
+         "metrics": {"serve/shed_total": 3.0}},
+        # Final supervisor flush: gauge last-wins, counters monotone.
+        {"event": "metrics", "replica": "supervisor",
+         "metrics": {"fleet/restarts": 2.0, "fleet/crash_loops": 1.0,
+                     "fleet/scale_ups": 1.0, "fleet/scale_downs": 1.0,
+                     "fleet/replicas_desired": 2.0}},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    fh = s["fleet_health"]
+    assert fh["restarts"] == 2
+    assert fh["crash_loops"] == 1
+    assert fh["scale_ups"] == 1 and fh["scale_downs"] == 1
+    assert fh["failovers"] == 4
+    assert fh["breaker_trips"] == 1
+    assert fh["sheds"] == 12          # r0: 7 + 3 (restart); r1: 2
+    assert fh["replicas_desired"] == 2  # last signal wins
+    assert fh["supervisor_events"] == {
+        "spawn": 1, "running": 1, "restart_scheduled": 1,
+        "crash_loop": 1}
+    assert "fleet health" in format_table(s)
+    # The healing counters must not leak into the v11 fleet section's
+    # l2/router tallies (distinct key sets over the same rows).
+    assert s["fleet"]["l2_hits"] == 0
+    assert s["fleet"]["router_spills"] == 0
+
+
+def test_fleet_health_section_unavailable_without_subsystem():
+    s = summarize_events([{"event": "train_epoch", "epoch": 0}])
+    assert s["fleet_health"] == UNAVAILABLE
 
 
 def test_tune_section_reset_aware_across_sweep_segments():
