@@ -450,6 +450,60 @@ class MAMLConfig:
                                            # dooms; 'fair' adds per-tenant
                                            # fairness (the hottest tenant
                                            # sheds first under pressure)
+    serve_continuous_batching: int = 0     # 1 = a GroupAssembler forms
+                                           # per-bucket groups in flight
+                                           # and dispatches on fill OR
+                                           # linger expiry. 0 (default):
+                                           # NOTHING is installed — head-
+                                           # of-line dequeue is bitwise
+                                           # identical to pre-CB serving
+    serve_batch_linger_ms: float = 5.0     # max milliseconds a forming
+                                           # group waits for stragglers
+                                           # before a partial dispatch
+                                           # (0 = dispatch immediately;
+                                           # only read when continuous
+                                           # batching is on)
+    fleet_canary_weights: Tuple[float, ...] = (0.01, 0.10, 1.0)
+                                           # weighted-rollout stages: the
+                                           # fraction of live traffic the
+                                           # canary version takes at each
+                                           # stage (strictly increasing,
+                                           # final stage 1.0 = promote).
+                                           # Per-request assignment is a
+                                           # deterministic hash of
+                                           # (tenant, seq) so stages are
+                                           # rate-monotone subsets
+    fleet_canary_min_requests: int = 32    # per-stage decision floor:
+                                           # the canary cohort must see
+                                           # at least this many requests
+                                           # before the stage can promote
+                                           # (or halt) on SLO evidence
+    fleet_canary_burn_factor: float = 2.0  # halt gate: canary cohort
+                                           # burn rate above stable's
+                                           # burn * factor (and above
+                                           # 1.0) halts the rollout and
+                                           # pins the stable version
+
+    # ---- traffic lab (serve/loadlab/, docs/SERVING.md § Traffic lab) ---
+    loadlab_trace_path: str = ""           # trace file a replay driver
+                                           # reads ("" = generate one
+                                           # from the loadlab_* shape
+                                           # knobs below)
+    loadlab_duration_s: float = 60.0       # trace length in trace-time
+                                           # seconds (wall time divides
+                                           # by loadlab_warp)
+    loadlab_base_rate: float = 2.0         # diurnal trough, requests/s
+    loadlab_peak_rate: float = 20.0        # diurnal crest, requests/s
+                                           # (peak/base is the load swing
+                                           # the autoscaler must ride)
+    loadlab_warp: float = 1.0              # time-warp: trace seconds per
+                                           # wall second (60 replays an
+                                           # hour-long trace in a minute;
+                                           # shape survives exactly)
+    loadlab_churn_every_s: float = 0.0     # slide the active-tenant
+                                           # window one id every this
+                                           # many trace seconds (0 = no
+                                           # churn)
 
     # ---- checkpoint lifecycle (ckpt/ subsystem, docs/CHECKPOINT.md) ----
     ckpt_async: int = 0                    # 1 = epoch saves snapshot host-
@@ -835,6 +889,45 @@ class MAMLConfig:
                 f"fleet_shed_policy must be 'off' (no estimator "
                 f"installed), 'deadline', or 'fair', got "
                 f"{self.fleet_shed_policy!r}")
+        if self.serve_continuous_batching not in (0, 1):
+            raise ValueError(
+                f"serve_continuous_batching must be 0 (head-of-line "
+                f"dequeue, nothing installed) or 1 (per-bucket group "
+                f"assembly), got {self.serve_continuous_batching}")
+        if self.serve_batch_linger_ms < 0:
+            raise ValueError("serve_batch_linger_ms must be >= 0 "
+                             "(0 = dispatch partial groups immediately)")
+        if not self.fleet_canary_weights:
+            raise ValueError(
+                "fleet_canary_weights must name at least one stage")
+        prev_w = 0.0
+        for w in self.fleet_canary_weights:
+            if not 0.0 < float(w) <= 1.0 or float(w) <= prev_w:
+                raise ValueError(
+                    f"fleet_canary_weights must be strictly increasing "
+                    f"fractions in (0, 1], got {self.fleet_canary_weights}")
+            prev_w = float(w)
+        if self.fleet_canary_weights[-1] != 1.0:
+            raise ValueError(
+                f"fleet_canary_weights must end at 1.0 (the promote "
+                f"stage), got {self.fleet_canary_weights}")
+        if self.fleet_canary_min_requests < 1:
+            raise ValueError("fleet_canary_min_requests must be >= 1")
+        if self.fleet_canary_burn_factor <= 0:
+            raise ValueError("fleet_canary_burn_factor must be > 0")
+        if self.loadlab_duration_s <= 0:
+            raise ValueError("loadlab_duration_s must be > 0")
+        if (self.loadlab_peak_rate <= 0 or self.loadlab_base_rate < 0
+                or self.loadlab_base_rate > self.loadlab_peak_rate):
+            raise ValueError(
+                f"loadlab rates need 0 <= base <= peak > 0, got "
+                f"base={self.loadlab_base_rate} "
+                f"peak={self.loadlab_peak_rate}")
+        if self.loadlab_warp <= 0:
+            raise ValueError("loadlab_warp must be > 0")
+        if self.loadlab_churn_every_s < 0:
+            raise ValueError(
+                "loadlab_churn_every_s must be >= 0 (0 = no churn)")
         if self.flight_recorder_events < 1:
             raise ValueError("flight_recorder_events must be >= 1")
         if self.require_mesh not in (0, 1):
@@ -1201,7 +1294,8 @@ class MAMLConfig:
         for tup_field in ("mesh_shape", "mesh_axis_names",
                           "indexes_of_folders_indicating_class",
                           "train_val_test_split",
-                          "image_norm_mean", "image_norm_std"):
+                          "image_norm_mean", "image_norm_std",
+                          "fleet_canary_weights"):
             if tup_field in kwargs and isinstance(kwargs[tup_field], list):
                 kwargs[tup_field] = tuple(kwargs[tup_field])
         if isinstance(kwargs.get("serve_buckets"), list):

@@ -159,6 +159,16 @@ _RUNTIME_ONLY_KEYS = frozenset({
     # same store an unsupervised run prewarmed.
     "fleet_supervisor", "fleet_max_restarts", "fleet_restart_window_s",
     "fleet_scale_min", "fleet_scale_max", "fleet_shed_policy",
+    # Traffic-lab knobs are dispatch-timing / traffic-split / replay
+    # POLICY: group assembly reorders which requests share a compiled
+    # step (never the step itself), canary weights split requests
+    # across versions, and loadlab shapes the offered load — none of
+    # them can change a compiled program.
+    "serve_continuous_batching", "serve_batch_linger_ms",
+    "fleet_canary_weights", "fleet_canary_min_requests",
+    "fleet_canary_burn_factor", "loadlab_trace_path",
+    "loadlab_duration_s", "loadlab_base_rate", "loadlab_peak_rate",
+    "loadlab_warp", "loadlab_churn_every_s",
     "health_grad_norm_warn_factor",
     "dispatch_sync_every", "live_progress", "use_tensorboard",
     "profile_dir", "profile_epoch", "profile_num_steps",

@@ -52,6 +52,22 @@ fairness: under queue pressure a tenant holding more than its fair
 share of the queue sheds first, so one hot tenant cannot starve the
 rest (docs/SERVING.md § Self-healing fleet).
 
+**Continuous batching** (``cfg.serve_continuous_batching``) replaces
+the head-of-line dequeue with per-bucket in-flight FORMING groups
+(:class:`GroupAssembler` — installed only when the knob is on, the
+same zero-cost pin as admission): a submit admits the request straight
+into its bucket's partially-filled group, and the group dispatches
+when it FILLS (``serve_batch_tasks`` members) or when its oldest admit
+has lingered past ``serve_batch_linger_ms`` — whichever comes first,
+oldest group first across buckets. Under load the linger budget buys
+batch occupancy (one nearly-full batch instead of several one-task
+batches each paying the full serial adapt cost), which is where the
+queue-shaped p95 of FLEET_r01 went; at low load the linger bounds the
+latency a lone request pays waiting for company. Dispatch rule table
+in docs/SERVING.md § Traffic lab. The padding contract is untouched —
+a partial group dispatched on linger pads exactly like a partial
+head-of-line group always has.
+
 Pure host-side code (numpy only) — unit-testable without compiles.
 """
 
@@ -232,6 +248,98 @@ class AdmissionController:
                 self._tenant_queued[tenant] = n - 1
 
 
+class GroupAssembler:
+    """Per-bucket in-flight forming groups: fill-or-linger dispatch.
+
+    Installed on a :class:`RequestBatcher` ONLY when
+    ``serve_continuous_batching`` is on; the default off leaves
+    ``batcher.assembler`` None and every submit/dequeue pays one
+    ``is None`` check — the admission/reqtrace structural zero-cost
+    discipline, pinned in tests/test_traffic_lab.py.
+
+    State is plain per-bucket FIFO deques (same-bucket order is strict
+    FIFO; CROSS-bucket order deliberately is not — that head-of-line
+    coupling is what continuous batching removes). Dispatch readiness,
+    oldest group first across buckets:
+
+    * **fill** — a bucket's forming group reached ``batch_tasks``
+      members; lingering longer buys nothing.
+    * **linger** — the group's oldest admit is older than
+      ``linger_ms``; waiting longer for company would start charging
+      the lone requests real latency.
+
+    Not thread-safe on its own: the owning batcher calls every method
+    under ITS queue lock (the admission-controller calling contract).
+    Dispatch counters are plain ints (registry-agnostic, the LRU-cache
+    discipline); the engine delta-mirrors them into telemetry.
+    """
+
+    def __init__(self, batch_tasks: int, linger_ms: float):
+        if batch_tasks < 1:
+            raise ValueError(
+                f"batch_tasks must be >= 1, got {batch_tasks}")
+        if linger_ms < 0:
+            raise ValueError(f"linger_ms must be >= 0, got {linger_ms}")
+        self.batch_tasks = int(batch_tasks)
+        self.linger_s = float(linger_ms) / 1e3
+        self._groups: Dict[Tuple[int, int], Deque[FewShotRequest]] = {}
+        self.fill_dispatches = 0
+        self.linger_dispatches = 0
+        self.groups_dispatched = 0
+
+    @property
+    def pending(self) -> int:
+        return sum(len(g) for g in self._groups.values())
+
+    def admit(self, req: FewShotRequest, bucket: Tuple[int, int]) -> None:
+        self._groups.setdefault(bucket, deque()).append(req)
+
+    def sweep_expired(self, now: float) -> List[FewShotRequest]:
+        """Remove deadline-expired requests from every forming group
+        (they are answered with errors exactly like queue-expired ones;
+        an empty bucket entry is dropped so its linger clock dies)."""
+        expired: List[FewShotRequest] = []
+        for bucket in list(self._groups):
+            kept = deque(r for r in self._groups[bucket]
+                         if r.deadline is None or now <= r.deadline)
+            expired.extend(r for r in self._groups[bucket]
+                           if not (r.deadline is None
+                                   or now <= r.deadline))
+            if kept:
+                self._groups[bucket] = kept
+            else:
+                del self._groups[bucket]
+        return expired
+
+    def pop_ready(self, now: float, max_tasks: int
+                  ) -> Optional[Tuple[Tuple[int, int],
+                                      List[FewShotRequest]]]:
+        """The oldest dispatch-ready group, or None while every forming
+        group is still within both its fill and linger budgets."""
+        best: Optional[Tuple[int, int]] = None
+        best_ts = math.inf
+        for bucket, grp in self._groups.items():
+            oldest = grp[0].enqueue_time or 0.0
+            full = len(grp) >= min(max_tasks, self.batch_tasks)
+            lingered = now - oldest >= self.linger_s
+            if (full or lingered) and oldest < best_ts:
+                best, best_ts = bucket, oldest
+        if best is None:
+            return None
+        grp = self._groups[best]
+        group = [grp.popleft()
+                 for _ in range(min(max_tasks, self.batch_tasks,
+                                    len(grp)))]
+        if not grp:
+            del self._groups[best]
+        if len(group) >= min(max_tasks, self.batch_tasks):
+            self.fill_dispatches += 1
+        else:
+            self.linger_dispatches += 1
+        self.groups_dispatched += 1
+        return best, group
+
+
 @dataclass
 class FewShotRequest:
     """One few-shot task: support set + query images.
@@ -325,6 +433,11 @@ class RequestBatcher:
         # (the structural zero-cost pin). The engine installs an
         # AdmissionController when the policy is on.
         self.admission: Optional[AdmissionController] = None
+        # Continuous batching (serve_continuous_batching): same pin —
+        # None routes every request through the head-of-line queue
+        # below, bitwise identical to pre-assembler serving; the engine
+        # installs a GroupAssembler when the knob is on.
+        self.assembler: Optional[GroupAssembler] = None
         self._queue: Deque[Tuple[FewShotRequest, Tuple[int, int]]] = deque()
         self._lock = threading.Lock()
 
@@ -342,6 +455,8 @@ class RequestBatcher:
 
     @property
     def depth(self) -> int:
+        if self.assembler is not None:
+            return len(self._queue) + self.assembler.pending
         return len(self._queue)
 
     def submit(self, req: FewShotRequest,
@@ -376,19 +491,24 @@ class RequestBatcher:
         stamp_deadline = (req.deadline is None
                           and self.default_deadline_ms > 0)
         with self._lock:
-            if len(self._queue) >= self.max_queue_depth:
+            depth = len(self._queue) + (self.assembler.pending
+                                        if self.assembler is not None
+                                        else 0)
+            if depth >= self.max_queue_depth:
                 raise QueueFullError(
                     f"serve queue at max depth {self.max_queue_depth}")
             now = time.monotonic() if now is None else now
             if self.admission is not None:
                 # Shed verdict BEFORE any side effect (same contract as
                 # the rejections above): the deadline judged is the one
-                # the request would carry once stamped.
+                # the request would carry once stamped. Forming-group
+                # members count as queued (``depth``) — a lingering
+                # batch is work the drain rate has not paid yet.
                 deadline = req.deadline
                 if deadline is None and stamp_deadline:
                     deadline = now + self.default_deadline_ms / 1e3
                 self.admission.admit(bucket, deadline, now,
-                                     len(self._queue), tenant=req.tenant)
+                                     depth, tenant=req.tenant)
             # Stamped only once admission is certain: a rejected submit
             # must leave the request untouched (the caller may retry it
             # later, and the deadline clock must not have been running
@@ -399,7 +519,12 @@ class RequestBatcher:
             if stamp_deadline:
                 req.deadline = now + self.default_deadline_ms / 1e3
             req.enqueue_time = now
-            self._queue.append((req, bucket))
+            if self.assembler is not None:
+                # Continuous batching: straight into the bucket's
+                # forming group — the group IS the queue position.
+                self.assembler.admit(req, bucket)
+            else:
+                self._queue.append((req, bucket))
             if self.admission is not None:
                 self.admission.note_enqueued(req.tenant)
         return bucket
@@ -415,10 +540,28 @@ class RequestBatcher:
         order (they'll head the next group). Expired requests — from any
         bucket encountered while scanning — are removed and returned
         separately for error responses + the deadline-miss metric.
+
+        Under continuous batching (``assembler`` installed) the group
+        is instead the oldest DISPATCH-READY forming group — full, or
+        past its linger budget — and an empty group with pending depth
+        means every forming group is still lingering for company (the
+        engine loop just polls again).
         """
         now = time.monotonic() if now is None else now
         group: List[FewShotRequest] = []
         expired: List[FewShotRequest] = []
+        if self.assembler is not None:
+            with self._lock:
+                expired = self.assembler.sweep_expired(now)
+                ready = self.assembler.pop_ready(now, max_tasks)
+                if self.admission is not None:
+                    for req in (ready[1] if ready else []):
+                        self.admission.note_removed(req.tenant)
+                    for req in expired:
+                        self.admission.note_removed(req.tenant)
+            if ready is not None:
+                return ready[0], ready[1], expired
+            return self.buckets[0], [], expired
         with self._lock:
             kept: Deque[Tuple[FewShotRequest, Tuple[int, int]]] = deque()
             bucket: Optional[Tuple[int, int]] = None

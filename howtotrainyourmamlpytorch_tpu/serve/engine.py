@@ -68,8 +68,8 @@ from howtotrainyourmamlpytorch_tpu.parallel.mesh import (
 from howtotrainyourmamlpytorch_tpu.serve.adapt import (
     AdaptedTask, make_serve_steps)
 from howtotrainyourmamlpytorch_tpu.serve.batcher import (
-    AdmissionController, FewShotRequest, QueueFullError, RequestBatcher,
-    ShedError, pad_group)
+    AdmissionController, FewShotRequest, GroupAssembler, QueueFullError,
+    RequestBatcher, ShedError, pad_group)
 from howtotrainyourmamlpytorch_tpu.serve.cache import (
     AdaptedParamsLRU, support_fingerprint)
 from howtotrainyourmamlpytorch_tpu.serve.fleet.l2cache import (
@@ -160,9 +160,24 @@ class ServingEngine:
                 cfg.serve_batch_tasks,
                 cfg.serve_max_queue_depth,
                 policy=cfg.fleet_shed_policy)
+        # Continuous batching (serve/batcher.py § GroupAssembler): same
+        # install-only-when-on discipline — the default off leaves
+        # batcher.assembler None and dispatch is bitwise identical to
+        # pre-assembler serving (pinned in tests/test_traffic_lab.py).
+        self._cb_mirrored = (0, 0, 0)
+        if cfg.serve_continuous_batching:
+            self.batcher.assembler = GroupAssembler(
+                cfg.serve_batch_tasks, cfg.serve_batch_linger_ms)
         self.cache = AdaptedParamsLRU(cfg.serve_cache_capacity)
         self.registry = registry if registry is not None else (
             MetricsRegistry())
+        if self.batcher.assembler is not None:
+            # Eager registration, gated on the knob (a flush row shows
+            # "0 groups", not an absent key; the default-off registry
+            # snapshot stays byte-identical to pre-CB serving).
+            for name in ("serve/cb_groups", "serve/cb_fill_dispatch",
+                         "serve/cb_linger_dispatch"):
+                self.registry.counter(name)
         if self.batcher.admission is not None:
             # Eager registration (a flush row shows "0 sheds", not an
             # absent key) — gated on the policy so the default-off
@@ -1049,6 +1064,15 @@ class ServingEngine:
         total = h + m
         if total:
             reg.gauge("serve/cache_hit_frac").set(h / total)
+        asm = self.batcher.assembler
+        if asm is not None:
+            g, fd, ld = (asm.groups_dispatched, asm.fill_dispatches,
+                         asm.linger_dispatches)
+            pg, pfd, pld = self._cb_mirrored
+            reg.counter("serve/cb_groups").inc(g - pg)
+            reg.counter("serve/cb_fill_dispatch").inc(fd - pfd)
+            reg.counter("serve/cb_linger_dispatch").inc(ld - pld)
+            self._cb_mirrored = (g, fd, ld)
 
     def flush_metrics(self, jsonl: JsonlLogger,
                       **extra: Any) -> Dict[str, Any]:

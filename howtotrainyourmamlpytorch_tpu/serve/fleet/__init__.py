@@ -32,6 +32,8 @@ from howtotrainyourmamlpytorch_tpu.serve.fleet.router import (
     HashRing,
     ReplicaBreaker,
     ReplicaLease,
+    assign_canary,
+    canary_fraction,
     read_members,
     routing_key,
 )
@@ -43,6 +45,6 @@ from howtotrainyourmamlpytorch_tpu.serve.fleet.supervisor import (
 __all__ = [
     "CrashLoopBreaker", "FailoverPolicy", "FleetController",
     "FleetRouter", "HashRing", "L2AdaptedParamsCache", "ReplicaBreaker",
-    "ReplicaLease", "ReplicaSupervisor", "advise", "read_members",
-    "routing_key",
+    "ReplicaLease", "ReplicaSupervisor", "advise", "assign_canary",
+    "canary_fraction", "read_members", "routing_key",
 ]

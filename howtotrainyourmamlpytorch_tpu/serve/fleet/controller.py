@@ -33,6 +33,19 @@ State machine (docs/SERVING.md § Fleet has the prose version)::
                     pinned in `rejected`, tombstone removed)
     rolling(index == len(replicas)) -> done
 
+**Weighted rollouts** (``start_rollout(..., weights=...)``, config
+``fleet_canary_weights``) interleave the same swap steps with BAKE
+stages: after the first replica adopts the version, the traffic split
+(``router.assign_canary`` — a deterministic hash of (tenant, seq))
+sends ``weights[stage]`` of live requests to the swapped cohort while
+two per-cohort :class:`SLOLedger` instances compare canary-vs-stable
+burn rates. Each stage promotes only on fresh evidence (>=
+``fleet_canary_min_requests`` canary completions, burn under
+``max(1, stable * fleet_canary_burn_factor)``); a regression halts
+and pins the version exactly like a canary failure. Reaching the
+final 1.0 weight promotes the remaining replicas through the ordinary
+rolling machine.
+
 Autoscale/drain signals: :meth:`publish_signals` folds the per-replica
 serving stats the replicas already publish in their lease payloads
 (queue depth, p95, cache hit fraction — derived from the existing
@@ -67,6 +80,8 @@ HALTED = "halted"
 SWAPS_COUNTER = "fleet/rolling_swaps"
 SWAP_STEPS_COUNTER = "fleet/rolling_swap_steps"
 HALTS_COUNTER = "fleet/rolling_swap_halts"
+CANARY_STAGE_COUNTER = "fleet/canary_stage_promotions"
+CANARY_WEIGHT_GAUGE = "fleet/canary_weight"
 QUEUE_GAUGE = "fleet/queue_depth_total"
 P95_GAUGE = "fleet/p95_ms_max"
 HIT_FRAC_GAUGE = "fleet/cache_hit_frac_min"
@@ -180,6 +195,12 @@ class SLOLedger:
             out.extend(window)
         return out
 
+    def count(self, tenant: Optional[str] = None) -> int:
+        """Requests currently in the rolling window(s) — the evidence
+        size a bake-stage decision is allowed to rest on."""
+        with self._lock:
+            return len(self._rows(tenant))
+
     def burn_rate(self, tenant: Optional[str] = None) -> Optional[float]:
         """Error-budget burn rate over the rolling window(s); None when
         nothing has been observed (an honest "no data", never a fake
@@ -229,7 +250,9 @@ class FleetController:
                  *, registry: Optional[Any] = None,
                  step_stall_timeout_s: float = 600.0,
                  slo_p95_ms: float = 2000.0,
-                 slo_target_frac: float = 0.95):
+                 slo_target_frac: float = 0.95,
+                 canary_min_requests: int = 32,
+                 canary_burn_factor: float = 2.0):
         self.fleet_dir = fleet_dir
         self.members = members
         self.registry = registry
@@ -244,11 +267,36 @@ class FleetController:
         self.slo = SLOLedger(slo_p95_ms=slo_p95_ms,
                              target_frac=slo_target_frac,
                              registry=registry)
+        # Weighted-canary cohort ledgers (config: fleet_canary_*): the
+        # driver attributes each completion to the cohort that served it
+        # via observe_cohort(); a bake stage promotes or halts on the
+        # canary-vs-stable burn comparison. Fresh ledgers per stage —
+        # each stage's verdict rests on its own evidence, never on
+        # requests a lighter weight already judged.
+        self.canary_min_requests = int(canary_min_requests)
+        self.canary_burn_factor = float(canary_burn_factor)
+        self._cohorts: Dict[str, SLOLedger] = {}
+        self._reset_cohorts()
         if registry is not None:
-            for name in (SWAPS_COUNTER, SWAP_STEPS_COUNTER, HALTS_COUNTER):
+            for name in (SWAPS_COUNTER, SWAP_STEPS_COUNTER, HALTS_COUNTER,
+                         CANARY_STAGE_COUNTER):
                 registry.counter(name)
             for name in _AGG_COUNTERS.values():
                 registry.counter(name)
+
+    def _reset_cohorts(self) -> None:
+        self._cohorts = {
+            name: SLOLedger(slo_p95_ms=self.slo.slo_p95_ms,
+                            target_frac=self.slo.target_frac)
+            for name in ("canary", "stable")}
+
+    def observe_cohort(self, cohort: str, tenant: Any,
+                       latency_ms: float) -> bool:
+        """Attribute one completed request to its serving cohort
+        (``"canary"`` / ``"stable"``) for the stage comparison. Callers
+        still feed ``self.slo`` for the fleet-wide signal — the cohort
+        ledgers exist ONLY to judge the rollout."""
+        return self._cohorts[cohort].observe(tenant, latency_ms)
 
     # -- rollout record ---------------------------------------------------
     def read_rollout(self) -> Dict[str, Any]:
@@ -295,12 +343,22 @@ class FleetController:
 
     # -- rolling swap -----------------------------------------------------
     def start_rollout(self, version: int,
-                      replicas: Optional[List[int]] = None
+                      replicas: Optional[List[int]] = None, *,
+                      weights: Optional[List[float]] = None
                       ) -> Dict[str, Any]:
         """Begin a rolling swap to ``version``. Replicas default to the
         current live membership in id order (deterministic — operators
         and tests see the same order). Prior ``rejected`` pins carry
-        over: a version rejected once stays rejected."""
+        over: a version rejected once stays rejected.
+
+        ``weights`` turns the rollout WEIGHTED (config:
+        ``fleet_canary_weights``): the first replica swaps as usual,
+        then instead of immediately draining the next one the rollout
+        BAKES — the traffic split (``router.assign_canary``) sends
+        ``weights[stage]`` of requests to the swapped cohort and
+        ``tick()`` promotes stage by stage on canary-vs-stable SLO
+        evidence. Reaching the final 1.0 stage promotes: the remaining
+        replicas roll exactly like an unweighted rollout."""
         doc = self.read_rollout()
         if version in doc.get("rejected", []):
             return doc  # pinned: never roll a known-bad version
@@ -311,6 +369,12 @@ class FleetController:
         doc.update({"state": ROLLING if replicas else DONE,
                     "version": int(version),
                     "replicas": [int(r) for r in replicas], "index": 0})
+        doc.pop("mode", None)
+        if weights is not None and replicas:
+            self._reset_cohorts()
+            doc.update({"mode": "weighted", "phase": "swap",
+                        "weights": [float(w) for w in weights],
+                        "stage": 0, "canary": [], "stage_history": []})
         # Rollout record FIRST, tombstone second: a crash between the
         # two leaves a rolling record whose next tick() re-drains (the
         # drain write is idempotent) — the reverse order would strand
@@ -329,6 +393,8 @@ class FleetController:
         doc = self.read_rollout()
         if doc["state"] != ROLLING:
             return doc
+        if doc.get("mode") == "weighted":
+            return self._tick_weighted(doc)
         version = int(doc["version"])
         replicas = doc["replicas"]
         rid = replicas[doc["index"]]
@@ -392,6 +458,150 @@ class FleetController:
                 self.registry.counter(HALTS_COUNTER).inc()
             return self._write_rollout(doc)
         return doc
+
+    # -- weighted canary rollout ------------------------------------------
+    def _halt(self, doc: Dict[str, Any], rid: Optional[int], *,
+              reason: str, detail: Optional[str],
+              pin: bool) -> Dict[str, Any]:
+        """Stop the rollout. ``pin`` records the version in the
+        fleet-wide ``rejected`` list (an SLO/canary VERDICT); a stall
+        halts unpinned so the same rollout can be retried once the
+        cause is fixed."""
+        if rid is not None:
+            self.undrain(rid)
+        doc["state"] = HALTED
+        doc["halt_reason"] = reason
+        doc["halt_detail"] = detail
+        doc["halt_replica"] = rid
+        if pin and int(doc["version"]) not in doc["rejected"]:
+            doc["rejected"].append(int(doc["version"]))
+        if self.registry is not None:
+            self.registry.counter(HALTS_COUNTER).inc()
+        return self._write_rollout(doc)
+
+    def _tick_weighted(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """One observation of the weighted machine::
+
+            swap --(ack, stage weight < 1)--> bake
+            swap --(ack, stage weight == 1)--> swap(next) ... -> done
+            swap --(swap_failed / died)--> halted (version pinned)
+            bake --(canary burn > max(1, stable burn * factor),
+                    over >= min_requests)--> halted (version pinned)
+            bake --(burn OK over >= min_requests)--> stage+1
+                   (bake again, or swap(next) when the ladder hits 1.0)
+            swap/bake --(stalled past step_stall_timeout_s)--> halted
+                   (NOT pinned: a stall is not a canary verdict)
+        """
+        version = int(doc["version"])
+        replicas = doc["replicas"]
+        weights = [float(w) for w in doc["weights"]]
+        stage = int(doc["stage"])
+        if self.registry is not None:
+            self.registry.gauge(CANARY_WEIGHT_GAUGE).set(
+                weights[min(stage, len(weights) - 1)])
+        age = time.time() - float(doc.get("updated_ts") or time.time())
+        stalled = (self.step_stall_timeout_s > 0
+                   and age > self.step_stall_timeout_s)
+        if doc.get("phase") == "swap":
+            rid = replicas[doc["index"]]
+            rec = self.members().get(rid) or {}
+            payload = rec.get("payload") or {}
+            died = rec.get("state", "dead") == "dead"
+            if (payload.get("swap_failed") == version
+                    or version in (payload.get("rejected") or [])
+                    or died):
+                return self._halt(
+                    doc, rid, pin=True,
+                    reason=("replica died mid-swap" if died
+                            else "canary failed"),
+                    detail=payload.get("swap_reason"))
+            if int(payload.get("version") or -1) >= version:
+                self.undrain(rid)
+                doc["canary"] = sorted(
+                    set(int(r) for r in (doc.get("canary") or []))
+                    | {int(rid)})
+                doc["index"] += 1
+                if self.registry is not None:
+                    self.registry.counter(SWAP_STEPS_COUNTER).inc()
+                if doc["index"] >= len(replicas):
+                    doc["state"] = DONE
+                    if self.registry is not None:
+                        self.registry.counter(SWAPS_COUNTER).inc()
+                elif weights[stage] >= 1.0:
+                    # Promote ladder reached 1.0: keep rolling, one
+                    # replica at a time, exactly like the unweighted
+                    # machine.
+                    self.drain(replicas[doc["index"]],
+                               reason="weighted_rollout", version=version)
+                else:
+                    doc["phase"] = "bake"
+                return self._write_rollout(doc)
+            if not os.path.exists(self._drain_path(rid)):
+                self.drain(rid, reason="weighted_rollout", version=version)
+            if stalled:
+                return self._halt(
+                    doc, rid, pin=False, reason="rollout step stalled",
+                    detail=(f"replica {rid} made no swap decision "
+                            f"in {age:.0f}s"))
+            return doc
+        # -- bake: judge weights[stage] on cohort SLO evidence ----------
+        canary, stable = self._cohorts["canary"], self._cohorts["stable"]
+        n = canary.count()
+        c_burn = canary.burn_rate()
+        if n >= self.canary_min_requests and c_burn is not None:
+            s_burn = stable.burn_rate()
+            threshold = max(1.0, (s_burn or 0.0) * self.canary_burn_factor)
+            if c_burn > threshold:
+                doc["halt_stage"] = stage
+                doc["halt_canary_burn"] = round(c_burn, 4)
+                doc["halt_stable_burn"] = (None if s_burn is None
+                                           else round(s_burn, 4))
+                return self._halt(
+                    doc, None, pin=True, reason="canary slo regression",
+                    detail=(f"stage {stage} weight {weights[stage]:g}: "
+                            f"canary burn {c_burn:.2f} > allowed "
+                            f"{threshold:.2f} (stable "
+                            f"{0.0 if s_burn is None else s_burn:.2f})"))
+            doc["stage_history"].append({
+                "stage": stage, "weight": weights[stage],
+                "canary": {"count": n, "burn_rate": round(c_burn, 4)},
+                "stable": {"count": stable.count(),
+                           "burn_rate": (None if s_burn is None
+                                         else round(s_burn, 4))}})
+            doc["stage"] = stage = stage + 1
+            self._reset_cohorts()
+            if self.registry is not None:
+                self.registry.counter(CANARY_STAGE_COUNTER).inc()
+            if stage >= len(weights) or weights[stage] >= 1.0:
+                doc["stage"] = min(stage, len(weights) - 1)
+                doc["phase"] = "swap"
+                self.drain(replicas[doc["index"]],
+                           reason="weighted_rollout", version=version)
+            return self._write_rollout(doc)
+        if stalled:
+            return self._halt(
+                doc, None, pin=False, reason="bake stage stalled",
+                detail=(f"stage {stage}: {n}/{self.canary_min_requests} "
+                        f"canary observations in {age:.0f}s"))
+        return doc
+
+    def traffic_split(self) -> Dict[str, Any]:
+        """The live split a driver feeds ``router.route(among=...)``:
+        ``{"weight", "canary", "stage"}``. ``weight`` None = split off
+        (no weighted bake in flight — either no weighted rollout, or
+        the promote leg where traffic routes unrestricted while the
+        remaining replicas swap)."""
+        doc = self.read_rollout()
+        if doc.get("mode") != "weighted" or doc.get("state") != ROLLING:
+            return {"weight": None, "canary": [], "stage": None}
+        canary = [int(r) for r in (doc.get("canary") or [])]
+        if not canary:
+            return {"weight": None, "canary": [], "stage": None}
+        stage = int(doc["stage"])
+        weight = float(doc["weights"][stage])
+        if doc.get("phase") != "bake" or weight >= 1.0:
+            return {"weight": None, "canary": canary, "stage": stage}
+        return {"weight": weight, "canary": canary, "stage": stage}
 
     # -- autoscale / drain signals ---------------------------------------
     def publish_signals(self,

@@ -243,6 +243,13 @@ class ReplicaServer:
                 "sheds": (reg.counter("serve/shed_total").value
                           if getattr(eng.batcher, "admission", None)
                           is not None else 0),
+                # Same guarded-read rule for continuous batching: the
+                # assembler's dispatch tallies are plain ints, and an
+                # uninstalled assembler reports 0 without creating
+                # anything.
+                "cb_groups": (eng.batcher.assembler.groups_dispatched
+                              if getattr(eng.batcher, "assembler", None)
+                              is not None else 0),
             },
         }
 
@@ -369,8 +376,16 @@ class ReplicaServer:
             if draining:
                 self._maybe_swap()
             self._touch_lease()
-            if not responses and not self.engine.batcher.depth:
-                time.sleep(0.002)  # idle: yield the (possibly 1-core) box
+            if not responses and (
+                    not self.engine.batcher.depth
+                    or getattr(self.engine.batcher, "assembler", None)
+                    is not None):
+                # Idle — or continuous batching is holding partial groups
+                # open (depth > 0 yet nothing dispatchable until a linger
+                # deadline ~ tens of ms away): yield the (possibly 1-core)
+                # box instead of spinning the serve loop through the
+                # whole linger window.
+                time.sleep(0.002)
 
     def close(self) -> None:
         self.running = False

@@ -125,6 +125,8 @@ FAILOVERS_COUNTER = "fleet/failovers"
 BREAKER_TRIPS_COUNTER = "fleet/breaker_trips"
 LIVE_GAUGE = "fleet/replicas_live"
 DRAINING_GAUGE = "fleet/replicas_draining"
+CANARY_REQUESTS_COUNTER = "fleet/canary_requests"
+COHORT_FALLBACK_COUNTER = "fleet/cohort_fallbacks"
 
 
 def lease_path(fleet_dir: str, replica_id: int) -> str:
@@ -154,6 +156,35 @@ def routing_key(support_x: Any, support_y: Any) -> str:
         h.update(str(getattr(arr, "shape", ())).encode())
         h.update(arr.tobytes() if hasattr(arr, "tobytes") else bytes(arr))
     return h.hexdigest()
+
+
+def canary_fraction(tenant: Any, seq: int) -> float:
+    """Deterministic traffic-split coordinate of one request in [0, 1).
+
+    A sha256 of ``(tenant, seq)`` scaled to the unit interval — the
+    request-level identity of the weighted canary split. Comparing the
+    SAME coordinate against a growing weight threshold makes every
+    stage's canary cohort a strict superset of the previous stage's
+    (the rate-monotone property the stage-over-stage SLO comparison
+    depends on: promoted traffic ADDS requests to the canary, it never
+    reshuffles which requests the canary already saw). Independent of
+    the routing key on purpose: the split must sample tenants evenly,
+    not follow cache affinity.
+    """
+    digest = hashlib.sha256(
+        f"canary:{tenant}:{int(seq)}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / 2.0 ** 64
+
+
+def assign_canary(tenant: Any, seq: int, weight: float) -> bool:
+    """True when request ``(tenant, seq)`` rides the canary cohort at
+    traffic ``weight`` in [0, 1]. Deterministic across processes and
+    reruns; monotone in ``weight``."""
+    if weight <= 0.0:
+        return False
+    if weight >= 1.0:
+        return True
+    return canary_fraction(tenant, seq) < float(weight)
 
 
 def _point(token: str) -> int:
@@ -468,7 +499,8 @@ class FleetRouter:
         if registry is not None:
             for name in (REQUESTS_COUNTER, SPILLS_COUNTER,
                          NO_REPLICA_COUNTER, FAILOVERS_COUNTER,
-                         BREAKER_TRIPS_COUNTER):
+                         BREAKER_TRIPS_COUNTER, CANARY_REQUESTS_COUNTER,
+                         COHORT_FALLBACK_COUNTER):
                 registry.counter(name)
 
     # -- membership -------------------------------------------------------
@@ -519,18 +551,34 @@ class FleetRouter:
 
     # -- routing ----------------------------------------------------------
     def route(self, key: str,
-              ctx: Optional[Dict[str, Any]] = None) -> Optional[int]:
+              ctx: Optional[Dict[str, Any]] = None, *,
+              among: Optional[Sequence[int]] = None) -> Optional[int]:
         """Pick the replica for ``key``: the ring primary unless it is
         past its bounded-load capacity, else the next ring position
         (counted as a spill), else — everyone saturated — the
         least-loaded routable replica (affinity yields to liveness).
         None (counted) when the ring is empty. ``ctx`` is an optional
         request-trace context — a sampled request records a ``route``
-        span carrying the pick and whether it spilled."""
+        span carrying the pick and whether it spilled.
+
+        ``among`` restricts the pick to a version cohort (the weighted
+        canary split: the caller assigns the request via
+        :func:`assign_canary` and passes that cohort's replica ids).
+        Ring order — and with it cache affinity — is preserved INSIDE
+        the cohort; an empty intersection falls back to the full
+        candidate list (counted ``fleet/cohort_fallbacks``: serving the
+        request on the wrong cohort beats dropping it, and the fallback
+        count is the honesty signal that the split was not exact)."""
         reg = self.registry
         t0 = time.monotonic() if ctx is not None else 0.0
         with self._lock:
             cands = self.ring.candidates(key)
+            if among is not None and cands:
+                cohort = [r for r in cands if r in set(among)]
+                if cohort:
+                    cands = cohort
+                elif reg is not None:
+                    reg.counter(COHORT_FALLBACK_COUNTER).inc()
             if cands and self.breaker._records:
                 # Slow path only while some breaker record exists: a
                 # healthy fleet never pays per-candidate state checks.

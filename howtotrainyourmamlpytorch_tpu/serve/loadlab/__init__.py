@@ -1,0 +1,30 @@
+"""Traffic lab: record, synthesize and replay request traffic.
+
+The fleet's proofs so far drove synthetic open-loop load with a flat
+arrival schedule; production traffic has *shape* — diurnal ramps,
+tenant churn, bursts — and the autoscaler/canary machinery can only be
+proven against a demand curve that actually moves. This package is
+that curve, as data:
+
+* ``trace.py`` — the on-disk trace format: a CRC-framed JSONL file of
+  (arrival_ts_rel, tenant, shape-bucket, deadline, seed) records (the
+  ``l2cache.py`` framing discipline: magic + length + CRC32, verified
+  before a byte is trusted; tmp + fsync + rename commit).
+* ``workloads.py`` — ONE definition of the synthetic request
+  generators (``synthetic_arrays`` / ``tenant_pool``, migrated from
+  scripts/serve_bench.py) plus deterministic traffic synthesizers:
+  diurnal rate ramps, tenant churn, burst overlays. Same seed, same
+  trace, byte for byte.
+* ``replay.py`` — open-loop replay: arrivals fire off the TRACE clock
+  (warped by a time factor), never the response clock, so overload is
+  actually applied instead of self-throttled away (the serve_bench
+  coordinated-omission rule, generalized to shaped traffic).
+
+Every module here is **jax-free and file-path-loadable** (stdlib +
+numpy only, no package imports — the ckpt_admin/reqtrace discipline),
+so the fleet driver processes (`scripts/fleet_bench.py`,
+`scripts/traffic_replay.py`) load them without initializing an
+accelerator runtime. NOTE: importing this package *as a package*
+triggers ``serve/__init__`` (which imports jax) — jax-free consumers
+must load the module files by path, exactly like router.py/l2cache.py.
+"""

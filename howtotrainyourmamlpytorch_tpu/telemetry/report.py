@@ -61,8 +61,15 @@ from typing import Any, Dict, List, Optional, Union
 # counters from the router's driver, shed counts from replica flushes
 # — all reset-aware per (source, metric) like the fleet section;
 # replicas_desired gauge last-wins; supervisor lifecycle events
-# tallied by kind)
-SCHEMA = "maml_tpu_telemetry_report_v16"
+# tallied by kind);
+# v17: + "traffic" (traffic lab, serve/loadlab/ + continuous batching
+# + weighted canary rollouts: cb group/fill/linger dispatch counters
+# from replica flushes, canary-request / cohort-fallback /
+# stage-promotion counters from the router+controller driver — all
+# reset-aware per (source, metric) like the fleet-health section; the
+# canary weight gauge — the rollout ladder's current stage — takes
+# the last signal)
+SCHEMA = "maml_tpu_telemetry_report_v17"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -715,6 +722,54 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "supervisor_events": fh_kinds or UNAVAILABLE,
         }
 
+    # Traffic section (serve/loadlab/ + continuous batching + weighted
+    # canary, schema v17): continuous-batching dispatch counters come
+    # from replica flushes (serve/cb_*), the traffic-split counters
+    # from whichever driver runs the router/controller — one log
+    # interleaves several sources, so accumulation is reset-aware per
+    # (source, metric) like the fleet-health section. The canary
+    # weight is a gauge (the rollout ladder's CURRENT stage —
+    # last-signal wins). Runs without continuous batching or a
+    # weighted rollout summarize to "unavailable".
+    _TRAFFIC_COUNTERS = {
+        "cb_groups": "serve/cb_groups",
+        "cb_fill_dispatches": "serve/cb_fill_dispatch",
+        "cb_linger_dispatches": "serve/cb_linger_dispatch",
+        "canary_requests": "fleet/canary_requests",
+        "cohort_fallbacks": "fleet/cohort_fallbacks",
+        "stage_promotions": "fleet/canary_stage_promotions",
+    }
+    tr_totals: Dict[str, float] = {}
+    tr_prev: Dict[str, float] = {}
+    tr_seen = False
+    tr_weight: Metric = UNAVAILABLE
+    for e in events:
+        if e.get("event") != "metrics":
+            continue
+        m = e.get("metrics") or {}
+        relevant = [key for key in _TRAFFIC_COUNTERS.values()
+                    if m.get(key) is not None]
+        if not relevant and m.get("fleet/canary_weight") is None:
+            continue
+        tr_seen = True
+        source = str(e.get("replica", ""))
+        for key in relevant:
+            _accumulate_counter(tr_totals, tr_prev,
+                                f"{source}:{key}", float(m[key]))
+        if m.get("fleet/canary_weight") is not None:
+            tr_weight = round(float(m["fleet/canary_weight"]), 4)
+    traffic_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if tr_seen:
+        def _tr_total(key: str) -> int:
+            return int(sum(v for k, v in tr_totals.items()
+                           if k.split(":", 1)[1] == key))
+
+        traffic_sec = {
+            **{label: _tr_total(key)
+               for label, key in _TRAFFIC_COUNTERS.items()},
+            "canary_weight": tr_weight,
+        }
+
     # Perf section (telemetry/profiler.py, schema v12): each
     # "perf_profile" row is one sampled dispatch-sync window — the
     # window-split fractions and top device-time executable take the
@@ -1026,6 +1081,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "elastic": elastic_sec,
         "fleet": fleet_sec,
         "fleet_health": fleet_health_sec,
+        "traffic": traffic_sec,
         "perf": perf_sec,
         "tune": tune_sec,
         "requests": requests_sec,
@@ -1067,6 +1123,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("elastic", summary["elastic"]),
         ("fleet", summary["fleet"]),
         ("fleet health", summary["fleet_health"]),
+        ("traffic", summary["traffic"]),
         ("perf", summary["perf"]),
         ("tune", summary["tune"]),
         ("requests", summary["requests"]),

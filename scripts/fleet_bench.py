@@ -69,15 +69,24 @@ _SCRIPTS = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _SCRIPTS)
 sys.path.insert(0, _REPO)
 
-from serve_bench import synthetic_arrays, tenant_pool  # noqa: E402
-
-
 def _load_module(name: str, relpath: str):
     spec = importlib.util.spec_from_file_location(
         name, os.path.join(_REPO, relpath))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+# The ONE definition of the synthetic request generators lives in
+# serve/loadlab/workloads.py (file-path loaded — stdlib+numpy only, no
+# jax); serve_bench re-exports the same functions, so every bench
+# synthesizes identical traffic by construction.
+_workloads_mod = _load_module(
+    "_fleet_bench_workloads_impl",
+    os.path.join("howtotrainyourmamlpytorch_tpu", "serve", "loadlab",
+                 "workloads.py"))
+synthetic_arrays = _workloads_mod.synthetic_arrays
+tenant_pool = _workloads_mod.tenant_pool
 
 
 _router_mod = _load_module(
@@ -1099,6 +1108,13 @@ def main(argv=None) -> int:
                                          if trace_summary else None),
             "fleet_slo_burn_rate": extras.get("slo_burn_rate"),
             "fleet_slo_tenants": extras.get("slo"),
+            # Traffic-lab keys (scripts/traffic_replay.py fills them):
+            # this bench drives closed-loop load with no replayer, no
+            # continuous batching and no weighted split — honestly null.
+            "traffic_p95_ms": None,
+            "traffic_slo_held": None,
+            "traffic_canary_weight_final": None,
+            "traffic_cb_groups": None,
             "rollout": rollout or None,
             "migration": migration or None,
             "zero_dropped": zero_dropped,

@@ -91,6 +91,9 @@ SHARD_SIZE_OVERRIDES = {
     #                                         (bitwise pin) + two
     #                                         serving engines (ANIL
     #                                         serve comparison)
+    "tests/test_traffic_lab.py": 120_000,   # batcher/canary units plus
+    #                                         a jax-free subprocess
+    #                                         booby-trap proof
 }
 
 

@@ -72,36 +72,25 @@ def build_config(args):
                        serve_max_queue_depth=args.queue_depth)
 
 
-def synthetic_arrays(image_shape, num_classes, uint8_wire, rng, fill):
-    """Raw (support_x, support_y, query_x) arrays for one synthetic
-    task at ``fill`` occupancy — plain args and numpy only, so the
-    jax-free fleet router process (scripts/fleet_bench.py) can share
-    THIS generator instead of forking it."""
-    s, q = fill
-    h, w, c = image_shape
-    if uint8_wire:
-        sx = rng.randint(0, 256, (s, h, w, c)).astype(np.uint8)
-        qx = rng.randint(0, 256, (q, h, w, c)).astype(np.uint8)
-    else:
-        sx = rng.randn(s, h, w, c).astype(np.float32)
-        qx = rng.randn(q, h, w, c).astype(np.float32)
-    sy = (np.arange(s) % num_classes).astype(np.int32)
-    return sx, sy, qx
+# The synthetic request generators moved to serve/loadlab/workloads.py
+# (the traffic lab's ONE definition — stdlib+numpy, file-path loadable
+# by the jax-free fleet drivers). Re-exported here so existing callers
+# (`from serve_bench import synthetic_arrays, tenant_pool`) keep
+# working and every bench draws identical traffic by construction.
+def _load_workloads():
+    import importlib.util
+    path = os.path.join(_REPO, "howtotrainyourmamlpytorch_tpu", "serve",
+                        "loadlab", "workloads.py")
+    spec = importlib.util.spec_from_file_location(
+        "_serve_bench_workloads_impl", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
-def tenant_pool(image_shape, num_classes, uint8_wire, rng, buckets,
-                num_tenants):
-    """Fixed support sets, one per tenant — the "adapt once, predict
-    many" population both serving benches draw repeats from. Each
-    tenant keeps its support set forever; only queries are fresh."""
-    pool = []
-    for t in range(num_tenants):
-        bucket = buckets[t % len(buckets)]
-        fill = (max(1, bucket[0] - (t % 2)), max(1, bucket[1] - (t % 3)))
-        sx, sy, _ = synthetic_arrays(image_shape, num_classes,
-                                     uint8_wire, rng, fill)
-        pool.append((sx, sy, fill[1]))
-    return pool
+_workloads_mod = _load_workloads()
+synthetic_arrays = _workloads_mod.synthetic_arrays
+tenant_pool = _workloads_mod.tenant_pool
 
 
 def synthetic_request(cfg, bucket, rng, fill, arrival):
@@ -304,6 +293,12 @@ def main() -> int:
         "fleet_shed_count": None,
         "fleet_failover_count": None,
         "fleet_restarts": None,
+        # Traffic-lab keys (scripts/traffic_replay.py fills them):
+        # null here, same schema-stability rule as the fleet keys.
+        "traffic_p95_ms": None,
+        "traffic_slo_held": None,
+        "traffic_canary_weight_final": None,
+        "traffic_cb_groups": None,
     }
     if args.events:
         jsonl = JsonlLogger(args.events)

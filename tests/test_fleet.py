@@ -714,5 +714,17 @@ def test_serve_bench_exposes_fleet_keys_as_null():
                 "fleet_trace_dominant_tier", "fleet_trace_tier_seconds",
                 "fleet_slo_burn_rate", "fleet_slo_tenants",
                 "fleet_shed_count", "fleet_failover_count",
-                "fleet_restarts"):
+                "fleet_restarts",
+                # ISSUE 19 traffic-lab keys (traffic_replay.py fills
+                # them; both bench artifacts carry them as null).
+                "traffic_p95_ms", "traffic_slo_held",
+                "traffic_canary_weight_final", "traffic_cb_groups"):
         assert key in keys, f"serve_bench artifact lost {key}"
+
+    fleet_src = open(os.path.join(REPO, "scripts", "fleet_bench.py")).read()
+    fleet_keys = {getattr(k, "value", None)
+                  for node in ast.walk(ast.parse(fleet_src))
+                  if isinstance(node, ast.Dict) for k in node.keys}
+    for key in ("traffic_p95_ms", "traffic_slo_held",
+                "traffic_canary_weight_final", "traffic_cb_groups"):
+        assert key in fleet_keys, f"fleet_bench artifact lost {key}"

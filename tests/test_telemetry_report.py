@@ -26,15 +26,16 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # section, v9 the AOT warm-start section, v10 the elastic-pod section,
 # v11 the serving-fleet section, v12 the perf-lab section, v13 the
 # autotune section, v14 the request-tracing + SLO section, v15 the
-# meta-algorithm zoo section, v16 the fleet-health section).
+# meta-algorithm zoo section, v16 the fleet-health section, v17 the
+# traffic-lab section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
     "compile_seconds", "feed_stall_frac", "peak_memory_bytes",
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
     "watchdog", "health", "checkpoint", "cluster", "warm_start",
-    "elastic", "fleet", "fleet_health", "perf", "tune", "requests",
-    "algo",
+    "elastic", "fleet", "fleet_health", "traffic", "perf", "tune",
+    "requests", "algo",
 }
 
 
@@ -682,6 +683,60 @@ def test_summarize_events_fleet_health_section():
 def test_fleet_health_section_unavailable_without_subsystem():
     s = summarize_events([{"event": "train_epoch", "epoch": 0}])
     assert s["fleet_health"] == UNAVAILABLE
+
+
+def test_summarize_events_traffic_section():
+    """v17: continuous-batching dispatch counters (replica flushes) and
+    weighted-canary split counters (router+controller driver flushes)
+    accumulate reset-aware per source; the canary weight — the rollout
+    ladder's current stage — is a gauge (last signal wins)."""
+    events = [
+        # Replica 0's engine flush mirrors its assembler counters.
+        {"event": "metrics", "replica": 0,
+         "metrics": {"serve/cb_groups": 10.0,
+                     "serve/cb_fill_dispatch": 6.0,
+                     "serve/cb_linger_dispatch": 4.0}},
+        # Replica 1 flushes smaller values: a second SOURCE, not a
+        # counter reset — totals must add.
+        {"event": "metrics", "replica": 1,
+         "metrics": {"serve/cb_groups": 3.0,
+                     "serve/cb_fill_dispatch": 1.0,
+                     "serve/cb_linger_dispatch": 2.0}},
+        # The driver's flush: split counters + the stage-weight gauge.
+        {"event": "metrics",
+         "metrics": {"fleet/canary_requests": 25.0,
+                     "fleet/cohort_fallbacks": 1.0,
+                     "fleet/canary_stage_promotions": 1.0,
+                     "fleet/canary_weight": 0.01}},
+        # Replica 0 restarted: counters reset below their own previous
+        # values — the new segment contributes whole.
+        {"event": "metrics", "replica": 0,
+         "metrics": {"serve/cb_groups": 2.0,
+                     "serve/cb_fill_dispatch": 1.0,
+                     "serve/cb_linger_dispatch": 1.0}},
+        # Later driver flush: promoted to the 10% stage.
+        {"event": "metrics",
+         "metrics": {"fleet/canary_requests": 60.0,
+                     "fleet/cohort_fallbacks": 1.0,
+                     "fleet/canary_stage_promotions": 2.0,
+                     "fleet/canary_weight": 0.10}},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    tr = s["traffic"]
+    assert tr["cb_groups"] == 15            # r0: 10 + 2 (restart); r1: 3
+    assert tr["cb_fill_dispatches"] == 8
+    assert tr["cb_linger_dispatches"] == 7
+    assert tr["canary_requests"] == 60
+    assert tr["cohort_fallbacks"] == 1
+    assert tr["stage_promotions"] == 2
+    assert tr["canary_weight"] == 0.10      # gauge: last signal wins
+    assert "traffic" in format_table(s)
+
+
+def test_traffic_section_unavailable_without_subsystem():
+    s = summarize_events([{"event": "train_epoch", "epoch": 0}])
+    assert s["traffic"] == UNAVAILABLE
 
 
 def test_tune_section_reset_aware_across_sweep_segments():

@@ -1,0 +1,540 @@
+"""Traffic-lab tests (ISSUE 19): trace format + generators, open-loop
+replay, continuous batching (GroupAssembler wiring), and the
+weighted-canary traffic split.
+
+Tier-1 keeps to pure/host-side units — the trace modules are loaded by
+FILE PATH (they are jax-free by contract, proven by the booby-trap
+subprocess test below), the batcher units run in-process, and the
+weighted-rollout machine is driven against a fake membership snapshot
+(the test_fleet.py idiom). The full three-leg replay proof lives in
+scripts/traffic_replay.py, not here.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.serve.batcher import (
+    FewShotRequest, GroupAssembler, QueueFullError, RequestBatcher,
+    pad_group)
+from howtotrainyourmamlpytorch_tpu.serve.fleet import (
+    FleetController, FleetRouter, ReplicaLease, assign_canary,
+    canary_fraction)
+from howtotrainyourmamlpytorch_tpu.serve.fleet import controller as fc
+from howtotrainyourmamlpytorch_tpu.serve.fleet import router as fr
+from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOADLAB = os.path.join(REPO, "howtotrainyourmamlpytorch_tpu", "serve",
+                       "loadlab")
+
+
+def _load(name, filename):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(LOADLAB, filename))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace = _load("_tl_trace", "trace.py")
+workloads = _load("_tl_workloads", "workloads.py")
+replay = _load("_tl_replay", "replay.py")
+
+
+# ---------------------------------------------------------------------------
+# trace format
+# ---------------------------------------------------------------------------
+
+def _records(n=20):
+    return [trace.trace_record(i * 0.5, i % 3, (4, 3),
+                               deadline_ms=250.0 if i % 2 else None,
+                               seed=i)
+            for i in range(n)]
+
+
+def test_trace_roundtrip_and_meta(tmp_path):
+    path = str(tmp_path / "t.trace")
+    recs = _records()
+    n = trace.write_trace(path, recs, meta={"workload": "diurnal",
+                                            "peak_rate": 12.5})
+    assert n == os.path.getsize(path)
+    header, out = trace.read_trace(path)
+    assert out == recs
+    assert header["records"] == len(recs)
+    assert header["workload"] == "diurnal"
+    assert header["peak_rate"] == 12.5
+
+
+def test_trace_refuses_every_kind_of_damage(tmp_path):
+    """The framing contract: a trace either replays exactly or refuses
+    to replay at all — no silently-shortened replay flattering every
+    latency number downstream."""
+    path = str(tmp_path / "t.trace")
+    trace.write_trace(path, _records())
+    blob = open(path, "rb").read()
+    # Bit flip in the payload -> CRC.
+    flipped = bytearray(blob)
+    flipped[-10] ^= 0x40
+    with pytest.raises(ValueError, match="CRC"):
+        trace.decode_trace(bytes(flipped))
+    # Truncation -> framed length.
+    with pytest.raises(ValueError, match="length"):
+        trace.decode_trace(blob[:-7])
+    # Foreign file -> magic.
+    with pytest.raises(ValueError, match="magic"):
+        trace.decode_trace(b"NOTATRACE" + blob)
+    # Header/record-count mismatch survives reframing -> loud.
+    head, recs = trace.decode_trace(blob)
+    doctored = trace.encode_trace(recs[:-1])
+    import json as _json
+    lines = doctored[trace._HEAD.size + len(trace.TRACE_MAGIC):].decode(
+        ).splitlines()
+    hdr = _json.loads(lines[0])
+    hdr["records"] = len(recs)  # lie
+    payload = ("\n".join([_json.dumps(hdr, sort_keys=True)] + lines[1:])
+               + "\n").encode()
+    import zlib as _zlib
+    reframed = (trace.TRACE_MAGIC
+                + trace._HEAD.pack(len(payload),
+                                   _zlib.crc32(payload) & 0xFFFFFFFF)
+                + payload)
+    with pytest.raises(ValueError, match="header says"):
+        trace.decode_trace(reframed)
+
+
+def test_trace_encode_rejects_unsorted_and_negative():
+    recs = [trace.trace_record(1.0, 0, (4, 3)),
+            trace.trace_record(0.5, 0, (4, 3))]
+    with pytest.raises(ValueError, match="sorted"):
+        trace.encode_trace(recs)
+    with pytest.raises(ValueError, match=">= 0"):
+        trace.trace_record(-0.1, 0, (4, 3))
+
+
+def test_gen_diurnal_trace_is_deterministic_and_shaped():
+    kw = dict(duration_s=60.0, base_rate=2.0, peak_rate=20.0,
+              num_tenants=24, buckets=[(4, 3), (8, 6)],
+              active_tenants=6, churn_every_s=5.0, seed=7)
+    a = workloads.gen_diurnal_trace(**kw)
+    assert a == workloads.gen_diurnal_trace(**kw)  # same seed, same trace
+    assert a and all(a[i]["t"] <= a[i + 1]["t"] for i in range(len(a) - 1))
+    # The diurnal shape: the middle third (around peak) offers several
+    # times the rate of the edges (base:peak is 1:10).
+    third = 60.0 / 3.0
+    edge = sum(1 for r in a if r["t"] < third or r["t"] >= 2 * third)
+    mid = sum(1 for r in a if third <= r["t"] < 2 * third)
+    assert mid > edge
+    # Every record's bucket matches the shared tenant->bucket rule, so
+    # generators and tenant_pool agree by construction.
+    for r in a:
+        assert r["bucket"] == list(
+            workloads.tenant_bucket(r["tenant"], kw["buckets"]))
+
+
+def test_overlay_burst_merges_sorted_and_adds_rate():
+    base = workloads.gen_diurnal_trace(
+        duration_s=30.0, base_rate=5.0, peak_rate=5.0, num_tenants=8,
+        buckets=[(4, 3)], seed=3)
+    merged = workloads.overlay_burst(
+        base, at_s=10.0, duration_s=5.0, rate=40.0, num_tenants=8,
+        buckets=[(4, 3)], seed=3)
+    assert all(merged[i]["t"] <= merged[i + 1]["t"]
+               for i in range(len(merged) - 1))
+    added = len(merged) - len(base)
+    assert 100 < added < 300  # ~40/s for 5s
+    assert all(10.0 <= r["t"] < 15.0
+               for r in merged if r not in base)
+
+
+# ---------------------------------------------------------------------------
+# open-loop replay
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    """Deterministic injectable clock: sleep() advances it exactly."""
+
+    def __init__(self):
+        self.t = 100.0
+
+    def now(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+def test_replay_fires_on_the_trace_clock_not_the_response_clock():
+    clk = _Clock()
+    recs = [trace.trace_record(t, 0, (4, 3)) for t in (0.0, 1.0, 1.0, 4.0)]
+    fired = []
+
+    def submit(i, rec, sched):
+        # An open-loop replayer never waits on this "response": make
+        # each submit artificially slow and check later arrivals are
+        # still scheduled off the TRACE clock, not pushed back.
+        fired.append((i, sched))
+        clk.t += 0.3
+
+    log = replay.replay(recs, submit, warp=2.0, now=clk.now,
+                        sleep=clk.sleep)
+    start = log["start"]
+    assert [s - start for _, s in fired] == [0.0, 0.5, 0.5, 2.0]
+    assert log["scheduled"] == [s for _, s in fired]
+    # Record 2 fires 0.3s behind schedule (record 1's slow submit ate
+    # its slot) and its own submit adds 0.3s more; the lag is REPORTED
+    # — the replayer measures its own under-offering.
+    assert log["lag_ms"][2] == pytest.approx(600.0, abs=1.0)
+    assert log["max_lag_ms"] == pytest.approx(600.0, abs=1.0)
+
+
+def test_replay_pumps_housekeeping_only_while_waiting():
+    clk = _Clock()
+    recs = [trace.trace_record(t, 0, (4, 3)) for t in (0.0, 0.5)]
+    pumped = []
+    log = replay.replay(recs, lambda *a: None, pump=pumped.append,
+                        now=clk.now, sleep=clk.sleep)
+    assert pumped  # ran during the 0.5s gap
+    assert all(log["start"] <= t <= log["start"] + 0.5 for t in pumped)
+    with pytest.raises(ValueError, match="warp"):
+        replay.replay(recs, lambda *a: None, warp=0.0)
+
+
+def test_phase_stats_attributes_by_arrival_and_keeps_empty_phases():
+    recs = [trace.trace_record(t, 0, (4, 3))
+            for t in (0.1, 0.2, 5.0, 11.0)]
+    phases = [{"name": "trough", "until_s": 1.0},
+              {"name": "peak", "until_s": 10.0},
+              {"name": "fall", "until_s": 12.0},
+              {"name": "never", "until_s": 12.0}]
+    lat = {0: 10.0, 1: 20.0, 3: 40.0}  # record 2 never completed
+    out = replay.phase_stats(recs, phases, lat,
+                             lambda v, q: v[round(q * (len(v) - 1))])
+    assert out["trough"] == {"offered": 2, "completed": 2,
+                             "p50_ms": 10.0, "p95_ms": 20.0}
+    assert out["peak"]["offered"] == 1 and out["peak"]["completed"] == 0
+    assert out["peak"]["p95_ms"] is None
+    assert out["fall"]["completed"] == 1
+    assert out["never"] == {"offered": 0, "completed": 0, "p50_ms": None,
+                            "p95_ms": None}
+    # Past-the-end arrivals belong to the LAST phase, not nowhere.
+    assert replay.phase_of(phases, 99.0) == "never"
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: GroupAssembler + batcher wiring
+# ---------------------------------------------------------------------------
+
+def _req(s=2, q=2, deadline=None, tenant=None):
+    return FewShotRequest(
+        support_x=np.zeros((s, 4, 4, 1), np.uint8),
+        support_y=(np.arange(s) % 3).astype(np.int32),
+        query_x=np.zeros((q, 4, 4, 1), np.uint8),
+        deadline=deadline, tenant=tenant)
+
+
+def test_assembler_fill_dispatch_fires_without_lingering():
+    asm = GroupAssembler(batch_tasks=3, linger_ms=10_000.0)
+    now = 50.0
+    for _ in range(3):
+        r = _req()
+        r.enqueue_time = now
+        asm.admit(r, (4, 4))
+    bucket, group = asm.pop_ready(now, max_tasks=3)
+    assert bucket == (4, 4) and len(group) == 3
+    assert asm.fill_dispatches == 1 and asm.linger_dispatches == 0
+    assert asm.pending == 0 and asm.pop_ready(now, 3) is None
+
+
+def test_assembler_linger_dispatch_charges_at_most_the_budget():
+    asm = GroupAssembler(batch_tasks=4, linger_ms=50.0)
+    r = _req()
+    r.enqueue_time = 10.0
+    asm.admit(r, (4, 4))
+    # Within the linger budget: hold for company.
+    assert asm.pop_ready(10.049, max_tasks=4) is None
+    assert asm.pending == 1
+    # Past it: the lone request dispatches rather than keep paying.
+    bucket, group = asm.pop_ready(10.051, max_tasks=4)
+    assert len(group) == 1
+    assert asm.linger_dispatches == 1 and asm.fill_dispatches == 0
+
+
+def test_assembler_dispatches_oldest_group_first_and_keeps_fifo():
+    asm = GroupAssembler(batch_tasks=2, linger_ms=0.0)  # always ready
+    first, second = _req(), _req()
+    first.enqueue_time, second.enqueue_time = 1.0, 2.0
+    asm.admit(first, (8, 8))
+    asm.admit(second, (4, 4))
+    bucket, group = asm.pop_ready(3.0, max_tasks=2)
+    assert bucket == (8, 8) and group == [first]  # oldest admit wins
+    a, b = _req(), _req()
+    a.enqueue_time = b.enqueue_time = 4.0
+    asm.admit(a, (4, 4))
+    asm.admit(b, (4, 4))
+    _, group = asm.pop_ready(5.0, max_tasks=2)
+    assert group == [second, a]  # same-bucket order is strict FIFO
+
+
+def test_assembler_sweeps_expired_from_forming_groups():
+    asm = GroupAssembler(batch_tasks=4, linger_ms=1000.0)
+    live, dead = _req(deadline=100.0), _req(deadline=1.0)
+    live.enqueue_time = dead.enqueue_time = 0.5
+    asm.admit(live, (4, 4))
+    asm.admit(dead, (4, 4))
+    assert asm.sweep_expired(2.0) == [dead]
+    assert asm.pending == 1
+    # An emptied bucket drops entirely so its linger clock dies.
+    only = _req(deadline=1.0)
+    only.enqueue_time = 0.5
+    asm2 = GroupAssembler(batch_tasks=4, linger_ms=1000.0)
+    asm2.admit(only, (4, 4))
+    asm2.sweep_expired(2.0)
+    assert asm2._groups == {}
+
+
+def _batcher(cb=True, depth=16, linger_ms=1000.0):
+    b = RequestBatcher(buckets=[(4, 4), (8, 8)], max_queue_depth=depth)
+    if cb:
+        b.assembler = GroupAssembler(4, linger_ms)
+    return b
+
+
+def test_batcher_default_off_is_structurally_unchanged():
+    """The zero-cost pin: serve_continuous_batching off leaves
+    ``assembler`` None and dispatch IS the head-of-line queue path."""
+    b = _batcher(cb=False)
+    assert b.assembler is None
+    b.submit(_req())
+    bucket, group, expired = b.next_group(4)
+    assert len(group) == 1 and expired == [] and b.depth == 0
+
+
+def test_batcher_cb_holds_partial_groups_then_dispatches():
+    b = _batcher(linger_ms=1000.0)
+    t0 = time.monotonic()
+    b.submit(_req(), now=t0)
+    b.submit(_req(), now=t0)
+    assert b.depth == 2  # forming members count as queued
+    bucket, group, expired = b.next_group(4, now=t0 + 0.1)
+    assert group == [] and b.depth == 2  # still lingering for company
+    bucket, group, _ = b.next_group(4, now=t0 + 1.1)
+    assert len(group) == 2 and bucket == (4, 4)
+    assert b.depth == 0
+
+
+def test_batcher_cb_forming_groups_count_against_backpressure():
+    b = _batcher(depth=2)
+    b.submit(_req())
+    b.submit(_req())
+    with pytest.raises(QueueFullError):
+        b.submit(_req())
+
+
+def test_pad_group_replicates_task0_for_missing_tasks():
+    """Padding exactness under partial groups: a 2-of-4 dispatch pads
+    the missing tasks by REPLICATING task 0 (an all-zero weight row
+    would divide by zero in the weighted adapt loss); real rows carry
+    weight 1 on real support only."""
+    a, b = _req(s=2, q=1), _req(s=3, q=2)
+    out = pad_group([a, b], bucket=(4, 4), batch_tasks=4,
+                    image_shape=(4, 4, 1))
+    assert out["support_x"].shape == (4, 4, 4, 4, 1)
+    assert out["occupancy"] == 0.5
+    np.testing.assert_array_equal(out["support_w"][0], [1, 1, 0, 0])
+    np.testing.assert_array_equal(out["support_w"][1], [1, 1, 1, 0])
+    for pad in (2, 3):
+        np.testing.assert_array_equal(out["support_x"][pad],
+                                      out["support_x"][0])
+        np.testing.assert_array_equal(out["support_w"][pad],
+                                      out["support_w"][0])
+
+
+# ---------------------------------------------------------------------------
+# weighted canary split
+# ---------------------------------------------------------------------------
+
+def test_canary_assignment_is_deterministic_and_rate_monotone():
+    ids = [(t, s) for t in range(8) for s in range(200)]
+    f = [canary_fraction(t, s) for t, s in ids]
+    assert f == [canary_fraction(t, s) for t, s in ids]
+    assert 0.4 < sum(f) / len(f) < 0.6  # roughly uniform on [0, 1)
+    # Growing the weight only ADDS requests to the canary cohort: every
+    # stage's cohort is a strict superset of the previous stage's (the
+    # property the stage-over-stage SLO comparison rests on).
+    cohorts = {w: {i for i in ids if assign_canary(i[0], i[1], w)}
+               for w in (0.0, 0.1, 0.25, 1.0)}
+    assert cohorts[0.0] == set()
+    assert cohorts[1.0] == set(ids)
+    assert cohorts[0.1] < cohorts[0.25] < cohorts[1.0]
+    assert len(cohorts[0.25]) / len(ids) == pytest.approx(0.25, abs=0.06)
+
+
+def _announce(fleet_dir, rid):
+    lease = ReplicaLease(str(fleet_dir), rid, interval_s=0.0)
+    lease.touch({"version": 1, "pid": 1000 + rid})
+    return lease
+
+
+def test_router_route_among_restricts_to_cohort_with_loud_fallback(
+        tmp_path):
+    reg = MetricsRegistry()
+    for rid in (0, 1, 2):
+        _announce(tmp_path, rid)
+    router = FleetRouter(str(tmp_path), registry=reg)
+    router.refresh()
+    keys = [f"key-{i}" for i in range(40)]
+    for k in keys:
+        rid = router.route(k, among=[1])
+        assert rid == 1
+        router.complete(rid)
+    assert reg.counter(fr.COHORT_FALLBACK_COUNTER).value == 0
+    # Empty intersection: serving on the wrong cohort beats dropping
+    # the request — but the fallback is COUNTED, never silent.
+    rid = router.route(keys[0], among=[99])
+    assert rid in (0, 1, 2)
+    router.complete(rid)
+    assert reg.counter(fr.COHORT_FALLBACK_COUNTER).value == 1
+
+
+# ---------------------------------------------------------------------------
+# weighted rollout state machine (fake membership, the test_fleet idiom)
+# ---------------------------------------------------------------------------
+
+class _FakeFleet:
+    def __init__(self, rids):
+        self.members = {r: {"state": "live", "age": 0.0,
+                            "draining": False,
+                            "payload": {"version": 1, "stats": {}}}
+                        for r in rids}
+
+    def __call__(self):
+        return {r: dict(rec) for r, rec in self.members.items()}
+
+
+def _feed(ctl, cohort, n, latency_ms):
+    for i in range(n):
+        ctl.observe_cohort(cohort, f"t{i}", latency_ms)
+
+
+def test_weighted_rollout_bakes_stage_by_stage_to_done(tmp_path):
+    reg = MetricsRegistry()
+    fleet = _FakeFleet([0, 1])
+    ctl = FleetController(str(tmp_path), fleet, registry=reg,
+                          slo_p95_ms=100.0, canary_min_requests=5,
+                          canary_burn_factor=2.0)
+    doc = ctl.start_rollout(2, weights=[0.25, 1.0])
+    assert doc["mode"] == "weighted" and doc["phase"] == "swap"
+    # No weighted bake in flight yet -> split off.
+    assert ctl.traffic_split() == {"weight": None, "canary": [],
+                                   "stage": None}
+    # Replica 0 acks the swap: it becomes the canary cohort and the
+    # rollout holds at weight 0.25 instead of draining replica 1.
+    fleet.members[0]["payload"] = {"version": 2}
+    doc = ctl.tick()
+    assert doc["phase"] == "bake" and doc["canary"] == [0]
+    assert ctl.traffic_split() == {"weight": 0.25, "canary": [0],
+                                   "stage": 0}
+    # Too little evidence: the stage holds.
+    _feed(ctl, "canary", 3, 10.0)
+    assert ctl.tick()["phase"] == "bake"
+    # Enough healthy canary evidence vs stable -> promote. The ladder
+    # hits 1.0, so the machine returns to swap for the rest of the
+    # fleet and the split opens up (weight None, cohort kept).
+    _feed(ctl, "canary", 2, 10.0)
+    _feed(ctl, "stable", 8, 10.0)
+    doc = ctl.tick()
+    assert doc["stage"] == 1 and doc["phase"] == "swap"
+    assert doc["stage_history"][0]["stage"] == 0
+    assert doc["stage_history"][0]["canary"]["count"] == 5
+    split = ctl.traffic_split()
+    assert split["weight"] is None and split["canary"] == [0]
+    assert os.path.exists(ctl._drain_path(1))
+    fleet.members[1]["payload"] = {"version": 2}
+    doc = ctl.tick()
+    assert doc["state"] == fc.DONE and doc["canary"] == [0, 1]
+    assert reg.counter(fc.CANARY_STAGE_COUNTER).value == 1
+    assert reg.counter(fc.SWAPS_COUNTER).value == 1
+    assert ctl.traffic_split()["weight"] is None
+
+
+def test_weighted_rollout_halts_and_pins_on_canary_regression(tmp_path):
+    reg = MetricsRegistry()
+    fleet = _FakeFleet([0, 1])
+    ctl = FleetController(str(tmp_path), fleet, registry=reg,
+                          slo_p95_ms=100.0, canary_min_requests=5,
+                          canary_burn_factor=2.0)
+    ctl.start_rollout(2, weights=[0.25, 1.0])
+    fleet.members[0]["payload"] = {"version": 2}
+    ctl.tick()
+    # The canary cohort blows its SLO while stable is healthy.
+    _feed(ctl, "canary", 6, 500.0)
+    _feed(ctl, "stable", 6, 10.0)
+    doc = ctl.tick()
+    assert doc["state"] == fc.HALTED
+    assert doc["halt_reason"] == "canary slo regression"
+    assert doc["halt_stage"] == 0 and 2 in doc["rejected"]
+    assert reg.counter(fc.HALTS_COUNTER).value == 1
+    # Split is off after the halt; the version is pinned fleet-wide.
+    assert ctl.traffic_split()["weight"] is None
+    assert ctl.start_rollout(2)["state"] == fc.HALTED
+
+
+def test_weighted_rollout_fresh_cohort_ledgers_per_stage(tmp_path):
+    """Each stage's verdict rests on its OWN evidence: observations a
+    lighter weight already judged must not leak into the next stage."""
+    fleet = _FakeFleet([0, 1, 2])
+    ctl = FleetController(str(tmp_path), fleet, slo_p95_ms=100.0,
+                          canary_min_requests=4, canary_burn_factor=2.0)
+    ctl.start_rollout(2, weights=[0.1, 0.5, 1.0])
+    fleet.members[0]["payload"] = {"version": 2}
+    ctl.tick()
+    _feed(ctl, "canary", 4, 10.0)
+    doc = ctl.tick()
+    assert doc["stage"] == 1 and doc["phase"] == "bake"
+    assert ctl.traffic_split()["weight"] == 0.5
+    # The promoted stage starts from zero observations.
+    assert ctl._cohorts["canary"].count() == 0
+    assert ctl.tick()["stage"] == 1  # holds without fresh evidence
+
+
+# ---------------------------------------------------------------------------
+# jax-free contract
+# ---------------------------------------------------------------------------
+
+def test_loadlab_modules_load_jax_free(tmp_path):
+    """PYTHONPATH booby trap (the reqtrace idiom): the trace, workload
+    and replay modules are file-path-loadable by jax-free driver
+    processes; any jax import explodes."""
+    trap = tmp_path / "trap"
+    trap.mkdir()
+    (trap / "jax.py").write_text(
+        "raise ImportError('loadlab must not import jax')\n")
+    prog = (
+        "import importlib.util, os\n"
+        f"base = {LOADLAB!r}\n"
+        "mods = {}\n"
+        "for name in ('trace', 'workloads', 'replay'):\n"
+        "    spec = importlib.util.spec_from_file_location(\n"
+        "        name, os.path.join(base, name + '.py'))\n"
+        "    mods[name] = importlib.util.module_from_spec(spec)\n"
+        "    spec.loader.exec_module(mods[name])\n"
+        "recs = mods['workloads'].gen_diurnal_trace(\n"
+        "    duration_s=5.0, base_rate=2.0, peak_rate=8.0,\n"
+        "    num_tenants=4, buckets=[(4, 3)], seed=1)\n"
+        "blob = mods['trace'].encode_trace(recs)\n"
+        "_, out = mods['trace'].decode_trace(blob)\n"
+        "assert out == recs\n"
+        "log = mods['replay'].replay(out[:3], lambda *a: None, warp=1e9)\n"
+        "assert len(log['scheduled']) == 3\n"
+        "print('OK')\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", prog], capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=str(trap)), timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "OK" in proc.stdout
