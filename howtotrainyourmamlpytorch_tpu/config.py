@@ -561,6 +561,25 @@ class MAMLConfig:
                                            # warning is observability and
                                            # keeps firing with rewinds
                                            # disabled
+    alert_rules_path: str = ""             # declarative alert rules file
+                                           # (telemetry/alerts.py; the
+                                           # shipped baseline is
+                                           # configs/alerts_default.json).
+                                           # "" = off, the default:
+                                           # NOTHING is installed — no
+                                           # evaluator object exists and
+                                           # training/serving is bitwise
+                                           # identical (the health/
+                                           # profiler zero-cost
+                                           # discipline). Set: the
+                                           # experiment loop, the
+                                           # ServingEngine and the fleet
+                                           # supervisor evaluate the
+                                           # rules at their existing
+                                           # flush points, emit 'alert'
+                                           # rows, keep ALERTS.json
+                                           # current and publish the
+                                           # maml_alert_firing series
 
     # ---- resilience (resilience/ subsystem, docs/RESILIENCE.md) --------
     divergence_patience: int = 2           # consecutive bad outer-loss

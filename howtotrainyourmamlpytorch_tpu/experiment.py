@@ -56,6 +56,7 @@ from howtotrainyourmamlpytorch_tpu.resilience.flightrec import (
     write_crash_bundle)
 from howtotrainyourmamlpytorch_tpu.telemetry import (
     FeedStallMeter, MetricsRegistry, device_memory_stats, emit_heartbeat)
+from howtotrainyourmamlpytorch_tpu.telemetry import alerts as alerts_mod
 from howtotrainyourmamlpytorch_tpu.telemetry import health as health_mod
 from howtotrainyourmamlpytorch_tpu.telemetry import profiler as profiler_mod
 from howtotrainyourmamlpytorch_tpu.telemetry import trace as trace_mod
@@ -176,8 +177,32 @@ class ExperimentBuilder:
             queue_policy=cfg.ckpt_queue_policy,
             publish=cfg.ckpt_publish and self.is_main_process)
 
+        # Size-capped rotation (utils/tracing.py): a long self-healing
+        # run's exhaust must not grow without bound — at 64 MiB the live
+        # file atomically becomes events.jsonl.1 (one spare; every
+        # jax-free reader reads the spare first). Generous enough that
+        # tests and normal runs never rotate.
         self.jsonl = JsonlLogger(f"{self.paths['logs']}/events.jsonl",
-                                 enabled=self.is_main_process)
+                                 enabled=self.is_main_process,
+                                 max_bytes=64 * 1024 * 1024)
+        # Alert rules engine (telemetry/alerts.py): installed iff
+        # alert_rules_path is set — the structural zero-cost pin is this
+        # staying None (the _perf/_watchdog discipline: one None check
+        # per flush point, nothing registered, bitwise-identical math).
+        # Rules are config (identical on all hosts), so every process
+        # evaluates the same rule set; only process 0 owns the on-disk
+        # ALERTS.json snapshot (single-writer, like events.jsonl).
+        self._alerts: Optional[alerts_mod.AlertEvaluator] = None
+        self._last_heartbeat_ts: Optional[float] = None
+        if cfg.alert_rules_path:
+            self._alerts = alerts_mod.AlertEvaluator(
+                alerts_mod.load_rules(cfg.alert_rules_path),
+                source="train",
+                snapshot_path=(f"{self.paths['logs']}/ALERTS.json"
+                               if self.is_main_process else None))
+            # Eager registration: a scrape between install and the first
+            # evaluation must read 0 firing, not a missing series.
+            self.registry.gauge(alerts_mod.FIRING_GAUGE).set(0.0)
         # The compile watcher (None until run) is installed at
         # run_experiment entry and removed in its finally, so a builder
         # that is constructed but never run (sweep drivers, failed
@@ -753,6 +778,7 @@ class ExperimentBuilder:
             # last epoch flush (a rewind in the killed window, IO
             # retries) must not die with the process — the report reads
             # them from this row.
+            self._evaluate_alerts()
             self.registry.flush_jsonl(self.jsonl, phase="preempt")
             if self.is_main_process:
                 self.registry.write_prometheus(
@@ -893,6 +919,10 @@ class ExperimentBuilder:
             lease_ages = {str(h): (round(a, 3) if np.isfinite(a)
                                    else None)
                           for h, a in sorted(ages.items())}
+        # Alert summary rides the heartbeat row so fleet readers (ops
+        # console, collectors) see firing state without a second file.
+        # Evaluator presence is config-determined — every host passes
+        # the same kwargs, keeping the underlying gathers collective-safe.
         emit_heartbeat(self.jsonl, epoch=epoch,
                        iteration=self.current_iter,
                        local_mean_step_seconds=tsum.get(
@@ -901,7 +931,32 @@ class ExperimentBuilder:
                        progress_phase=(beacon.current()[0]
                                        if beacon is not None else None),
                        **({"peer_lease_age_seconds": lease_ages}
-                          if lease_ages is not None else {}))
+                          if lease_ages is not None else {}),
+                       **({"alerts_firing":
+                           self._alerts.firing_summary()}
+                          if self._alerts is not None else {}))
+        self._last_heartbeat_ts = time.time()
+
+    def _evaluate_alerts(self, **extra_ages: float) -> None:
+        """One alert-rule pass over the live registry snapshot (no-op
+        when ``alert_rules_path`` is unset). Called at the existing
+        registry flush points only — alerting adds no new sync points.
+        The ``heartbeat`` absence signal is the age of this process's
+        own last heartbeat row; before the first heartbeat the signal is
+        simply absent (absence rules judge only present signals), so a
+        fresh run cannot false-fire during warmup.
+        """
+        if self._alerts is None:
+            return
+        now = time.time()
+        ages: Dict[str, float] = dict(extra_ages)
+        if self._last_heartbeat_ts is not None:
+            ages["heartbeat"] = now - self._last_heartbeat_ts
+        self._alerts.evaluate(now=now,
+                              snapshot=self.registry.snapshot(),
+                              ages=ages,
+                              jsonl=self.jsonl,
+                              registry=self.registry)
 
     def _eval_batches(self, split: str) -> Iterable:
         """The split's fixed evaluation batches, device-cached after the
@@ -1591,6 +1646,9 @@ class ExperimentBuilder:
         self.registry.gauge("val/loss").set(val_stats["loss"])
         self.registry.gauge("val/accuracy").set(val_stats["accuracy"])
         self.registry.gauge("progress/epoch").set(epoch)
+        # Alert pass rides the existing epoch flush: transitions land as
+        # ``alert`` rows just before the metrics row that triggered them.
+        self._evaluate_alerts()
         self.registry.flush_jsonl(self.jsonl, epoch=epoch)
         if self.is_main_process:
             # Prometheus textfile snapshot (node-exporter sidecar
@@ -1757,6 +1815,7 @@ class ExperimentBuilder:
             result["test_accuracy_mean"])
         self.registry.gauge("test/accuracy_std").set(
             result["test_accuracy_std"])
+        self._evaluate_alerts()
         self.registry.flush_jsonl(self.jsonl, phase="test_protocol")
         if self.is_main_process:
             self.registry.write_prometheus(

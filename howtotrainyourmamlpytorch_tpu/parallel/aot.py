@@ -136,6 +136,10 @@ _RUNTIME_ONLY_KEYS = frozenset({
     "watchdog_compile_timeout_s", "watchdog_serve_timeout_s",
     "watchdog_ckpt_timeout_s", "watchdog_poll_interval_s",
     "flight_recorder_events", "require_mesh",
+    # Alerting is pure observability POLICY: rule evaluation watches
+    # metrics the run already publishes and can never change a compiled
+    # program — an alerting run must hit a store prewarmed without it.
+    "alert_rules_path",
     "cluster_collective_timeout_s", "cluster_lease_interval_s",
     "cluster_peer_stalled_s", "cluster_peer_dead_s",
     # Elastic-pod POLICY knobs change no compiled program (and the

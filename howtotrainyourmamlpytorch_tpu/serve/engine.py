@@ -75,6 +75,7 @@ from howtotrainyourmamlpytorch_tpu.serve.cache import (
 from howtotrainyourmamlpytorch_tpu.serve.fleet.l2cache import (
     L2AdaptedParamsCache)
 from howtotrainyourmamlpytorch_tpu.telemetry import MetricsRegistry
+from howtotrainyourmamlpytorch_tpu.telemetry import alerts
 from howtotrainyourmamlpytorch_tpu.telemetry import reqtrace
 from howtotrainyourmamlpytorch_tpu.utils.backend import instrument_compiles
 from howtotrainyourmamlpytorch_tpu.utils.checkpoint import (
@@ -302,6 +303,18 @@ class ServingEngine:
             self._reqtrace_ring = reqtrace.SpanRing(
                 registry=self.registry)
             self._prev_reqtrace = reqtrace.install(self._reqtrace_ring)
+        # Alerting (telemetry/alerts.py): an evaluator exists ONLY when
+        # alert_rules_path names a rules file — unset (the default)
+        # installs nothing (`_alerts is None` is the structural
+        # zero-cost pin) and rules are evaluated at flush_metrics, the
+        # engine's existing flush point; no new clocks.
+        self._alerts: Optional[alerts.AlertEvaluator] = None
+        if cfg.alert_rules_path:
+            self._alerts = alerts.AlertEvaluator(
+                alerts.load_rules(cfg.alert_rules_path), source="serve")
+            # Eager gauge registration (the shed-counter rule): an
+            # alerting engine's flush shows 0 firing, not an absent key.
+            self.registry.gauge(alerts.FIRING_GAUGE).set(0.0)
         self._watchdog: Optional[watchdog.Watchdog] = None
         self._prev_beacon = None
         self._prev_recorder = None
@@ -1074,6 +1087,14 @@ class ServingEngine:
             reg.counter("serve/cb_linger_dispatch").inc(ld - pld)
             self._cb_mirrored = (g, fd, ld)
 
+    def alerts_firing_summary(self) -> Optional[Dict[str, Any]]:
+        """``{"count", "max_severity"}`` of this process's firing
+        alerts, or None when alerting is off — replica lease payloads
+        carry it so a peer's alert state is visible fleet-wide before
+        its process dies."""
+        return (None if self._alerts is None
+                else self._alerts.firing_summary())
+
     def flush_metrics(self, jsonl: JsonlLogger,
                       **extra: Any) -> Dict[str, Any]:
         """One ``metrics`` row carrying the full serve/* snapshot —
@@ -1086,6 +1107,13 @@ class ServingEngine:
         self.registry.gauge("serve/queue_depth").set(self.batcher.depth)
         if self._reqtrace_ring is not None:
             self._reqtrace_ring.flush(jsonl, **extra)
+        if self._alerts is not None:
+            # After the gauges above are current, before the snapshot
+            # row is written — the flushed row carries the updated
+            # maml_alert_firing value, and transitions land in the same
+            # stream the report/console read.
+            self._alerts.evaluate(snapshot=self.registry.snapshot(),
+                                  jsonl=jsonl, registry=self.registry)
         # Stamp the algorithm onto the row so the report can attribute
         # serve/adapt_seconds per variant (telemetry "algo" section).
         extra.setdefault("meta_algorithm", self.cfg.meta_algorithm)

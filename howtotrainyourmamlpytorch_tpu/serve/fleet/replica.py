@@ -251,6 +251,12 @@ class ReplicaServer:
                               if getattr(eng.batcher, "assembler", None)
                               is not None else 0),
             },
+            # Ops-plane visibility: the peer's own firing alerts
+            # (count + max severity) ride the lease so the supervisor
+            # and console see a replica's alert state even after the
+            # process dies. Null when alerting is off — honest, and
+            # schema-stable for every lease reader.
+            "alerts_firing": eng.alerts_firing_summary(),
         }
 
     def _touch_lease(self, force: bool = False) -> None:
@@ -429,7 +435,10 @@ def main(argv=None) -> int:
     finally:
         server.close()
         if args.events:
-            engine.flush_metrics(JsonlLogger(args.events),
+            # One shared events file accumulates across supervisor
+            # respawns of this slot — capped like the trainer's.
+            engine.flush_metrics(JsonlLogger(args.events,
+                                             max_bytes=64 * 1024 * 1024),
                                  phase="fleet_replica",
                                  replica=args.replica_id)
         engine.close()

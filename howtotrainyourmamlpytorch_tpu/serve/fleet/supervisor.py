@@ -52,6 +52,7 @@ sockets or real processes (tests/test_fleet_supervisor.py).
 from __future__ import annotations
 
 import json
+import math
 import os
 import random
 import time
@@ -124,6 +125,27 @@ def router_mod() -> Any:
     return _router_cached
 
 
+class _EventAppender:
+    """Minimal JsonlLogger-shaped sink over the supervisor's events
+    file: the alert evaluator only needs ``.log(event, **payload)``,
+    and alert transitions must land in the same stream as the
+    supervisor's own rows (fail-soft, same as ``_event``)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def log(self, event: str, **payload: Any) -> Dict[str, Any]:
+        row: Dict[str, Any] = {"ts": payload.get("at_ts") or time.time(),
+                               "event": event}
+        row.update(payload)
+        try:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(row, default=str) + "\n")
+        except OSError:
+            pass
+        return row
+
+
 def backoff_delay(*args: Any, **kwargs: Any) -> float:
     """``resilience/retry.py § backoff_delay`` via the lazy resolver —
     ONE backoff definition in the repo, not a re-implementation."""
@@ -194,6 +216,7 @@ class ReplicaSupervisor:
                  drain_grace_s: float = 1.0,
                  registry: Optional[Any] = None,
                  events_path: Optional[str] = None,
+                 alert_evaluator: Optional[Any] = None,
                  rng: Optional[random.Random] = None):
         if scale_min < 1:
             raise ValueError(f"scale_min must be >= 1, got {scale_min}")
@@ -215,6 +238,11 @@ class ReplicaSupervisor:
         self.drain_grace_s = float(drain_grace_s)
         self.registry = registry
         self.events_path = events_path
+        # Duck-typed telemetry/alerts.py § AlertEvaluator (this module
+        # stays stdlib + file-path loadable, so the caller constructs
+        # it). None = alerting off: no rule ever runs, no event row
+        # grows an alerts_firing field — the zero-cost discipline.
+        self.alerts = alert_evaluator
         self.rng = random.Random() if rng is None else rng
         self.breaker = CrashLoopBreaker(max_restarts, restart_window_s)
         # Slot table: every slot 0..scale_max-1 exists from birth; a
@@ -230,12 +258,22 @@ class ReplicaSupervisor:
                          SCALE_UPS_COUNTER, SCALE_DOWNS_COUNTER):
                 registry.counter(name)
 
+    # Decision kinds annotated with the alerts firing at decision time:
+    # "the autoscaler scaled up WHILE slo_burn_high was firing" is the
+    # line an operator needs in the post-mortem.
+    _DECISION_KINDS = frozenset({
+        "scale_up", "scale_down", "restart_scheduled", "crash_loop",
+        "lease_dead_kill", "start_timeout_kill", "draining"})
+
     # -- small helpers ----------------------------------------------------
     def _event(self, kind: str, now: float, **fields: Any) -> None:
         if self.events_path is None:
             return
         row = {"event": "fleet_supervisor", "kind": kind, "ts": now}
         row.update(fields)
+        if self.alerts is not None and kind in self._DECISION_KINDS:
+            row["alerts_firing"] = sorted(
+                {a["rule"] for a in self.alerts.active()})
         try:
             with open(self.events_path, "a") as f:
                 f.write(json.dumps(row, default=str) + "\n")
@@ -287,7 +325,30 @@ class ReplicaSupervisor:
         self._reconcile(members, now)
         if self.registry is not None:
             self.registry.gauge(DESIRED_GAUGE).set(self.desired)
+        if self.alerts is not None:
+            self._evaluate_alerts(members, now)
         return self.states()
+
+    def _evaluate_alerts(self, members: Dict[int, Dict[str, Any]],
+                         now: float) -> None:
+        """Rule pass at the tick's end — the restart/crash counters the
+        tick just bumped are visible, and absence rules see one
+        ``lease:<slot>`` age per slot that SHOULD be leasing (RUNNING /
+        DRAINING; a STARTING slot has not leased yet and must not
+        false-fire). A vanished lease file is age ``inf``."""
+        ages: Dict[str, float] = {}
+        for slot, rec in self.slots.items():
+            if rec["state"] in (RUNNING, DRAINING):
+                ages[f"lease:{slot}"] = members.get(
+                    slot, {}).get("age", math.inf)
+        snapshot = (self.registry.snapshot()
+                    if self.registry is not None
+                    and hasattr(self.registry, "snapshot") else {})
+        self.alerts.evaluate(
+            now, snapshot=snapshot, ages=ages,
+            jsonl=(_EventAppender(self.events_path)
+                   if self.events_path is not None else None),
+            registry=self.registry)
 
     def _apply_advice(self, advice: str, now: float) -> None:
         if advice == "scale_up":
@@ -445,6 +506,12 @@ class ReplicaSupervisor:
                      SCALE_UPS_COUNTER, SCALE_DOWNS_COUNTER):
             snap[name] = self.registry.counter(name).value
         snap[DESIRED_GAUGE] = self.registry.gauge(DESIRED_GAUGE).value
+        if self.alerts is not None:
+            # Textual mirror of telemetry/alerts.py § FIRING_GAUGE (the
+            # router-constant rule: importing the package would pull
+            # jax into this jax-free module).
+            snap["maml_alert_firing"] = float(
+                self.alerts.firing_summary()["count"])
         row: Dict[str, Any] = {"event": "metrics", "ts": now,
                                "replica": "supervisor", "metrics": snap}
         try:

@@ -1,25 +1,50 @@
-"""Multi-host telemetry aggregation: heartbeats and straggler skew.
+"""Fleet-wide telemetry aggregation: heartbeats, skew, and the
+events-file collector behind the ops console.
 
-On a pod, per-host observability is the difference between "the run is
-slow" and "host 3 is slow". Every process computes its local step-time
-mean; :func:`host_step_skew` all-gathers the per-host vector (over the
-existing ``parallel/multihost.py`` collectives, so it composes with the
-repo's SPMD discipline), and :func:`emit_heartbeat` logs ONE row per
-heartbeat under the established single-writer rule — every process calls
-it at the same program point (the gather is a collective), every process
-builds the identical row, and only the process whose ``JsonlLogger`` is
-``enabled`` (process 0) writes it.
+Two planes meet here (docs/OBSERVABILITY.md § The ops console):
+
+* **In-run, collective**: on a pod, per-host observability is the
+  difference between "the run is slow" and "host 3 is slow". Every
+  process computes its local step-time mean; :func:`host_step_skew`
+  all-gathers the per-host vector (over ``parallel/multihost.py``
+  collectives, so it composes with the repo's SPMD discipline), and
+  :func:`emit_heartbeat` logs ONE row per heartbeat under the
+  single-writer rule — every process calls it at the same program
+  point, builds the identical row, and only process 0's enabled
+  ``JsonlLogger`` writes it.
+
+* **Offline, jax-free**: a fleet run leaves one ``events*.jsonl`` per
+  process (trainer, replicas, supervisor, bench driver).
+  :func:`collect_fleet_events` merges them into one time-ordered
+  timeline with each row stamped by its source file, and
+  :func:`fleet_counter_totals` folds the interleaved counter streams
+  reset-aware per ``(source, metric)`` — the same Prometheus ``rate()``
+  rule ``telemetry/report.py`` applies per source, so a replica that
+  restarted mid-run contributes both lifetimes. ``scripts/
+  ops_console.py`` and the alert engine (``telemetry/alerts.py``) read
+  the fleet through these two functions.
+
+This module is importable by file path on a jax-free login node (the
+router.py/supervisor.py discipline): the collective half lazily imports
+``parallel.multihost`` only when actually called.
 """
 
 from __future__ import annotations
 
+import glob
+import os
 from typing import Any, Dict, List, Optional
 
-from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
-    gather_host_floats)
-from howtotrainyourmamlpytorch_tpu.utils.tracing import JsonlLogger
-
 HEARTBEAT_EVENT = "heartbeat"
+METRICS_EVENT = "metrics"
+
+
+def _gather_host_floats(value: float) -> List[float]:
+    # Lazy on purpose: the import chain reaches jax, and the offline
+    # collector below must load on a login node without it.
+    from howtotrainyourmamlpytorch_tpu.parallel.multihost import (
+        gather_host_floats)
+    return gather_host_floats(value)
 
 
 def host_step_skew(local_mean_step_seconds: float) -> Dict[str, Any]:
@@ -31,7 +56,7 @@ def host_step_skew(local_mean_step_seconds: float) -> Dict[str, Any]:
     0.2 means the slowest host (which paces every collective) runs 20%
     behind the fleet average.
     """
-    values = gather_host_floats(local_mean_step_seconds)
+    values = _gather_host_floats(local_mean_step_seconds)
     finite = [v for v in values if v > 0]
     if not finite:
         return {"hosts": len(values), "host_mean_step_seconds": values,
@@ -46,7 +71,7 @@ def host_step_skew(local_mean_step_seconds: float) -> Dict[str, Any]:
     }
 
 
-def emit_heartbeat(jsonl: JsonlLogger, *, epoch: int, iteration: int,
+def emit_heartbeat(jsonl: Any, *, epoch: int, iteration: int,
                    local_mean_step_seconds: float,
                    process_index: Optional[int] = None,
                    progress_age_seconds: Optional[float] = None,
@@ -55,8 +80,8 @@ def emit_heartbeat(jsonl: JsonlLogger, *, epoch: int, iteration: int,
     """One heartbeat row per call ACROSS the fleet (not one per host).
 
     Collective (see :func:`host_step_skew`); the returned row is the
-    same on every process. Extra payload (memory stats, feed stall) is
-    merged into the row.
+    same on every process. Extra payload (memory stats, feed stall, the
+    ``alerts_firing`` summary) is merged into the row.
 
     ``progress_age_seconds`` is the caller's watchdog-beacon age (now −
     last beacon stamp). When passed, the per-host ages are gathered
@@ -71,7 +96,7 @@ def emit_heartbeat(jsonl: JsonlLogger, *, epoch: int, iteration: int,
         process_index = jax.process_index()
     skew = host_step_skew(local_mean_step_seconds)
     if progress_age_seconds is not None:
-        ages = gather_host_floats(progress_age_seconds)
+        ages = _gather_host_floats(progress_age_seconds)
         skew["host_progress_age_seconds"] = ages
         skew["progress_age_seconds"] = max(ages)
     if progress_phase is not None:
@@ -82,3 +107,131 @@ def emit_heartbeat(jsonl: JsonlLogger, *, epoch: int, iteration: int,
 
 def heartbeat_rows(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
     return [e for e in events if e.get("event") == HEARTBEAT_EVENT]
+
+
+# ---------------------------------------------------------------------------
+# Offline fleet collector (jax-free; scripts/ops_console.py's substrate)
+# ---------------------------------------------------------------------------
+
+
+def _read_rotated(path: str) -> List[Dict[str, Any]]:
+    """utils/tracing.py § read_jsonl_rotated, resolved lazily: the
+    package copy when already imported, else a file-path load — this
+    module must stay loadable on a jax-free login node and tracing.py
+    honors the same contract (the report.py § _reqtrace idiom)."""
+    import sys
+    mod = sys.modules.get("howtotrainyourmamlpytorch_tpu.utils.tracing")
+    if mod is None or not hasattr(mod, "read_jsonl_rotated"):
+        import importlib.util
+        path_mod = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "utils", "tracing.py")
+        spec = importlib.util.spec_from_file_location(
+            "_aggregate_tracing_impl", path_mod)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    return mod.read_jsonl_rotated(path)
+
+
+def resolve_fleet_files(paths: List[str]) -> List[str]:
+    """Expand args into event files: a ``.jsonl`` file stands for
+    itself; a directory stands for every ``*.jsonl`` directly under it
+    and under ``logs/`` (the slo_report.py rule — the layout a
+    fleet_bench/chaos_fleet out dir and an experiment dir both leave
+    behind). Rotated spares (``*.jsonl.1``) are NOT listed — readers
+    fold them in per live segment."""
+    files: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            found = sorted(glob.glob(os.path.join(path, "*.jsonl")))
+            found += sorted(glob.glob(os.path.join(path, "logs",
+                                                   "*.jsonl")))
+            files += found
+        else:
+            files.append(path)
+    return files
+
+
+def collect_fleet_events(paths: List[str]) -> List[Dict[str, Any]]:
+    """Merge trainer + replica + supervisor + driver event files into
+    one time-ordered timeline.
+
+    Each row gains a ``source`` key (the file's basename stem, e.g.
+    ``events_replica_0``) unless the row already names one (supervisor
+    metric rows carry ``replica="supervisor"``; those win — they are
+    the writer's own identity). The sort is stable on ``ts`` so rows
+    from one file keep their write order even with equal stamps; a row
+    without a finite ``ts`` sorts to the front rather than being
+    dropped (half-written logs from a live fleet must still render).
+    Unreadable files contribute nothing — the console's job includes
+    rendering a half-dead fleet.
+    """
+    rows: List[Dict[str, Any]] = []
+    for path in resolve_fleet_files(paths):
+        stem = os.path.basename(path)
+        if stem.endswith(".jsonl"):
+            stem = stem[:-len(".jsonl")]
+        try:
+            file_rows = _read_rotated(path)
+        except (OSError, ValueError):
+            continue
+        for row in file_rows:
+            if not isinstance(row, dict):
+                continue
+            row.setdefault("source", str(row.get("replica", "")) or stem)
+            rows.append(row)
+    rows.sort(key=lambda r: (
+        float(r["ts"]) if isinstance(r.get("ts"), (int, float))
+        else float("-inf")))
+    return rows
+
+
+def fleet_counter_totals(rows: List[Dict[str, Any]],
+                         prefixes: tuple = ("fleet/", "serve/")
+                         ) -> Dict[str, float]:
+    """Reset-aware fleet-wide counter totals over a merged timeline.
+
+    Accumulation is per ``(source, metric)`` — the timeline interleaves
+    several processes, and each restarts independently — then summed
+    across sources per metric: the Prometheus ``rate()`` rule
+    report.py's fleet section applies, lifted to the merged stream.
+    Gauges are not meaningful to sum this way; callers wanting "latest
+    gauge" read the last ``metrics`` row of the relevant source.
+    """
+    totals: Dict[str, float] = {}
+    prev: Dict[str, float] = {}
+    for row in rows:
+        if row.get("event") != METRICS_EVENT:
+            continue
+        metrics = row.get("metrics")
+        if not isinstance(metrics, dict):
+            continue
+        source = str(row.get("source", ""))
+        for key, value in metrics.items():
+            if not key.startswith(prefixes) \
+                    or not isinstance(value, (int, float)):
+                continue
+            pkey = f"{source}:{key}"
+            p = prev.get(pkey, 0.0)
+            totals[key] = totals.get(key, 0.0) + (
+                float(value) if float(value) < p else float(value) - p)
+            prev[pkey] = float(value)
+    return totals
+
+
+def latest_gauges(rows: List[Dict[str, Any]],
+                  names: List[str]) -> Dict[str, Any]:
+    """Last observed value per named metric across the merged timeline
+    (whatever source wrote it last — the console's 'current fleet
+    state' read for gauges like ``fleet/canary_weight``)."""
+    out: Dict[str, Any] = {name: None for name in names}
+    for row in rows:
+        if row.get("event") != METRICS_EVENT:
+            continue
+        metrics = row.get("metrics")
+        if not isinstance(metrics, dict):
+            continue
+        for name in names:
+            if isinstance(metrics.get(name), (int, float)):
+                out[name] = metrics[name]
+    return out

@@ -68,8 +68,14 @@ from typing import Any, Dict, List, Optional, Union
 # stage-promotion counters from the router+controller driver — all
 # reset-aware per (source, metric) like the fleet-health section; the
 # canary weight gauge — the rollout ladder's current stage — takes
-# the last signal)
-SCHEMA = "maml_tpu_telemetry_report_v17"
+# the last signal);
+# v18: + "alerts" (alert rules engine, telemetry/alerts.py: explicit
+# "alert" transition rows tallied fired/resolved and by severity;
+# still-firing reconstructed by replaying transitions last-wins per
+# (source, rule, labels) — a fired-then-resolved instance must read
+# as closed, and one log interleaves several evaluators' sources;
+# most-fired rule names the noisiest rule)
+SCHEMA = "maml_tpu_telemetry_report_v18"
 UNAVAILABLE = "unavailable"
 
 Metric = Union[float, int, str]
@@ -1042,6 +1048,50 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             "adapt_batches": al_batches or UNAVAILABLE,
         }
 
+    # Alerts section (telemetry/alerts.py, schema v18): the engine logs
+    # only TRANSITIONS ("firing"/"resolved" — pending is silent), so the
+    # section is a pure replay: fired/resolved tallies (and fired-by-
+    # severity), plus the still-firing reconstruction — last transition
+    # wins per (source, rule, labels); several evaluators (trainer,
+    # serving engine, supervisor) legitimately interleave in one log,
+    # and the SAME rule name firing on two sources is two instances.
+    # most_fired_rule names the noisiest rule — the first thing a human
+    # tunes. Runs without alert_rules_path summarize to "unavailable".
+    at_fired = 0
+    at_resolved = 0
+    at_fired_by_sev: Dict[str, int] = {}
+    at_per_rule: Dict[str, int] = {}
+    at_last: Dict[str, str] = {}   # instance key -> last state
+    at_seen = False
+    for e in events:
+        if e.get("event") != "alert":
+            continue
+        at_seen = True
+        state = str(e.get("state", ""))
+        rule = str(e.get("rule", "unknown"))
+        key = "|".join((str(e.get("source", "")), rule,
+                        repr(sorted((e.get("labels") or {}).items()))))
+        at_last[key] = state
+        if state == "firing":
+            at_fired += 1
+            sev = str(e.get("severity", "warn"))
+            at_fired_by_sev[sev] = at_fired_by_sev.get(sev, 0) + 1
+            at_per_rule[rule] = at_per_rule.get(rule, 0) + 1
+        elif state == "resolved":
+            at_resolved += 1
+    alerts_sec: Union[Dict[str, Any], str] = UNAVAILABLE
+    if at_seen:
+        alerts_sec = {
+            "fired": at_fired,
+            "resolved": at_resolved,
+            "still_firing": sum(1 for s in at_last.values()
+                                if s == "firing"),
+            "fired_by_severity": at_fired_by_sev or UNAVAILABLE,
+            "most_fired_rule": (max(sorted(at_per_rule),
+                                    key=lambda r: at_per_rule[r])
+                                if at_per_rule else UNAVAILABLE),
+        }
+
     skews = _finite([e.get("skew_frac") for e in beats])
     hosts = [int(e.get("hosts") or 1) for e in beats]
     host_skew: Union[Dict[str, Any], str] = UNAVAILABLE
@@ -1086,6 +1136,7 @@ def summarize_events(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "tune": tune_sec,
         "requests": requests_sec,
         "algo": algo_sec,
+        "alerts": alerts_sec,
     }
 
 
@@ -1128,6 +1179,7 @@ def format_table(summary: Dict[str, Any]) -> str:
         ("tune", summary["tune"]),
         ("requests", summary["requests"]),
         ("algo", summary["algo"]),
+        ("alerts", summary["alerts"]),
     ]
     width = max(len(label) for label, _ in rows)
     lines = [f"telemetry report ({summary['events']} events)"]

@@ -34,11 +34,22 @@ class JsonlLogger:
     are coerced via ``float``/``int`` fallback.
     """
 
-    def __init__(self, path: str, enabled: bool = True):
+    def __init__(self, path: str, enabled: bool = True,
+                 max_bytes: int = 0):
         """``enabled=False`` keeps the logger callable but writes nothing —
-        multi-host runs disable every process but 0 (single-writer)."""
+        multi-host runs disable every process but 0 (single-writer).
+
+        ``max_bytes > 0`` caps the live segment: a write that pushes the
+        file past the cap rotates ``path`` → ``path.1`` (one spare,
+        ``os.replace`` so a concurrent reader sees either the old or the
+        new segment, never a torn one) and the next write starts a fresh
+        live file. A long fleet run otherwise grows the log unbounded;
+        readers that want the full window read the spare first
+        (:func:`read_jsonl_rotated`).
+        """
         self.path = path
         self.enabled = enabled
+        self.max_bytes = int(max_bytes)
         if enabled:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
@@ -71,7 +82,21 @@ class JsonlLogger:
         if self.enabled:
             with open(self.path, "a") as f:
                 f.write(json.dumps(row) + "\n")
+                size = f.tell()
+            if self.max_bytes > 0 and size > self.max_bytes:
+                # Rotate AFTER the triggering row lands: every row is in
+                # exactly one segment, and a crash between write and
+                # rename only leaves the live file slightly over-cap.
+                try:
+                    os.replace(self.path, rotated_path(self.path))
+                except OSError:
+                    pass  # rotation is hygiene, never a lost event
         return row
+
+
+def rotated_path(path: str) -> str:
+    """The one spare segment a size-capped log rotates into."""
+    return path + ".1"
 
 
 def read_jsonl(path: str,
@@ -84,6 +109,26 @@ def read_jsonl(path: str,
     if tail is not None:
         lines = lines[-tail:]
     return [json.loads(line) for line in lines if line.strip()]
+
+
+def read_jsonl_rotated(path: str,
+                       tail: Optional[int] = None) -> List[Dict[str, Any]]:
+    """:func:`read_jsonl` plus the rotated spare: a size-capped
+    :class:`JsonlLogger` leaves up to two segments (``path.1`` then
+    ``path``); this reads the spare FIRST so rows come back in write
+    order. Every jax-free reader (telemetry_report, slo_report,
+    trace_export, ops_console) goes through here — a rotated fleet log
+    must not silently lose its older half. Missing segments (including
+    ``path`` itself right after a rotation) contribute nothing."""
+    rows: List[Dict[str, Any]] = []
+    for segment in (rotated_path(path), path):
+        try:
+            rows += read_jsonl(segment)
+        except OSError:
+            continue
+    if tail is not None:
+        rows = rows[-tail:]
+    return rows
 
 
 def nearest_rank(sorted_values: Sequence[float], q: float) -> float:

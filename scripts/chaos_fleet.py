@@ -29,12 +29,19 @@ failure shapes it exists for, each asserted from the artifact:
    the "never a timeout after queued work" contract) and the admitted
    p95 holds the SLO.
 
+Every phase also runs under alert rules (telemetry/alerts.py): kill
+proves the lease-absence and restart-rate alerts fire during the fault
+and resolve after healing, crash_loop the crash-loop rate alert, burst
+the admission-shedding rate alert. The rollup (``alert_fired_kinds``,
+``alerts_resolved``) and a post-chaos ``scripts/ops_console.py`` render
+(zero alerts still firing) gate the ``recovered`` verdict.
+
 Artifact contract (bench.py discipline): the LAST stdout JSON line is
 ``{"metric": "chaos_fleet", ...}`` with per-phase verdicts and the
 schema-stable fleet robustness keys (``fleet_restarts``,
-``fleet_crash_loops``, ``fleet_failover_count``, ``fleet_shed_count``).
-On a box that cannot bind localhost sockets: ``"status": "skipped"``,
-exit 0 (the chaos_pod.py rule).
+``fleet_crash_loops``, ``fleet_failover_count``, ``fleet_shed_count``)
+plus the alert rollup above. On a box that cannot bind localhost
+sockets: ``"status": "skipped"``, exit 0 (the chaos_pod.py rule).
 
 The driver process stays jax-free (fleet_bench's file-path loading
 discipline — router, supervisor and load generator shared with
@@ -74,6 +81,90 @@ _supervisor_mod = _load_module(
     "_chaos_fleet_supervisor_impl",
     os.path.join("howtotrainyourmamlpytorch_tpu", "serve", "fleet",
                  "supervisor.py"))
+_alerts_mod = _load_module(
+    "_chaos_fleet_alerts_impl",
+    os.path.join("howtotrainyourmamlpytorch_tpu", "telemetry",
+                 "alerts.py"))
+
+
+# ---------------------------------------------------------------------------
+# alert instrumentation (telemetry/alerts.py)
+# ---------------------------------------------------------------------------
+
+def _make_evaluator(rules: List[dict], *, source: str,
+                    snapshot_path: Optional[str] = None):
+    """Inline-rules AlertEvaluator for one chaos phase: each phase must
+    prove its alerts FIRE during the fault and RESOLVE after healing,
+    through the same rule engine production configs drive."""
+    return _alerts_mod.AlertEvaluator(
+        _alerts_mod.parse_rules({"rules": rules}), source=source,
+        snapshot_path=snapshot_path)
+
+
+def _alert_outcome(evaluators: Dict[str, Any],
+                   events_paths: List[str]) -> dict:
+    """Per-phase alert verdict: which rules fired (from the ``alert``
+    rows the evaluators appended to the phase's events files) plus the
+    fire/resolve ledger — the artifact's fire-AND-resolve proof.
+    ``resolved_all`` is the recovery gate: every fired instance closed
+    and nothing is still active on any evaluator."""
+    fired_kinds: set = set()
+    for path in events_paths:
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        row = json.loads(line)
+                    except ValueError:
+                        continue
+                    if (row.get("event") == "alert"
+                            and row.get("state") == "firing"):
+                        fired_kinds.add(str(row.get("rule")))
+        except OSError:
+            continue
+    fired = sum(ev.fired_total for ev in evaluators.values())
+    resolved = sum(ev.resolved_total for ev in evaluators.values())
+    active = sum(len(ev.active()) for ev in evaluators.values())
+    return {"fired_kinds": sorted(fired_kinds), "fired": fired,
+            "resolved": resolved, "active_final": active,
+            "resolved_all": bool(fired > 0 and resolved == fired
+                                 and active == 0)}
+
+
+def _console_check(out: str) -> dict:
+    """Render post-chaos fleet status via scripts/ops_console.py — the
+    operator's real entrypoint, as a subprocess — and keep the artifact
+    fields the chaos verdict gates on: the console must agree that
+    nothing is still firing after the suite."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_SCRIPTS, "ops_console.py"),
+             out],
+            cwd=_REPO, capture_output=True, text=True, timeout=120)
+        lines = proc.stdout.strip().splitlines()
+        doc = json.loads(lines[-1]) if lines else {}
+    except Exception as e:  # noqa: BLE001 — folded into the verdict
+        return {"error": f"{type(e).__name__}: {e}"}
+    return {"exit_code": proc.returncode,
+            "events_rows": doc.get("events_rows"),
+            "replicas_live": doc.get("replicas_live"),
+            "alerts_firing": doc.get("alerts_firing"),
+            "alerts_by_severity": doc.get("alerts_by_severity"),
+            "error": doc.get("error")}
+
+
+def _settle_alerts(evaluators: Dict[str, Any], tick_fns,
+                   timeout_s: float = 15.0) -> None:
+    """Keep ticking the healing loops until every fired alert has
+    resolved (or the budget runs out — the outcome assert then names
+    the stuck rule). Rate rules need one more evaluation AFTER the
+    counter stops moving; absence rules need the replacement lease."""
+    deadline = time.monotonic() + timeout_s
+    while (any(ev.active() for ev in evaluators.values())
+           and time.monotonic() < deadline):
+        for fn in tick_fns:
+            fn()
+        time.sleep(0.1)
 
 
 # ---------------------------------------------------------------------------
@@ -191,6 +282,47 @@ def phase_kill(out: str, cfg_path: str, cfg_doc: dict, ckpt_dir: str,
     fleet_dir = os.path.join(out, "fleet_kill")
     registry = _MiniMetrics()
     router = _router_for(fleet_dir, cfg_doc, registry)
+    sup_events = os.path.join(out, "events_supervisor_kill.jsonl")
+    drv_events = os.path.join(out, "events_driver_kill.jsonl")
+    # Two evaluators, two vantage points. The SUPERVISOR one rides the
+    # wired-in tick hook and watches the restart counter it bumps (a
+    # SIGKILLed child is seen by poll() within one tick, so its lease
+    # never ages while the slot counts as RUNNING — the supervisor's
+    # absence view cannot fire here by design). The DRIVER one watches
+    # the raw membership leases the router routes by: the victim's
+    # lease vanishes for the whole respawn window, fires, and resolves
+    # when the replacement's lease lands.
+    sup_alerts = _make_evaluator(
+        [{"name": "replica_restarts", "type": "rate",
+          "metric": _supervisor_mod.RESTARTS_COUNTER,
+          "op": ">", "value": 0, "for_s": 0, "severity": "warn"}],
+        source="supervisor",
+        snapshot_path=os.path.join(out, "ALERTS_kill_sup.json"))
+    drv_alerts = _make_evaluator(
+        [{"name": "replica_lease_stale", "type": "absence",
+          "signal_prefix": "lease:", "for_s": 0, "severity": "critical",
+          "max_age_s": 2.0 * float(cfg_doc["fleet_replica_stalled_s"])}],
+        source="driver",
+        snapshot_path=os.path.join(out, "ALERTS_kill_driver.json"))
+    drv_appender = _supervisor_mod._EventAppender(drv_events)
+    seen_rids: set = set()
+
+    def drv_alert_tick() -> None:
+        # A replica that has EVER leased is expected to keep leasing:
+        # the supervisor reaps the victim's stale lease file within a
+        # tick of the kill (so its age never grows on disk), and a
+        # vanished-but-expected lease is age inf — the absence rule
+        # fires for the whole respawn window and resolves the moment
+        # the replacement's lease lands.
+        members = _router_mod.read_members(fleet_dir)
+        seen_rids.update(members)
+        ages = {f"lease:{rid}":
+                (float(members[rid].get("age") or 0.0)
+                 if rid in members else float("inf"))
+                for rid in seen_rids}
+        drv_alerts.evaluate(snapshot=registry.snapshot(), ages=ages,
+                            jsonl=drv_appender, registry=registry)
+
     sup = _supervisor_mod.ReplicaSupervisor(
         fleet_dir, make_spawn(out, cfg_path, ckpt_dir, fleet_dir),
         desired=replicas, scale_min=1, scale_max=replicas,
@@ -198,8 +330,8 @@ def phase_kill(out: str, cfg_path: str, cfg_doc: dict, ckpt_dir: str,
         stalled_after_s=float(cfg_doc["fleet_replica_stalled_s"]),
         dead_after_s=float(cfg_doc["fleet_replica_dead_s"]),
         start_timeout_s=420.0, backoff_base_s=0.2, backoff_cap_s=2.0,
-        registry=registry,
-        events_path=os.path.join(out, "events_supervisor_kill.jsonl"))
+        registry=registry, events_path=sup_events,
+        alert_evaluator=sup_alerts)
     client = FleetClient(router, fleet_dir)
     try:
         _boot_fleet(sup, client, router, want_live=replicas)
@@ -221,6 +353,7 @@ def phase_kill(out: str, cfg_path: str, cfg_doc: dict, ckpt_dir: str,
         def on_tick(_now: float) -> None:
             sup.tick()
             client.pump()
+            drv_alert_tick()
 
         stats = drive_leg(
             router, client.conns, schedule,
@@ -241,10 +374,14 @@ def phase_kill(out: str, cfg_path: str, cfg_doc: dict, ckpt_dir: str,
             sup.tick()
             router.refresh()
             client.pump()
+            drv_alert_tick()
             if len(router.routable) >= replicas:
                 break
             time.sleep(0.1)
         restored = len(router.routable) >= replicas
+        evaluators = {"supervisor": sup_alerts, "driver": drv_alerts}
+        _settle_alerts(evaluators, [sup.tick, drv_alert_tick])
+        alerts = _alert_outcome(evaluators, [sup_events, drv_events])
         sup.flush_metrics()
         snap = registry.snapshot()
         restarts = int(snap.get(_supervisor_mod.RESTARTS_COUNTER, 0))
@@ -252,12 +389,18 @@ def phase_kill(out: str, cfg_path: str, cfg_doc: dict, ckpt_dir: str,
         ok = bool(stats["responses_ok"] == requests
                   and stats["dropped"] == 0
                   and victim["slot"] is not None
-                  and restarts >= 1 and restored)
+                  and restarts >= 1 and restored
+                  # Fire-AND-resolve: both vantage points saw the kill
+                  # (restart rate + lease staleness) and every alert
+                  # closed once the fleet healed.
+                  and "replica_restarts" in alerts["fired_kinds"]
+                  and "replica_lease_stale" in alerts["fired_kinds"]
+                  and alerts["resolved_all"])
         return {"ok": ok, "stats": stats, "victim_slot": victim["slot"],
                 "restarts": restarts, "failovers": failovers,
                 "breaker_trips": int(snap.get(
                     _router_mod.BREAKER_TRIPS_COUNTER, 0)),
-                "restored": restored, "metrics": snap}
+                "restored": restored, "alerts": alerts, "metrics": snap}
     finally:
         sup.stop()
         client.close()
@@ -270,6 +413,21 @@ def phase_crash_loop(out: str, cfg_path: str, cfg_doc: dict,
     registry = _MiniMetrics()
     router = _router_for(fleet_dir, cfg_doc, registry)
     poisoned_slot = replicas  # one EXTRA slot beyond the healthy fleet
+    sup_events = os.path.join(out, "events_supervisor_crash.jsonl")
+    # The crash-loop story is entirely supervisor-side: each boot
+    # failure bumps restarts (rate alert, warn) until the breaker
+    # trips crash_loops (rate alert, critical). Rate rules resolve on
+    # the first quiet evaluation — the FAILED slot stops respawning,
+    # so a post-leg settle pass must end with zero active alerts.
+    sup_alerts = _make_evaluator(
+        [{"name": "replica_crash_loop", "type": "rate",
+          "metric": _supervisor_mod.CRASH_LOOPS_COUNTER,
+          "op": ">", "value": 0, "for_s": 0, "severity": "critical"},
+         {"name": "replica_restarts", "type": "rate",
+          "metric": _supervisor_mod.RESTARTS_COUNTER,
+          "op": ">", "value": 0, "for_s": 0, "severity": "warn"}],
+        source="supervisor",
+        snapshot_path=os.path.join(out, "ALERTS_crash_sup.json"))
     sup = _supervisor_mod.ReplicaSupervisor(
         fleet_dir, make_spawn(out, cfg_path, ckpt_dir, fleet_dir,
                               poisoned={poisoned_slot}),
@@ -278,8 +436,8 @@ def phase_crash_loop(out: str, cfg_path: str, cfg_doc: dict,
         stalled_after_s=float(cfg_doc["fleet_replica_stalled_s"]),
         dead_after_s=float(cfg_doc["fleet_replica_dead_s"]),
         start_timeout_s=420.0, backoff_base_s=0.1, backoff_cap_s=0.5,
-        registry=registry,
-        events_path=os.path.join(out, "events_supervisor_crash.jsonl"))
+        registry=registry, events_path=sup_events,
+        alert_evaluator=sup_alerts)
     client = FleetClient(router, fleet_dir)
     try:
         # The poisoned slot crash-loops DURING boot: wait for the
@@ -297,6 +455,9 @@ def phase_crash_loop(out: str, cfg_path: str, cfg_doc: dict,
                           max_outstanding=4 * replicas,
                           stall_timeout_s=180.0 if quick else 300.0,
                           on_tick=on_tick)
+        evaluators = {"supervisor": sup_alerts}
+        _settle_alerts(evaluators, [sup.tick])
+        alerts = _alert_outcome(evaluators, [sup_events])
         sup.flush_metrics()
         snap = registry.snapshot()
         crash_loops = int(snap.get(
@@ -306,14 +467,17 @@ def phase_crash_loop(out: str, cfg_path: str, cfg_doc: dict,
         ok = bool(stats["responses_ok"] == requests
                   and stats["dropped"] == 0
                   and crash_loops >= 1 and failed_state
-                  and len(router.routable) == replicas)
+                  and len(router.routable) == replicas
+                  and "replica_crash_loop" in alerts["fired_kinds"]
+                  and alerts["resolved_all"])
         return {"ok": ok, "stats": stats,
                 "poisoned_slot": poisoned_slot,
                 "crash_loops": crash_loops,
                 "restarts": int(snap.get(
                     _supervisor_mod.RESTARTS_COUNTER, 0)),
                 "slot_failed": failed_state,
-                "served_at": len(router.routable), "metrics": snap}
+                "served_at": len(router.routable),
+                "alerts": alerts, "metrics": snap}
     finally:
         sup.stop()
         client.close()
@@ -386,15 +550,47 @@ def phase_burst(out: str, cfg_path: str, cfg_doc: dict, ckpt_dir: str,
         failed = int(burst["status_counts"].get("failed", 0))
         slo_ms = float(cfg_doc["fleet_slo_p95_ms"])
         p95 = burst["p95_ms"]
+        # Shed-rate alert over the driver's own observation ledger: the
+        # replica flushes its shed counter only at exit, so the driver
+        # mirrors the refusals it SAW into serve/shed_total and replays
+        # the burst timeline through the rate rule — quiet baseline
+        # (first observation), the burst's refusals (fires), cooldown
+        # with the counter still (resolves). Synthetic timestamps keep
+        # the rate math deterministic.
+        sh_events = os.path.join(out, "events_driver_burst.jsonl")
+        sh_alerts = _make_evaluator(
+            [{"name": "admission_shedding", "type": "rate",
+              "metric": "serve/shed_total",
+              "op": ">", "value": 0, "for_s": 0, "severity": "warn"}],
+            source="driver",
+            snapshot_path=os.path.join(out, "ALERTS_burst_driver.json"))
+        sh_appender = _supervisor_mod._EventAppender(sh_events)
+        t0 = time.time()
+        # Materialize the counter at 0 BEFORE the baseline pass: a rate
+        # rule ignores an absent metric entirely, so without this the
+        # post-burst value would itself become the baseline and the
+        # alert could never fire.
+        registry.counter("serve/shed_total")
+        sh_alerts.evaluate(t0, snapshot=registry.snapshot(),
+                           jsonl=sh_appender, registry=registry)
+        registry.counter("serve/shed_total").inc(shed)
+        sh_alerts.evaluate(t0 + 1.0, snapshot=registry.snapshot(),
+                           jsonl=sh_appender, registry=registry)
+        sh_alerts.evaluate(t0 + 2.0, snapshot=registry.snapshot(),
+                           jsonl=sh_appender, registry=registry)
+        alerts = _alert_outcome({"driver": sh_alerts}, [sh_events])
         ok = bool(burst["dropped"] == 0 and warm["dropped"] == 0
                   and prime["dropped"] == 0
                   and shed > 0 and failed == 0
                   and replica_sheds >= shed > 0
-                  and p95 is not None and p95 <= slo_ms)
+                  and p95 is not None and p95 <= slo_ms
+                  and "admission_shedding" in alerts["fired_kinds"]
+                  and alerts["resolved_all"])
         return {"ok": ok, "warm": warm, "prime": prime, "stats": burst,
                 "shed": shed, "replica_sheds": replica_sheds,
                 "deadline_misses": failed,
                 "admitted_p95_ms": p95, "slo_p95_ms": slo_ms,
+                "alerts": alerts,
                 "per_replica": per_replica, "metrics": registry.snapshot()}
     finally:
         sup.stop()
@@ -494,10 +690,28 @@ def main(argv=None) -> int:
                 quick=args.quick, image_shape=image_shape)
 
         n_ok = sum(1 for r in results.values() if r.get("ok"))
-        ok = n_ok == len(phases) and len(results) == len(phases)
         kill = results.get("kill") or {}
         crash = results.get("crash_loop") or {}
         burst = results.get("burst") or {}
+        # Fire-AND-resolve rollup across phases: which alert rules the
+        # chaos actually tripped, and whether every one of them closed
+        # once the fleet healed. Both gate "recovered" — a fleet that
+        # serves every request but leaves an alert stuck firing has NOT
+        # recovered by the ops plane's definition.
+        phase_alerts = [r.get("alerts") or {} for r in results.values()]
+        alert_fired_kinds = sorted(
+            {k for a in phase_alerts for k in a.get("fired_kinds", [])})
+        alerts_resolved = bool(phase_alerts and all(
+            a.get("resolved_all") for a in phase_alerts))
+        # Post-chaos console render: the SAME status CLI an operator
+        # would run, over the phase out-dir exhaust — and it must agree
+        # that nothing is still firing.
+        console = _console_check(out)
+        recovered = bool(n_ok == len(phases)
+                         and len(results) == len(phases)
+                         and alerts_resolved
+                         and console.get("alerts_firing") == 0)
+        ok = recovered
         artifact.update({
             "status": "ok" if ok else "failed",
             "value": n_ok,
@@ -508,6 +722,14 @@ def main(argv=None) -> int:
             "fleet_crash_loops": crash.get("crash_loops"),
             "fleet_failover_count": kill.get("failovers"),
             "fleet_shed_count": burst.get("shed"),
+            "alert_fired_kinds": alert_fired_kinds,
+            "alerts_fired": sum(int(a.get("fired") or 0)
+                                for a in phase_alerts),
+            "alerts_resolved": alerts_resolved,
+            "alerts_active_final": sum(int(a.get("active_final") or 0)
+                                       for a in phase_alerts),
+            "recovered": recovered,
+            "console": console,
             "out_dir": None if made_tmp else out,
         })
         print(json.dumps(artifact), flush=True)

@@ -1115,6 +1115,11 @@ def main(argv=None) -> int:
             "traffic_slo_held": None,
             "traffic_canary_weight_final": None,
             "traffic_cb_groups": None,
+            # Alert keys (scripts/chaos_fleet.py fills them): this
+            # bench installs no alert evaluator — honestly null.
+            "alerts_fired": None,
+            "alerts_resolved": None,
+            "alerts_active_final": None,
             "rollout": rollout or None,
             "migration": migration or None,
             "zero_dropped": zero_dropped,

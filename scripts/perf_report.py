@@ -247,7 +247,7 @@ def main(argv=None) -> int:
     events: List[Dict[str, Any]] = []
     if events_path and os.path.exists(events_path):
         try:
-            events = _tracing.read_jsonl(events_path)
+            events = _tracing.read_jsonl_rotated(events_path)
         except (OSError, ValueError) as e:
             print(json.dumps(
                 {"error": f"{type(e).__name__}: {e}",

@@ -94,6 +94,10 @@ SHARD_SIZE_OVERRIDES = {
     "tests/test_traffic_lab.py": 120_000,   # batcher/canary units plus
     #                                         a jax-free subprocess
     #                                         booby-trap proof
+    "tests/test_alerts.py": 120_000,        # rule-engine units + the
+    #                                         ops_console CLI subprocess
+    #                                         + the slow bitwise
+    #                                         alerts-on/off parity run
 }
 
 

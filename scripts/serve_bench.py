@@ -299,6 +299,11 @@ def main() -> int:
         "traffic_slo_held": None,
         "traffic_canary_weight_final": None,
         "traffic_cb_groups": None,
+        # Alert keys (scripts/chaos_fleet.py fills them): this bench
+        # runs no alert rules — honestly null, same schema rule.
+        "alerts_fired": None,
+        "alerts_resolved": None,
+        "alerts_active_final": None,
     }
     if args.events:
         jsonl = JsonlLogger(args.events)

@@ -56,7 +56,8 @@ _reqtrace = _load_module(
 _tracing = _load_module(
     "_slo_tracing_impl",
     os.path.join("howtotrainyourmamlpytorch_tpu", "utils", "tracing.py"))
-read_jsonl = _tracing.read_jsonl
+# Rotation-aware: the spare segment (events.jsonl.1) reads first.
+read_jsonl = _tracing.read_jsonl_rotated
 nearest_rank = _tracing.nearest_rank
 
 

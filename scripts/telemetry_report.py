@@ -50,7 +50,10 @@ _tracing = _load_module(
     os.path.join("howtotrainyourmamlpytorch_tpu", "utils", "tracing.py"))
 format_table = _report.format_table
 summarize_events = _report.summarize_events
-read_jsonl = _tracing.read_jsonl
+# Rotation-aware (utils/tracing.py § JsonlLogger rotation): the
+# capped spare segment (events.jsonl.1) is read first, so a report
+# over a rotated log keeps the oldest surviving rows.
+read_jsonl = _tracing.read_jsonl_rotated
 
 
 def resolve_events_path(path: str) -> str:

@@ -58,7 +58,8 @@ _trace = _load_module(
 _tracing = _load_module(
     "_trace_export_tracing_impl",
     os.path.join("howtotrainyourmamlpytorch_tpu", "utils", "tracing.py"))
-read_jsonl = _tracing.read_jsonl
+# Rotation-aware: the spare segment (events.jsonl.1) reads first.
+read_jsonl = _tracing.read_jsonl_rotated
 
 
 def resolve_paths(path: str):

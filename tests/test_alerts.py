@@ -1,0 +1,747 @@
+"""Ops-plane tests (ISSUE 20): alert rules engine, fleet log
+collection, rotation, and the status console.
+
+Tier-1 units pin the whole alerting contract clock-in and
+process-free: rule parsing (config.py-grade did-you-mean errors),
+every rule type's condition math (threshold ops, reset-aware counter
+rates with the first-observation-is-baseline rule, absence over
+present-signals-only ages incl. the ``inf`` vanished-lease case,
+per-tenant burn rates), ``for_s`` hysteresis with blink reset, dedup
+by (rule, labels), the firing -> resolved lifecycle (event rows,
+``maml_alert_firing`` gauge, atomic ALERTS.json), the supervisor
+integration (rate + absence rules over real fake-proc ticks, decision
+rows annotated with the firing set), JsonlLogger size-capped rotation
++ the rotated readers, the fleet events collector, and the
+ops_console CLI (real subprocess under the jax-import booby trap —
+the artifact schema pin the console docstring promises).
+
+The structural zero-cost pin (``alert_rules_path`` unset installs
+NOTHING on the serving engine) is tier-1; the bitwise
+alerts-on-vs-off serving parity proof compiles two engines and rides
+the ``slow`` profile.
+"""
+
+import json
+import math
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from howtotrainyourmamlpytorch_tpu.config import MAMLConfig
+from howtotrainyourmamlpytorch_tpu.serve.fleet import (
+    supervisor as fsup)
+from howtotrainyourmamlpytorch_tpu.serve.fleet.router import ReplicaLease
+from howtotrainyourmamlpytorch_tpu.serve.fleet.supervisor import (
+    ReplicaSupervisor)
+from howtotrainyourmamlpytorch_tpu.telemetry import aggregate, alerts
+from howtotrainyourmamlpytorch_tpu.utils.tracing import (
+    JsonlLogger, read_jsonl, read_jsonl_rotated, rotated_path)
+from test_fleet_supervisor import FakeProc, _touch_lease
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OPS_CONSOLE = os.path.join(REPO, "scripts", "ops_console.py")
+DEFAULT_RULES = os.path.join(REPO, "configs", "alerts_default.json")
+
+
+# ---------------------------------------------------------------------------
+# test doubles
+# ---------------------------------------------------------------------------
+
+class _Sink:
+    """JsonlLogger-shaped capture sink (the evaluator only needs
+    ``.log(event, **payload)``)."""
+
+    def __init__(self):
+        self.rows = []
+
+    def log(self, event, **payload):
+        row = {"event": event, **payload}
+        self.rows.append(row)
+        return row
+
+
+class _Counter:
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount=1.0):
+        self.value += amount
+
+
+class _Gauge:
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v):
+        self.value = float(v)
+
+
+class _SnapReg:
+    """Duck-typed MetricsRegistry WITH ``snapshot()`` — the
+    supervisor's alert pass reads its counters through it
+    (test_fleet_supervisor's ``_Reg`` deliberately lacks snapshot;
+    alerting is exactly the consumer that needs one)."""
+
+    def __init__(self):
+        self.counters = {}
+        self.gauges = {}
+
+    def counter(self, name):
+        return self.counters.setdefault(name, _Counter())
+
+    def gauge(self, name):
+        return self.gauges.setdefault(name, _Gauge())
+
+    def snapshot(self):
+        out = {n: c.value for n, c in self.counters.items()}
+        out.update({n: g.value for n, g in self.gauges.items()})
+        return out
+
+
+def _ev(*rule_dicts, **kw):
+    return alerts.AlertEvaluator(
+        alerts.parse_rules({"rules": list(rule_dicts)}), **kw)
+
+
+# ---------------------------------------------------------------------------
+# rule parsing / validation
+# ---------------------------------------------------------------------------
+
+def test_shipped_default_rules_parse_and_round_trip():
+    rules = alerts.load_rules(DEFAULT_RULES)
+    names = {r.name for r in rules}
+    assert {"heartbeat_stale", "replica_lease_stale", "slo_burn_high",
+            "replica_restarts", "replica_crash_loop",
+            "admission_shedding"} <= names
+    # as_dict() is a valid rules document again (the snapshot format).
+    redo = alerts.parse_rules({"rules": [r.as_dict() for r in rules]})
+    assert [r.as_dict() for r in redo] == [r.as_dict() for r in rules]
+
+
+@pytest.mark.parametrize("doc,match", [
+    ("not a dict", r"'rules' list"),
+    ({"rules": [{"type": "threshold"}]}, r"non-empty 'name'"),
+    ({"rules": [{"name": "a", "type": "treshold"}]},
+     r"did you mean 'threshold'"),
+    ({"rules": [{"name": "a", "type": "threshold", "metrik": "m",
+                 "op": ">", "value": 1, "metric": "m"}]},
+     r"unknown field 'metrik'.*did you mean 'metric'"),
+    ({"rules": [{"name": "a", "type": "threshold", "op": ">",
+                 "value": 1}]}, r"requires field 'metric'"),
+    ({"rules": [{"name": "a", "type": "threshold", "metric": "m",
+                 "op": ">", "value": 1, "severity": "warning"}]},
+     r"did you mean 'warn'"),
+    ({"rules": [{"name": "a", "type": "threshold", "metric": "m",
+                 "op": "=>", "value": 1}]}, r"unknown op '=>'"),
+    ({"rules": [{"name": "a", "type": "rate", "metric": "m", "op": ">",
+                 "value": 0, "for_s": -1}]}, r"for_s must be >= 0"),
+    ({"rules": [{"name": "a", "type": "absence", "signal": "hb"}]},
+     r"max_age_s"),
+    ({"rules": [{"name": "a", "type": "absence", "max_age_s": 5}]},
+     r"'signal'\s+or 'signal_prefix'"),
+    ({"rules": [{"name": "a", "type": "burn_rate", "max_burn": 1},
+                {"name": "a", "type": "burn_rate", "max_burn": 2}]},
+     r"duplicate rule name"),
+])
+def test_parse_rules_rejections_name_the_problem(doc, match):
+    with pytest.raises(ValueError, match=match):
+        alerts.parse_rules(doc)
+
+
+def test_load_rules_errors_name_the_file(tmp_path):
+    bad = tmp_path / "rules.json"
+    bad.write_text("{not json")
+    with pytest.raises(ValueError, match=r"rules\.json.*not valid"):
+        alerts.load_rules(str(bad))
+    bad.write_text(json.dumps(
+        {"rules": [{"name": "a", "type": "nope"}]}))
+    with pytest.raises(ValueError, match=r"rules\.json.*unknown type"):
+        alerts.load_rules(str(bad))
+    # A config-named file that does not exist is a deployment error.
+    with pytest.raises(OSError):
+        alerts.load_rules(str(tmp_path / "missing.json"))
+
+
+def test_severity_helpers():
+    assert [alerts.severity_rank(s) for s in alerts.SEVERITIES] \
+        == [0, 1, 2]
+    assert alerts.max_severity(["info", "critical", "warn"]) \
+        == "critical"
+    assert alerts.max_severity(["info"]) == "info"
+    assert alerts.max_severity([]) is None
+
+
+# ---------------------------------------------------------------------------
+# condition math per rule type
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("op,value,fires", [
+    (">", 2.0, True), (">", 3.0, False),
+    (">=", 3.0, True), (">=", 3.1, False),
+    ("<", 4.0, True), ("<", 3.0, False),
+    ("<=", 3.0, True), ("<=", 2.9, False),
+    ("==", 3.0, True), ("==", 2.0, False),
+])
+def test_threshold_ops(op, value, fires):
+    ev = _ev({"name": "t", "type": "threshold", "metric": "m",
+              "op": op, "value": value})
+    t = ev.evaluate(0.0, snapshot={"m": 3.0})
+    assert bool(t) is fires
+    if fires:
+        assert t[0]["state"] == "firing" and t[0]["value"] == 3.0
+
+
+def test_threshold_ignores_missing_and_non_finite_metrics():
+    ev = _ev({"name": "t", "type": "threshold", "metric": "m",
+              "op": ">", "value": 0.0})
+    assert ev.evaluate(0.0, snapshot={}) == []
+    assert ev.evaluate(1.0, snapshot={"m": float("nan")}) == []
+    assert ev.evaluate(2.0, snapshot={"m": "not a number"}) == []
+    assert ev.active() == []
+
+
+def test_rate_first_observation_is_baseline_then_fires_then_resolves():
+    ev = _ev({"name": "r", "type": "rate", "metric": "c",
+              "op": ">", "value": 0.0})
+    # A huge first value is a baseline, never a rate — a fresh process
+    # attaching to a long-lived counter must not page.
+    assert ev.evaluate(0.0, snapshot={"c": 1000.0}) == []
+    t = ev.evaluate(2.0, snapshot={"c": 1006.0})
+    assert t[0]["state"] == "firing"
+    assert t[0]["value"] == pytest.approx(3.0)  # 6 over 2s
+    # Steady counter -> rate 0 -> resolved.
+    t = ev.evaluate(3.0, snapshot={"c": 1006.0})
+    assert [r["state"] for r in t] == ["resolved"]
+    assert ev.fired_total == 1 and ev.resolved_total == 1
+
+
+def test_rate_is_reset_aware():
+    ev = _ev({"name": "r", "type": "rate", "metric": "c",
+              "op": ">", "value": 0.0})
+    ev.evaluate(0.0, snapshot={"c": 100.0})
+    # Counter below its predecessor = restarted process: the new value
+    # contributes whole over the interval, never a negative rate.
+    t = ev.evaluate(2.0, snapshot={"c": 4.0})
+    assert t[0]["state"] == "firing"
+    assert t[0]["value"] == pytest.approx(2.0)
+
+
+def test_absence_judges_only_present_signals():
+    ev = _ev({"name": "hb", "type": "absence", "signal": "heartbeat",
+              "max_age_s": 10.0, "severity": "critical"})
+    # Not this process's signal to watch: a shared rules file must not
+    # make a process page about a heartbeat it does not emit.
+    assert ev.evaluate(0.0, ages={}) == []
+    assert ev.evaluate(1.0, ages={"heartbeat": 5.0}) == []
+    t = ev.evaluate(2.0, ages={"heartbeat": 11.0})
+    assert t[0]["state"] == "firing"
+    assert t[0]["labels"] == {"signal": "heartbeat"}
+    assert t[0]["value"] == 11.0
+    t = ev.evaluate(3.0, ages={"heartbeat": 0.1})
+    assert [r["state"] for r in t] == ["resolved"]
+
+
+def test_absence_prefix_instances_and_vanished_lease_inf():
+    ev = _ev({"name": "lease_stale", "type": "absence",
+              "signal_prefix": "lease:", "max_age_s": 1.0})
+    t = ev.evaluate(0.0, ages={"lease:0": 2.0,
+                               "lease:1": float("inf"),
+                               "lease:2": 0.2})
+    fired = {r["labels"]["signal"]: r["value"] for r in t}
+    assert fired == {"lease:0": 2.0, "lease:1": None}  # inf -> null
+    # One instance resolves while the other keeps firing silently.
+    t = ev.evaluate(1.0, ages={"lease:0": 0.0,
+                               "lease:1": float("inf")})
+    assert [(r["state"], r["labels"]["signal"]) for r in t] \
+        == [("resolved", "lease:0")]
+    assert ev.firing_summary() == {"count": 1, "max_severity": "warn"}
+
+
+def test_burn_rate_per_tenant_instances():
+    ev = _ev({"name": "burn", "type": "burn_rate", "max_burn": 2.0,
+              "severity": "critical"})
+    t = ev.evaluate(0.0, burn_rates={"acme": 3.5, "bbco": 1.0})
+    assert [(r["labels"], r["value"]) for r in t] \
+        == [({"tenant": "acme"}, 3.5)]
+    # The other tenant crossing later is a SECOND instance, deduped
+    # independently of the first.
+    t = ev.evaluate(1.0, burn_rates={"acme": 3.5, "bbco": 4.0})
+    assert [(r["state"], r["labels"]) for r in t] \
+        == [("firing", {"tenant": "bbco"})]
+    assert ev.firing_summary() == {"count": 2,
+                                   "max_severity": "critical"}
+
+
+# ---------------------------------------------------------------------------
+# hysteresis, dedup, lifecycle
+# ---------------------------------------------------------------------------
+
+def test_for_s_hysteresis_with_blink_reset():
+    ev = _ev({"name": "q", "type": "threshold", "metric": "m",
+              "op": ">", "value": 1.0, "for_s": 5.0})
+    assert ev.evaluate(0.0, snapshot={"m": 9.0}) == []  # pending
+    assert ev.evaluate(3.0, snapshot={"m": 9.0}) == []  # still pending
+    # The condition blinks false: pending drops SILENTLY (that is the
+    # hysteresis working — a noisy sample never pages, never logs).
+    assert ev.evaluate(4.0, snapshot={"m": 0.0}) == []
+    assert ev.evaluate(5.0, snapshot={"m": 9.0}) == []  # clock restarts
+    assert ev.evaluate(9.0, snapshot={"m": 9.0}) == []  # 4s < for_s
+    t = ev.evaluate(10.0, snapshot={"m": 9.0})
+    assert t[0]["state"] == "firing"
+    assert t[0]["since_ts"] == 5.0 and t[0]["fired_ts"] == 10.0
+    assert ev.fired_total == 1
+
+
+def test_firing_dedup_no_refire_while_active():
+    ev = _ev({"name": "hot", "type": "threshold", "metric": "m",
+              "op": ">", "value": 1.0})
+    t = ev.evaluate(0.0, snapshot={"m": 5.0})
+    assert [r["state"] for r in t] == ["firing"]
+    # Re-observed true: silent, but the tracked value stays current.
+    assert ev.evaluate(1.0, snapshot={"m": 6.0}) == []
+    assert ev.fired_total == 1
+    (act,) = ev.active()
+    assert act["value"] == 6.0
+
+
+def test_lifecycle_rows_gauge_and_atomic_snapshot(tmp_path):
+    snap_path = tmp_path / "ALERTS.json"
+    reg, sink = _SnapReg(), _Sink()
+    ev = _ev({"name": "hot", "type": "threshold", "metric": "m",
+              "op": ">", "value": 1.0},
+             source="unit", snapshot_path=str(snap_path))
+    ev.evaluate(0.0, snapshot={"m": 5.0}, jsonl=sink, registry=reg)
+    assert reg.gauges[alerts.FIRING_GAUGE].value == 1.0
+    doc = json.loads(snap_path.read_text())
+    assert len(doc["firing"]) == 1
+    assert doc["counts"] == {"info": 0, "warn": 1, "critical": 0}
+    assert doc["source"] == "unit"
+    ev.evaluate(1.0, snapshot={"m": 0.0}, jsonl=sink, registry=reg)
+    assert reg.gauges[alerts.FIRING_GAUGE].value == 0.0
+    assert ev.active() == []
+    doc = json.loads(snap_path.read_text())
+    assert doc["firing"] == []
+    assert doc["fired_total"] == 1 and doc["resolved_total"] == 1
+    rows = [r for r in sink.rows if r["event"] == alerts.ALERT_EVENT]
+    assert [r["state"] for r in rows] == ["firing", "resolved"]
+    assert all(r["source"] == "unit" and r["rule"] == "hot"
+               for r in rows)
+    assert set(rows[0]) >= {"rule", "type", "severity", "state",
+                            "labels", "value", "since_ts", "fired_ts",
+                            "at_ts", "source"}
+    # Atomic replace leaves no tmp litter behind.
+    assert [p for p in os.listdir(tmp_path) if ".tmp." in p] == []
+
+
+def test_active_orders_critical_first():
+    ev = _ev({"name": "warned", "type": "threshold", "metric": "a",
+              "op": ">", "value": 0.0, "severity": "warn"},
+             {"name": "paged", "type": "threshold", "metric": "b",
+              "op": ">", "value": 0.0, "severity": "critical"})
+    ev.evaluate(0.0, snapshot={"a": 1.0, "b": 1.0})
+    assert [r["rule"] for r in ev.active()] == ["paged", "warned"]
+    assert ev.firing_summary() == {"count": 2,
+                                   "max_severity": "critical"}
+
+
+def test_read_snapshots_fail_soft(tmp_path):
+    good = tmp_path / "ALERTS.json"
+    good.write_text(json.dumps({"updated_ts": 1.0, "source": "x",
+                                "firing": [{"rule": "r",
+                                            "severity": "warn"}],
+                                "counts": {}}))
+    (tmp_path / "torn.json").write_text("{torn")
+    (tmp_path / "shape.json").write_text(json.dumps({"firing": "no"}))
+    docs = alerts.read_snapshots([str(good), str(tmp_path / "torn.json"),
+                                  str(tmp_path / "shape.json"),
+                                  str(tmp_path / "missing.json")])
+    assert len(docs) == 1 and docs[0]["source"] == "x"
+
+
+# ---------------------------------------------------------------------------
+# supervisor integration (rate + absence over real ticks; satellite 3)
+# ---------------------------------------------------------------------------
+
+def _mk_sup_with_alerts(fleet_dir, spawned, reg, events, ev, **kw):
+    def spawn(slot):
+        proc = FakeProc()
+        spawned.setdefault(slot, []).append(proc)
+        return proc
+    kw.setdefault("rng", random.Random(0))
+    return ReplicaSupervisor(str(fleet_dir), spawn, registry=reg,
+                             events_path=str(events),
+                             alert_evaluator=ev, **kw)
+
+
+def test_supervisor_restart_rate_alert_annotates_decisions(tmp_path):
+    spawned, reg = {}, _SnapReg()
+    events = tmp_path / "events_supervisor.jsonl"
+    ev = _ev({"name": "replica_restarts", "type": "rate",
+              "metric": fsup.RESTARTS_COUNTER, "op": ">", "value": 0.0,
+              "severity": "warn"}, source="supervisor")
+    sup = _mk_sup_with_alerts(tmp_path / "fleet", spawned, reg, events,
+                              ev, desired=1, scale_max=2,
+                              backoff_base_s=0.05, backoff_cap_s=2.0)
+    t0 = time.time()
+    sup.tick(t0)                        # spawn; rate baseline (c=0)
+    _touch_lease(tmp_path / "fleet", 0)
+    sup.tick(t0 + 0.1)                  # RUNNING; steady -> no fire
+    assert ev.active() == []
+    spawned[0][0].exit(1)
+    sup.tick(t0 + 0.2)                  # crash -> restarts=1 -> fires
+    assert ev.firing_summary() == {"count": 1, "max_severity": "warn"}
+    # A decision made WHILE firing carries the firing set — and the
+    # counter going quiet resolves the alert at this tick's end.
+    sup.tick(t0 + 0.3, advice="scale_up")
+    assert ev.active() == []
+    assert reg.gauges[alerts.FIRING_GAUGE].value == 0.0
+    rows = read_jsonl(str(events))
+    alert_rows = [r for r in rows if r.get("event") == alerts.ALERT_EVENT]
+    assert [r["state"] for r in alert_rows] == ["firing", "resolved"]
+    assert all(r["rule"] == "replica_restarts"
+               and r["source"] == "supervisor" for r in alert_rows)
+    scale = [r for r in rows if r.get("event") == "fleet_supervisor"
+             and r.get("kind") == "scale_up"]
+    assert scale and scale[0]["alerts_firing"] == ["replica_restarts"]
+
+
+def test_supervisor_absence_alert_on_stale_lease(tmp_path):
+    spawned, reg = {}, _SnapReg()
+    events = tmp_path / "events_supervisor.jsonl"
+    ev = _ev({"name": "lease_stale", "type": "absence",
+              "signal_prefix": "lease:", "max_age_s": 1.0,
+              "severity": "critical"}, source="supervisor")
+    # Wide stalled/dead thresholds: the aged lease must trip the ALERT,
+    # not the supervisor's own kill path.
+    sup = _mk_sup_with_alerts(tmp_path / "fleet", spawned, reg, events,
+                              ev, desired=1, scale_max=1,
+                              stalled_after_s=10.0, dead_after_s=30.0)
+    t0 = time.time()
+    sup.tick(t0)
+    _touch_lease(tmp_path / "fleet", 0)
+    sup.tick(t0 + 0.1)
+    assert ev.active() == []            # fresh lease, nothing fires
+    _touch_lease(tmp_path / "fleet", 0, age_s=2.0)
+    sup.tick(t0 + 0.2)
+    (act,) = ev.active()
+    assert act["rule"] == "lease_stale"
+    assert act["labels"] == {"signal": "lease:0"}
+    _touch_lease(tmp_path / "fleet", 0)  # proof of life returns
+    sup.tick(t0 + 0.3)
+    assert ev.active() == []
+    assert ev.fired_total == 1 and ev.resolved_total == 1
+
+
+# ---------------------------------------------------------------------------
+# JsonlLogger rotation + rotated readers (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_jsonl_logger_rotates_one_spare(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = JsonlLogger(path, max_bytes=150)
+    for seq in range(12):
+        log.log("tick", seq=seq)
+    assert os.path.exists(rotated_path(path))
+    rows = read_jsonl_rotated(path)
+    seqs = [r["seq"] for r in rows]
+    # Every row lands in exactly one segment and the two segments are
+    # contiguous in write order; the oldest rows (beyond one spare)
+    # are legitimately gone.
+    assert seqs == list(range(seqs[0], 12))
+    assert 0 < len(seqs) < 12
+    # Only the one spare exists — no .2 ladder.
+    assert not os.path.exists(path + ".2")
+    assert [r["seq"] for r in read_jsonl_rotated(path, tail=2)] \
+        == [10, 11]
+
+
+def test_read_jsonl_rotated_survives_missing_live_segment(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with open(rotated_path(path), "w") as f:
+        f.write(json.dumps({"ts": 1.0, "event": "tick", "seq": 0})
+                + "\n")
+    # Right after a rotation the live file does not exist yet.
+    assert [r["seq"] for r in read_jsonl_rotated(path)] == [0]
+
+
+def test_jsonl_logger_uncapped_and_disabled_behavior(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = JsonlLogger(path)  # max_bytes=0: never rotates
+    for seq in range(50):
+        log.log("tick", seq=seq, pad="x" * 64)
+    assert not os.path.exists(rotated_path(path))
+    assert len(read_jsonl(path)) == 50
+    off = JsonlLogger(str(tmp_path / "never.jsonl"), enabled=False,
+                      max_bytes=10)
+    off.log("tick", seq=0)
+    assert not os.path.exists(str(tmp_path / "never.jsonl"))
+
+
+# ---------------------------------------------------------------------------
+# fleet events collector (satellite 2)
+# ---------------------------------------------------------------------------
+
+def _write_rows(path, rows):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def test_collect_fleet_events_merges_sources_in_time_order(tmp_path):
+    out = tmp_path / "out"
+    _write_rows(str(out / "events_driver.jsonl"),
+                [{"ts": 3.0, "event": "metrics"},
+                 {"ts": 1.0, "event": "metrics"}])
+    _write_rows(str(out / "logs" / "events_replica_0.jsonl"),
+                [{"ts": 2.0, "event": "metrics"},
+                 {"ts": 4.0, "event": "metrics",
+                  "replica": "supervisor"},
+                 {"event": "half_written"}])
+    # A rotated spare folds into its live segment's stream.
+    _write_rows(str(out / "events_driver.jsonl.1"),
+                [{"ts": 0.5, "event": "metrics"}])
+    # Unreadable files contribute nothing (render the half-dead fleet).
+    (out / "bad.jsonl").write_text("{torn")
+    rows = aggregate.collect_fleet_events([str(out)])
+    assert [r.get("ts") for r in rows] == [None, 0.5, 1.0, 2.0, 3.0, 4.0]
+    by_ts = {r.get("ts"): r["source"] for r in rows}
+    assert by_ts[0.5] == "events_driver"       # spare keeps its stem
+    assert by_ts[2.0] == "events_replica_0"
+    assert by_ts[4.0] == "supervisor"          # row's own identity wins
+    assert by_ts[None] == "events_replica_0"   # no-ts rows still render
+    # The spare is folded per live segment, never listed as a file.
+    files = aggregate.resolve_fleet_files([str(out)])
+    assert not any(f.endswith(".jsonl.1") for f in files)
+
+
+def test_fleet_counter_totals_reset_aware_per_source():
+    rows = [
+        {"event": "metrics", "source": "a",
+         "metrics": {"fleet/restarts": 2.0, "other/x": 9.0}},
+        {"event": "metrics", "source": "b",
+         "metrics": {"fleet/restarts": 4.0}},
+        {"event": "metrics", "source": "a",
+         "metrics": {"fleet/restarts": 5.0}},
+        # Source a restarts: value below predecessor contributes whole.
+        {"event": "metrics", "source": "a",
+         "metrics": {"fleet/restarts": 1.0, "serve/shed_total": 3.0}},
+        {"event": "not_metrics", "source": "a",
+         "metrics": {"fleet/restarts": 99.0}},
+    ]
+    totals = aggregate.fleet_counter_totals(rows)
+    assert totals["fleet/restarts"] == pytest.approx(10.0)  # 2+3+1 + 4
+    assert totals["serve/shed_total"] == pytest.approx(3.0)
+    assert "other/x" not in totals
+
+
+def test_latest_gauges_last_write_wins():
+    rows = [
+        {"event": "metrics",
+         "metrics": {"fleet/canary_weight": 0.1}},
+        {"event": "metrics",
+         "metrics": {"fleet/canary_weight": 0.5, "junk": "str"}},
+    ]
+    out = aggregate.latest_gauges(rows, ["fleet/canary_weight",
+                                         "fleet/never_written"])
+    assert out == {"fleet/canary_weight": 0.5,
+                   "fleet/never_written": None}
+
+
+# ---------------------------------------------------------------------------
+# ops_console CLI (real subprocess, jax-import booby trap)
+# ---------------------------------------------------------------------------
+
+def _console(args, trap):
+    proc = subprocess.run(
+        [sys.executable, OPS_CONSOLE] + args, capture_output=True,
+        text=True, env=dict(os.environ, PYTHONPATH=str(trap)),
+        timeout=120)
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln]
+    artifact = json.loads(lines[-1]) if lines else {}
+    return proc, artifact
+
+
+@pytest.fixture
+def trap(tmp_path):
+    """PYTHONPATH booby trap (the reqtrace idiom): the console must run
+    on a login node — any jax import explodes."""
+    trap = tmp_path / "trap"
+    trap.mkdir()
+    (trap / "jax.py").write_text(
+        "raise ImportError('ops_console must not import jax')\n")
+    return trap
+
+
+def test_ops_console_renders_fleet_and_alerts(tmp_path, trap):
+    out = tmp_path / "out"
+    _write_rows(str(out / "logs" / "events.jsonl"), [
+        {"ts": 1.0, "event": "heartbeat", "epoch": 0, "iter": 10,
+         "process_index": 0},
+        {"ts": 2.0, "event": "metrics",
+         "metrics": {"fleet/canary_weight": 0.25,
+                     "serve/shed_total": 3.0}},
+        # replica_restarts fired then resolved: replay must NOT count
+        # it (last transition per (source, rule, labels) wins).
+        {"ts": 3.0, "event": "alert", "rule": "replica_restarts",
+         "severity": "warn", "state": "firing", "labels": {}},
+        {"ts": 4.0, "event": "alert", "rule": "replica_restarts",
+         "severity": "warn", "state": "resolved", "labels": {}},
+        {"ts": 5.0, "event": "alert", "rule": "slo_burn_high",
+         "severity": "critical", "state": "firing",
+         "labels": {"tenant": "acme"}, "value": 3.5},
+    ])
+    fleet = out / "fleet"
+    fleet.mkdir()
+    lease = ReplicaLease(str(fleet), 0, 0.0)
+    assert lease.touch(payload={
+        "port": 7001, "pid": 1234, "version": "ckpt_v1",
+        "stats": {"queue_depth": 1, "p95_ms": 12.5},
+        "alerts_firing": {"count": 2, "max_severity": "warn"}},
+        force=True)
+
+    proc, art = _console([str(out)], trap)
+    assert proc.returncode == 0, proc.stderr
+    assert "ALERTS FIRING (1)" in proc.stdout  # human render
+    assert art["metric"] == "ops_console"
+    assert art["events_rows"] == 5 and art["sources"] == ["events"]
+    assert art["replicas_live"] == 1
+    (rep,) = art["replicas"]
+    assert rep["verdict"] == "live" and rep["version"] == "ckpt_v1"
+    # The peer's own firing summary rides the lease payload (sat. 3).
+    assert rep["alerts_firing"] == 2
+    assert rep["alerts_max_severity"] == "warn"
+    assert art["canary_weight"] == 0.25
+    assert art["counters"] == {"serve/shed_total": 3.0}
+    assert art["alerts_firing"] == 1
+    assert art["alerts_by_severity"] == {"info": 0, "warn": 0,
+                                         "critical": 1}
+    assert art["alerts"][0]["rule"] == "slo_burn_high"
+
+    # An ALERTS.json snapshot is the evaluator's own word and WINS over
+    # row replay: all-clear snapshot -> zero firing.
+    (out / "ALERTS.json").write_text(json.dumps(
+        {"updated_ts": 6.0, "source": "supervisor", "firing": [],
+         "counts": {}, "fired_total": 2, "resolved_total": 2}))
+    proc, art = _console([str(out), "--json"], trap)
+    assert proc.returncode == 0, proc.stderr
+    assert art["alerts_firing"] == 0
+    assert "alerts: none firing" not in proc.stdout  # --json is quiet
+
+
+def test_ops_console_exit_codes(tmp_path, trap):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    proc, art = _console([str(empty), "--json"], trap)
+    assert proc.returncode == 1 and "error" in art
+    proc, art = _console([str(empty), "--watch", "-1"], trap)
+    assert proc.returncode == 2 and "error" in art
+
+
+# ---------------------------------------------------------------------------
+# config contract + serving-engine zero-cost pin (satellite 4)
+# ---------------------------------------------------------------------------
+
+def _tiny_serve_cfg(**kw):
+    kw.setdefault("serve_buckets", ((3, 4),))
+    kw.setdefault("serve_batch_tasks", 2)
+    return MAMLConfig(
+        dataset_name="synthetic_serve", image_height=10, image_width=10,
+        image_channels=1, num_classes_per_set=3, num_samples_per_class=1,
+        num_target_samples=2, batch_size=2, cnn_num_filters=4,
+        num_stages=2, number_of_training_steps_per_iter=2,
+        number_of_evaluation_steps_per_iter=2, second_order=False,
+        use_multi_step_loss_optimization=False,
+        serve_default_deadline_ms=0.0,
+        serve_cache_capacity=8, **kw)
+
+
+def test_alert_rules_path_is_runtime_only_and_defaults_off():
+    assert MAMLConfig().alert_rules_path == ""
+    from howtotrainyourmamlpytorch_tpu.parallel import aot
+    # Pointing a run at a rules file must not invalidate its AOT
+    # compile cache — alerting never touches the computation.
+    assert "alert_rules_path" in aot._RUNTIME_ONLY_KEYS
+
+
+def test_engine_alerting_is_structurally_zero_cost_when_off():
+    import jax
+
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.serve import ServingEngine
+
+    cfg = _tiny_serve_cfg()
+    init, _ = make_model(cfg)
+    state = init_train_state(cfg, init, jax.random.PRNGKey(0))
+    eng = ServingEngine(cfg, state, devices=jax.devices()[:1])
+    try:
+        # The knob at default installs NOTHING: no evaluator object, no
+        # gauge series — the _perf/_watchdog structural discipline.
+        assert eng._alerts is None
+        assert eng.alerts_firing_summary() is None
+        assert alerts.FIRING_GAUGE not in eng.registry.snapshot()
+    finally:
+        eng.close()
+    # alert_rules_path is runtime-only, so the same state serves both.
+    eng = ServingEngine(_tiny_serve_cfg(alert_rules_path=DEFAULT_RULES),
+                        state, devices=jax.devices()[:1])
+    try:
+        assert eng._alerts is not None
+        # Eager registration: an alerting engine's first flush shows 0
+        # firing, not an absent series.
+        assert eng.registry.snapshot()[alerts.FIRING_GAUGE] == 0.0
+        assert eng.alerts_firing_summary() == {"count": 0,
+                                               "max_severity": None}
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_serving_bitwise_parity_alerts_on_vs_off(tmp_path):
+    """Alerting observes; it must never perturb the computation. Same
+    state, same request, alerts on vs off: bitwise-identical logits,
+    and only the alerting engine's flush carries the firing gauge."""
+    import jax
+    import numpy as np
+
+    from howtotrainyourmamlpytorch_tpu.meta.outer import init_train_state
+    from howtotrainyourmamlpytorch_tpu.models import make_model
+    from howtotrainyourmamlpytorch_tpu.serve import (
+        FewShotRequest, ServingEngine)
+
+    cfg_off = _tiny_serve_cfg()
+    init, _ = make_model(cfg_off)
+    state = init_train_state(cfg_off, init, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(7)
+    sx = rng.randint(0, 256, (3, 10, 10, 1)).astype(np.uint8)
+    sy = (np.arange(3) % 3).astype(np.int32)
+    qx = rng.randint(0, 256, (2, 10, 10, 1)).astype(np.uint8)
+
+    logits, flushed = {}, {}
+    for key, cfg in (("off", cfg_off),
+                     ("on", _tiny_serve_cfg(
+                         alert_rules_path=DEFAULT_RULES))):
+        eng = ServingEngine(cfg, state, devices=jax.devices()[:1])
+        try:
+            eng.warmup()
+            eng.submit(FewShotRequest(support_x=sx, support_y=sy,
+                                      query_x=qx))
+            (resp,) = eng.drain()
+            assert resp.error is None
+            logits[key] = np.asarray(resp.logits)
+            jl = JsonlLogger(str(tmp_path / f"events_{key}.jsonl"))
+            eng.flush_metrics(jl)
+        finally:
+            eng.close()
+        (row,) = [r for r in read_jsonl(
+            str(tmp_path / f"events_{key}.jsonl"))
+            if r.get("event") == "metrics"]
+        flushed[key] = row["metrics"]
+    assert np.array_equal(logits["on"], logits["off"])
+    assert flushed["on"].get(alerts.FIRING_GAUGE) == 0.0
+    assert alerts.FIRING_GAUGE not in flushed["off"]

@@ -718,7 +718,11 @@ def test_serve_bench_exposes_fleet_keys_as_null():
                 # ISSUE 19 traffic-lab keys (traffic_replay.py fills
                 # them; both bench artifacts carry them as null).
                 "traffic_p95_ms", "traffic_slo_held",
-                "traffic_canary_weight_final", "traffic_cb_groups"):
+                "traffic_canary_weight_final", "traffic_cb_groups",
+                # ISSUE 20 alert keys (chaos_fleet.py fills them; the
+                # benches carry them as honestly-null).
+                "alerts_fired", "alerts_resolved",
+                "alerts_active_final"):
         assert key in keys, f"serve_bench artifact lost {key}"
 
     fleet_src = open(os.path.join(REPO, "scripts", "fleet_bench.py")).read()
@@ -726,5 +730,7 @@ def test_serve_bench_exposes_fleet_keys_as_null():
                   for node in ast.walk(ast.parse(fleet_src))
                   if isinstance(node, ast.Dict) for k in node.keys}
     for key in ("traffic_p95_ms", "traffic_slo_held",
-                "traffic_canary_weight_final", "traffic_cb_groups"):
+                "traffic_canary_weight_final", "traffic_cb_groups",
+                "alerts_fired", "alerts_resolved",
+                "alerts_active_final"):
         assert key in fleet_keys, f"fleet_bench artifact lost {key}"

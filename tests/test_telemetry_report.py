@@ -27,7 +27,7 @@ CLI = os.path.join(REPO, "scripts", "telemetry_report.py")
 # v11 the serving-fleet section, v12 the perf-lab section, v13 the
 # autotune section, v14 the request-tracing + SLO section, v15 the
 # meta-algorithm zoo section, v16 the fleet-health section, v17 the
-# traffic-lab section).
+# traffic-lab section, v18 the alerts section).
 SCHEMA_KEYS = {
     "schema", "events", "epochs", "steps", "step_seconds_p50",
     "step_seconds_p95", "meta_tasks_per_sec_per_chip", "compile_count",
@@ -35,7 +35,7 @@ SCHEMA_KEYS = {
     "live_memory_bytes", "host_skew", "serving", "resilience", "data",
     "watchdog", "health", "checkpoint", "cluster", "warm_start",
     "elastic", "fleet", "fleet_health", "traffic", "perf", "tune",
-    "requests", "algo",
+    "requests", "algo", "alerts",
 }
 
 
@@ -737,6 +737,49 @@ def test_summarize_events_traffic_section():
 def test_traffic_section_unavailable_without_subsystem():
     s = summarize_events([{"event": "train_epoch", "epoch": 0}])
     assert s["traffic"] == UNAVAILABLE
+
+
+def test_summarize_events_alerts_section():
+    """v18: fired/resolved tallies from the explicit ``alert``
+    transition rows; still_firing replays transitions last-wins per
+    (source, rule, labels) — a fired-then-resolved instance reads
+    closed, the same rule on a DIFFERENT source is its own instance."""
+    events = [
+        {"event": "alert", "rule": "replica_restarts", "type": "rate",
+         "severity": "warn", "state": "firing", "labels": {},
+         "source": "supervisor"},
+        {"event": "alert", "rule": "replica_restarts", "type": "rate",
+         "severity": "warn", "state": "resolved", "labels": {},
+         "source": "supervisor"},
+        {"event": "alert", "rule": "heartbeat_stale", "type": "absence",
+         "severity": "critical", "state": "firing",
+         "labels": {"signal": "heartbeat"}, "source": "train"},
+        # Same rule name, different source: a distinct instance that is
+        # STILL firing at the end of the log.
+        {"event": "alert", "rule": "replica_restarts", "type": "rate",
+         "severity": "warn", "state": "firing", "labels": {},
+         "source": "driver"},
+        {"event": "alert", "rule": "replica_restarts", "type": "rate",
+         "severity": "warn", "state": "firing", "labels": {},
+         "source": "supervisor"},
+    ]
+    s = summarize_events(events)
+    assert set(s) == SCHEMA_KEYS
+    al = s["alerts"]
+    assert al["fired"] == 4
+    assert al["resolved"] == 1
+    # Still firing: train/heartbeat_stale, driver/replica_restarts and
+    # the supervisor's re-fired replica_restarts.
+    assert al["still_firing"] == 3
+    assert al["fired_by_severity"] == {"warn": 3, "critical": 1}
+    assert al["most_fired_rule"] == "replica_restarts"
+    assert "alerts" in format_table(s)
+
+
+def test_alerts_section_unavailable_without_subsystem():
+    s = summarize_events([{"event": "train_epoch", "epoch": 0}])
+    assert s["alerts"] == UNAVAILABLE
+    assert s["schema"] == "maml_tpu_telemetry_report_v18"
 
 
 def test_tune_section_reset_aware_across_sweep_segments():
